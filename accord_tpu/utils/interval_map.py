@@ -1,0 +1,148 @@
+"""Sorted-boundary interval maps with merge semantics.
+
+Rebuild of the reference's ReducingIntervalMap/ReducingRangeMap
+(ref: accord-core/src/main/java/accord/utils/ReducingIntervalMap.java,
+ReducingRangeMap.java:30) — the base of RedundantBefore, DurableBefore,
+MaxConflicts and rejectBefore.  A map is a step function over the token
+space: sorted boundary tokens plus one value per gap (including the two
+unbounded ends).  Watermarks being step functions over sorted boundaries is
+also what makes them natural device arrays (searchsorted lookup).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, List, Optional, Sequence, TypeVar
+
+from ..utils import invariants
+
+V = TypeVar("V")
+
+
+class ReducingRangeMap(Generic[V]):
+    """Immutable step function token -> V.
+
+    ``boundaries`` is a sorted list of tokens [b0..bn); ``values`` has
+    len(boundaries)+1 entries: values[i] applies to [b(i-1), b(i)) with
+    values[0] for (-inf, b0) and values[-1] for [bn, +inf).  None means
+    'absent'.
+    """
+
+    __slots__ = ("boundaries", "values")
+
+    def __init__(self, boundaries: Sequence[int], values: Sequence[Optional[V]]):
+        invariants.check_argument(len(values) == len(boundaries) + 1,
+                                  "values must have len(boundaries)+1 entries")
+        if invariants.PARANOID:
+            invariants.check_state(all(boundaries[i] < boundaries[i + 1]
+                                       for i in range(len(boundaries) - 1)),
+                                   "boundaries must be strictly sorted")
+        self.boundaries = tuple(boundaries)
+        self.values = tuple(values)
+
+    @classmethod
+    def empty(cls) -> "ReducingRangeMap[V]":
+        return cls((), (None,))
+
+    @classmethod
+    def of_ranges(cls, ranges, value: V) -> "ReducingRangeMap[V]":
+        """Step function that is ``value`` on the ranges and None elsewhere."""
+        boundaries: List[int] = []
+        values: List[Optional[V]] = [None]
+        for r in ranges:
+            boundaries.extend((r.start, r.end))
+            values.extend((value, None))
+        return cls(boundaries, values)
+
+    def is_empty(self) -> bool:
+        return all(v is None for v in self.values)
+
+    # -- lookup -------------------------------------------------------------
+    def _index_of(self, token: int) -> int:
+        import bisect
+        return bisect.bisect_right(self.boundaries, token)
+
+    def get(self, token: int) -> Optional[V]:
+        return self.values[self._index_of(token)]
+
+    def fold_over_ranges(self, ranges, fn: Callable[[V, "object"], "object"],
+                         initial):
+        """Fold fn over every non-None value intersecting the ranges."""
+        acc = initial
+        for r in ranges:
+            lo, hi = self._index_of(r.start), self._index_of(r.end - 1)
+            for i in range(lo, hi + 1):
+                v = self.values[i]
+                if v is not None:
+                    acc = fn(v, acc)
+        return acc
+
+    def fold_with_bounds(self, fn, initial):
+        """Fold fn(value, start_token, end_token, acc) over every segment."""
+        import itertools
+        from ..primitives.keys import MAX_TOKEN, MIN_TOKEN
+        bounds = [MIN_TOKEN, *self.boundaries, MAX_TOKEN]
+        acc = initial
+        for i, v in enumerate(self.values):
+            if v is not None:
+                acc = fn(v, bounds[i], bounds[i + 1], acc)
+        return acc
+
+    def values_intersecting(self, ranges) -> List[V]:
+        out: List[V] = []
+        self.fold_over_ranges(ranges, lambda v, acc: (out.append(v), acc)[1], None)
+        return out
+
+    # -- merge --------------------------------------------------------------
+    def merge(self, other: "ReducingRangeMap[V]",
+              reduce_fn: Callable[[V, V], V]) -> "ReducingRangeMap[V]":
+        """Pointwise merge: where both defined, reduce; else whichever is
+        defined (ref: ReducingIntervalMap.merge)."""
+        if other.is_empty():
+            return self
+        if self.is_empty():
+            return ReducingRangeMap(other.boundaries, other.values)
+        all_bounds = sorted(set(self.boundaries) | set(other.boundaries))
+        values: List[Optional[V]] = []
+        # evaluate each resulting gap at a representative point
+        import bisect
+
+        def at(m: "ReducingRangeMap[V]", i_gap: int) -> Optional[V]:
+            # gap i spans (all_bounds[i-1], all_bounds[i]); probe with the
+            # left edge (or -inf for the first gap)
+            if i_gap == 0:
+                return m.values[0]
+            return m.get(all_bounds[i_gap - 1])
+
+        for gap in range(len(all_bounds) + 1):
+            a, b = at(self, gap), at(other, gap)
+            if a is None:
+                values.append(b)
+            elif b is None:
+                values.append(a)
+            else:
+                values.append(reduce_fn(a, b))
+        return ReducingRangeMap(all_bounds, values)._compact()
+
+    def _compact(self) -> "ReducingRangeMap[V]":
+        """Drop boundaries separating equal values."""
+        if not self.boundaries:
+            return self
+        boundaries: List[int] = []
+        values: List[Optional[V]] = [self.values[0]]
+        for i, b in enumerate(self.boundaries):
+            if self.values[i + 1] != values[-1]:
+                boundaries.append(b)
+                values.append(self.values[i + 1])
+        return ReducingRangeMap(boundaries, values)
+
+    def add(self, ranges, value: V,
+            reduce_fn: Callable[[V, V], V]) -> "ReducingRangeMap[V]":
+        """Merge ``value`` over ``ranges`` into this map."""
+        return self.merge(ReducingRangeMap.of_ranges(ranges, value), reduce_fn)
+
+    def __eq__(self, o):
+        return (isinstance(o, ReducingRangeMap)
+                and self.boundaries == o.boundaries and self.values == o.values)
+
+    def __repr__(self):
+        return f"RangeMap(b={list(self.boundaries)}, v={list(self.values)})"

@@ -1,0 +1,234 @@
+"""Unit + property tests for timestamps, keys/ranges, deps CSR.
+
+Modelled on the reference's primitive test tier
+(ref: accord-core/src/test/java/accord/primitives/ KeyDepsTest, RangeDepsTest,
+TimestampTest ...)."""
+
+import random
+
+import pytest
+
+from accord_tpu.primitives import (
+    Ballot, Deps, DepsBuilder, Domain, IntKey, KeyDeps, KeyDepsBuilder, Keys,
+    Kinds, Range, RangeDeps, RangeDepsBuilder, Ranges, Route, RoutingKeys,
+    Timestamp, TxnId, TxnKind)
+from accord_tpu.utils.random_source import RandomSource
+
+
+# ---------------------------------------------------------------------------
+# Timestamp / TxnId
+# ---------------------------------------------------------------------------
+
+def test_timestamp_pack_roundtrip():
+    rng = random.Random(1)
+    for _ in range(1000):
+        epoch = rng.randrange(0, 1 << 48)
+        hlc = rng.randrange(0, 1 << 63)
+        flags = rng.randrange(0, 1 << 16)
+        node = rng.randrange(0, 1 << 31)
+        ts = Timestamp.from_values(epoch, hlc, node, flags)
+        assert ts.epoch() == epoch
+        assert ts.hlc() == hlc
+        assert ts.flags() == flags
+        assert ts.node == node
+
+
+def test_timestamp_order_epoch_major():
+    a = Timestamp.from_values(1, 10**12, 5)
+    b = Timestamp.from_values(2, 0, 0)
+    assert a < b
+    c = Timestamp.from_values(1, 10**12, 6)
+    assert a < c
+    d = Timestamp.from_values(1, 10**12 + 1, 0)
+    assert a < d and c < d
+
+
+def test_timestamp_order_matches_value_tuple():
+    rng = random.Random(2)
+    tss = []
+    for _ in range(500):
+        tss.append(Timestamp.from_values(
+            rng.randrange(0, 1 << 20), rng.randrange(0, 1 << 50),
+            rng.randrange(0, 16), rng.randrange(0, 4)))
+    by_bits = sorted(tss)
+    by_vals = sorted(tss, key=lambda t: (t.epoch(), t.hlc(), t.flags(), t.node))
+    assert by_bits == by_vals
+
+
+def test_txnid_kind_domain_roundtrip():
+    for kind in TxnKind:
+        for domain in Domain:
+            t = TxnId.create(3, 999, kind, domain, 7)
+            assert t.kind() is kind
+            assert t.domain() is domain
+            assert t.epoch() == 3 and t.hlc() == 999 and t.node == 7
+
+
+def test_txnid_witnesses():
+    r = TxnId.create(1, 1, TxnKind.Read, Domain.Key, 1)
+    w = TxnId.create(1, 2, TxnKind.Write, Domain.Key, 1)
+    e = TxnId.create(1, 3, TxnKind.EphemeralRead, Domain.Key, 1)
+    x = TxnId.create(1, 4, TxnKind.ExclusiveSyncPoint, Domain.Range, 1)
+    assert w.witnesses(r) and w.witnesses(w)
+    assert r.witnesses(w) and not r.witnesses(r)
+    assert not r.witnesses(e)
+    assert x.witnesses(r) and x.witnesses(w) and x.witnesses(x)
+    assert not x.witnesses(e)
+
+
+def test_rejected_flag_merge():
+    a = Timestamp.from_values(1, 5, 1)
+    b = Timestamp.from_values(1, 3, 2).as_rejected()
+    m = a.merge(b)
+    assert m.hlc() == 5 and m.is_rejected()
+
+
+def test_min_max_for_epoch():
+    lo, hi = Timestamp.min_for_epoch(5), Timestamp.max_for_epoch(5)
+    mid = Timestamp.from_values(5, 123456, 3, 9)
+    assert lo <= mid <= hi
+    assert Timestamp.max_for_epoch(4) < lo
+    assert hi < Timestamp.min_for_epoch(6)
+
+
+def test_with_next_hlc():
+    t = Timestamp.from_values(2, 100, 1)
+    assert t.with_next_hlc().hlc() == 101
+    assert t.with_next_hlc(500).hlc() == 500
+    assert Ballot.ZERO < Ballot.from_values(1, 1, 1)
+
+
+# ---------------------------------------------------------------------------
+# Keys / Ranges
+# ---------------------------------------------------------------------------
+
+def test_keys_sorted_dedup():
+    ks = Keys.of(IntKey(5), IntKey(1), IntKey(5), IntKey(3))
+    assert [k.value for k in ks] == [1, 3, 5]
+    assert ks.contains(IntKey(3)) and not ks.contains(IntKey(2))
+
+
+def test_keys_slice_and_union():
+    ks = Keys([IntKey(i) for i in range(10)])
+    sl = ks.slice(Ranges.of(Range(2, 5), Range(8, 100)))
+    assert [k.value for k in sl] == [2, 3, 4, 8, 9]
+    u = sl.with_(Keys.of(IntKey(0)))
+    assert [k.value for k in u] == [0, 2, 3, 4, 8, 9]
+
+
+def test_ranges_normalise_merge():
+    rs = Ranges.of(Range(5, 10), Range(1, 6), Range(20, 30))
+    assert list(rs) == [Range(1, 10), Range(20, 30)]
+    assert rs.contains_token(9) and not rs.contains_token(15)
+
+
+def test_ranges_set_algebra():
+    a = Ranges.of(Range(0, 100))
+    b = Ranges.of(Range(10, 20), Range(50, 60))
+    assert a.intersecting(b) == b
+    diff = a.without(b)
+    assert list(diff) == [Range(0, 10), Range(20, 50), Range(60, 100)]
+    assert a.contains_all_ranges(b)
+    assert not b.contains_all_ranges(a)
+    assert diff.with_(b) == a
+
+
+def test_ranges_intersects_keys():
+    rs = Ranges.of(Range(10, 20))
+    assert rs.intersects(RoutingKeys.of(5, 15))
+    assert not rs.intersects(RoutingKeys.of(5, 25))
+
+
+def test_route_slice_covers():
+    route = Route.full(7, RoutingKeys.of(3, 7, 42))
+    part = route.slice(Ranges.of(Range(0, 10)))
+    assert not part.is_full
+    assert list(part.participants) == [3, 7]
+    assert part.covers(Ranges.of(Range(2, 8)))
+    assert not part.covers(Ranges.of(Range(0, 50)))
+    merged = part.with_(route.slice(Ranges.of(Range(10, 100))))
+    assert list(merged.participants) == [3, 7, 42]
+
+
+# ---------------------------------------------------------------------------
+# Deps CSR
+# ---------------------------------------------------------------------------
+
+def _tid(hlc, node=1, kind=TxnKind.Write):
+    return TxnId.create(1, hlc, kind, Domain.Key, node)
+
+
+def test_key_deps_build_and_query():
+    b = KeyDepsBuilder()
+    b.add(10, _tid(1)).add(10, _tid(2)).add(20, _tid(2)).add(20, _tid(3))
+    kd = b.build()
+    assert kd.txn_ids == [_tid(1), _tid(2), _tid(3)]
+    assert kd.txn_ids_for(10) == [_tid(1), _tid(2)]
+    assert kd.txn_ids_for(20) == [_tid(2), _tid(3)]
+    assert kd.txn_ids_for(30) == []
+    assert kd.contains(_tid(2)) and not kd.contains(_tid(9))
+    assert list(kd.participants(_tid(2))) == [10, 20]
+
+
+def test_key_deps_csr_export():
+    kd = KeyDeps.of({10: [_tid(1), _tid(2)], 20: [_tid(2)]})
+    tokens, offsets, indices = kd.to_csr()
+    assert tokens == [10, 20]
+    assert offsets == [2, 3]
+    assert indices == [0, 1, 1]
+
+
+def test_key_deps_merge_matches_naive():
+    rs = RandomSource(42)
+    for _ in range(50):
+        n = rs.next_int(5) + 1
+        deps_list, naive = [], {}
+        for _ in range(n):
+            b = KeyDepsBuilder()
+            for _ in range(rs.next_int(20)):
+                tok = rs.next_int(8)
+                t = _tid(rs.next_int(30) + 1, rs.next_int(3))
+                b.add(tok, t)
+                naive.setdefault(tok, set()).add(t)
+            deps_list.append(b.build())
+        merged = KeyDeps.merge(deps_list)
+        for tok, ids in naive.items():
+            assert merged.txn_ids_for(tok) == sorted(ids)
+
+
+def test_key_deps_slice_without():
+    kd = KeyDeps.of({5: [_tid(1)], 15: [_tid(2)], 25: [_tid(3)]})
+    sl = kd.slice(Ranges.of(Range(0, 20)))
+    assert sl.txn_ids == [_tid(1), _tid(2)]
+    wo = kd.without(lambda t: t == _tid(2))
+    assert wo.txn_ids == [_tid(1), _tid(3)]
+
+
+def test_range_deps_stabbing():
+    b = RangeDepsBuilder()
+    b.add(Range(0, 10), _tid(1)).add(Range(5, 15), _tid(2)).add(Range(20, 30), _tid(3))
+    rd = b.build()
+    assert rd.intersecting_token(7) == [_tid(1), _tid(2)]
+    assert rd.intersecting_token(12) == [_tid(2)]
+    assert rd.intersecting_token(17) == []
+    assert rd.intersecting_range(Range(8, 25)) == [_tid(1), _tid(2), _tid(3)]
+    assert rd.participants(_tid(2)) == Ranges.of(Range(5, 15))
+
+
+def test_deps_union_and_merge():
+    d1 = DepsBuilder().add_key(1, _tid(1)).add_range(Range(0, 10), _tid(2)).build()
+    d2 = DepsBuilder().add_key(1, _tid(3)).add_key(2, _tid(1)).build()
+    u = d1.with_(d2)
+    assert u.key_deps.txn_ids_for(1) == [_tid(1), _tid(3)]
+    assert u.key_deps.txn_ids_for(2) == [_tid(1)]
+    assert u.range_deps.intersecting_token(5) == [_tid(2)]
+    m = Deps.merge([d1, d2, Deps.none()])
+    assert m == u
+    assert u.contains(_tid(2)) and u.max_txn_id() == _tid(3)
+
+
+def test_partial_deps_covers():
+    d = DepsBuilder().add_key(5, _tid(1)).build_partial(Ranges.of(Range(0, 10)))
+    assert d.covers(RoutingKeys.of(3, 9))
+    assert not d.covers(RoutingKeys.of(3, 11))
+    assert d.covers(Ranges.of(Range(2, 8)))

@@ -1,0 +1,104 @@
+"""Tests for bitsets, interval maps, async chains, random source
+(ref test models: SimpleBitSetTest, ReducingRangeMapTest, async tests)."""
+
+import pytest
+
+from accord_tpu.primitives import Range, Ranges
+from accord_tpu.utils import async_chain
+from accord_tpu.utils.bitset import ImmutableBitSet, SimpleBitSet
+from accord_tpu.utils.interval_map import ReducingRangeMap
+from accord_tpu.utils.random_source import RandomSource
+
+
+def test_bitset_basic():
+    bs = SimpleBitSet(70)
+    assert bs.set(3) and bs.set(65) and not bs.set(3)
+    assert bs.get(3) and bs.get(65) and not bs.get(4)
+    assert bs.count() == 2
+    assert list(bs) == [3, 65]
+    assert bs.first_set() == 3 and bs.last_set() == 65
+    assert bs.next_set(4) == 65 and bs.prev_set(64) == 3
+    assert bs.unset(3) and not bs.unset(3)
+    assert bs.to_words()[2] == (1 << 1)  # bit 65 -> word 2 bit 1
+
+
+def test_bitset_immutable():
+    bs = SimpleBitSet.full(5).freeze()
+    with pytest.raises(TypeError):
+        bs.set(1)
+    assert isinstance(bs.with_unset(0), ImmutableBitSet)
+    assert list(bs.with_unset(0)) == [1, 2, 3, 4]
+
+
+def test_range_map_of_and_get():
+    m = ReducingRangeMap.of_ranges(Ranges.of(Range(10, 20)), 5)
+    assert m.get(9) is None and m.get(10) == 5 and m.get(19) == 5 and m.get(20) is None
+
+
+def test_range_map_merge_max():
+    m = ReducingRangeMap.empty()
+    m = m.add(Ranges.of(Range(0, 100)), 1, max)
+    m = m.add(Ranges.of(Range(50, 150)), 2, max)
+    assert m.get(10) == 1 and m.get(75) == 2 and m.get(120) == 2 and m.get(160) is None
+    m = m.add(Ranges.of(Range(0, 200)), 0, max)
+    assert m.get(10) == 1 and m.get(75) == 2 and m.get(180) == 0
+
+
+def test_range_map_fold():
+    m = ReducingRangeMap.of_ranges(Ranges.of(Range(0, 10), Range(20, 30)), 3)
+    total = m.fold_over_ranges(Ranges.of(Range(5, 25)), lambda v, acc: acc + v, 0)
+    assert total == 6
+    segs = m.fold_with_bounds(lambda v, s, e, acc: acc + [(v, s, e)], [])
+    assert segs == [(3, 0, 10), (3, 20, 30)]
+
+
+def test_async_chain_map_flatmap():
+    out = []
+    async_chain.success(2).map(lambda x: x + 1).flat_map(
+        lambda x: async_chain.success(x * 10)).begin(
+        lambda r, f: out.append((r, f)))
+    assert out == [(30, None)]
+
+
+def test_async_chain_failure_propagates():
+    out = []
+    boom = ValueError("boom")
+    async_chain.failure(boom).map(lambda x: x + 1).begin(lambda r, f: out.append((r, f)))
+    assert out == [(None, boom)]
+    out2 = []
+    async_chain.failure(boom).recover(lambda e: 42).begin(lambda r, f: out2.append((r, f)))
+    assert out2 == [(42, None)]
+
+
+def test_async_result_settles_once():
+    r = async_chain.AsyncResult()
+    seen = []
+    r.begin(lambda v, f: seen.append(v))
+    r.set_success(1)
+    r.set_success(2)
+    assert seen == [1] and r.result() == 1
+
+
+def test_async_all_and_reduce():
+    a, b = async_chain.AsyncResult(), async_chain.AsyncResult()
+    out = []
+    async_chain.reduce([a, b], lambda x, y: x + y).begin(lambda r, f: out.append(r))
+    assert out == []
+    b.set_success(10)
+    a.set_success(1)
+    assert out == [11]
+
+
+def test_random_source_determinism():
+    a, b = RandomSource(7), RandomSource(7)
+    assert [a.next_int(100) for _ in range(20)] == [b.next_int(100) for _ in range(20)]
+    fa, fb = a.fork(), b.fork()
+    assert fa.next_long() == fb.next_long()
+
+
+def test_random_zipf_skews():
+    rs = RandomSource(3)
+    draws = [rs.next_zipf(100, 0.99) for _ in range(2000)]
+    assert all(0 <= d < 100 for d in draws)
+    low = sum(1 for d in draws if d < 10)
+    assert low > len(draws) * 0.4  # heavily skewed to small indices
