@@ -1,0 +1,104 @@
+"""The transaction entry FSM: PreAccept round -> fast/slow path.
+
+Rebuild of ref: accord-core/src/main/java/accord/coordinate/
+CoordinateTransaction.java:50-101 and AbstractCoordinatePreAccept.java:46-250.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .. import api
+from ..messages.preaccept import PreAccept, PreAcceptNack, PreAcceptOk
+from ..primitives.deps import Deps
+from ..primitives.timestamp import Ballot, Timestamp, TxnId
+from ..primitives.txn import Txn
+from ..utils import async_chain
+from .errors import Exhausted, Preempted, Timeout
+from .execute import execute
+from .propose import propose
+from .tracking import FastPathTracker, RequestStatus
+
+
+class CoordinateTransaction(api.Callback):
+    """(ref: coordinate/CoordinateTransaction.java)."""
+
+    @staticmethod
+    def coordinate(node, txn_id: TxnId, txn: Txn) -> async_chain.AsyncChain:
+        return CoordinateTransaction(node, txn_id, txn)._start()
+
+    def __init__(self, node, txn_id: TxnId, txn: Txn):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = node.compute_route(txn_id, txn.keys)
+        self.result: async_chain.AsyncResult = async_chain.AsyncResult()
+        self.topologies = node.topology().with_unsynced_epochs(
+            self.route.participants, txn_id.epoch(), txn_id.epoch())
+        self.tracker = FastPathTracker(self.topologies)
+        self.oks: Dict[int, PreAcceptOk] = {}
+        self.done = False
+
+    def _start(self) -> async_chain.AsyncChain:
+        request = PreAccept(self.txn_id, self.txn, self.route,
+                            self.topologies.current_epoch())
+        for to in sorted(self.tracker.nodes()):
+            self.node.send(to, request, self)
+        return self.result
+
+    # -- Callback -----------------------------------------------------------
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        if isinstance(reply, PreAcceptNack) or not reply.is_ok():
+            # a higher ballot owns this txn: a recovery coordinator preempted us
+            self._fail(Preempted(self.txn_id))
+            return
+        self.oks[from_id] = reply
+        fast_vote = reply.witnessed_at == self.txn_id
+        status = self.tracker.record_success(from_id, fast_vote)
+        if status is RequestStatus.Success:
+            self._on_preaccepted()
+        elif status is RequestStatus.Failed:
+            self._fail(Exhausted(self.txn_id))
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        status = self.tracker.record_failure(from_id)
+        if status is RequestStatus.Failed:
+            self._fail(Timeout(self.txn_id))
+
+    # -- decision (ref: CoordinateTransaction.java:71-101) ------------------
+    def _on_preaccepted(self) -> None:
+        self.done = True
+        oks = list(self.oks.values())
+        if self.tracker.has_fast_path_accepted():
+            # fast path: executeAt == txnId, deps from fast-path voters
+            deps = Deps.merge([ok.deps for ok in oks
+                               if ok.witnessed_at == self.txn_id])
+            self.node.agent.events_listener().on_fast_path_taken(self.txn_id, deps)
+            execute(self.node, self.txn_id, self.txn, self.route,
+                    self.txn_id, deps).begin(self.result.settle)
+        else:
+            execute_at = self.txn_id
+            for ok in oks:
+                if ok.witnessed_at > execute_at:
+                    execute_at = ok.witnessed_at
+            deps = Deps.merge([ok.deps for ok in oks])
+            self.node.agent.events_listener().on_slow_path_taken(self.txn_id, deps)
+            propose(self.node, Ballot.ZERO, self.txn_id, self.txn, self.route,
+                    execute_at, deps).begin(self._on_proposed)
+
+    def _on_proposed(self, value, failure) -> None:
+        if failure is not None:
+            self.result.set_failure(failure)
+            return
+        execute_at, deps = value
+        execute(self.node, self.txn_id, self.txn, self.route, execute_at,
+                deps).begin(self.result.settle)
+
+    def _fail(self, exc: BaseException) -> None:
+        if not self.done:
+            self.done = True
+            self.result.set_failure(exc)

@@ -1,0 +1,46 @@
+"""Coordination failure hierarchy
+(ref: accord-core/src/main/java/accord/coordinate/CoordinationFailed.java,
+Timeout.java, Preempted.java, Invalidated.java, Truncated.java,
+Exhausted.java, TopologyMismatch.java)."""
+
+from __future__ import annotations
+
+from ..primitives.timestamp import TxnId
+
+
+class CoordinationFailed(RuntimeError):
+    def __init__(self, txn_id: TxnId = None, msg: str = ""):
+        super().__init__(msg or type(self).__name__)
+        self.txn_id = txn_id
+
+
+class Timeout(CoordinationFailed):
+    pass
+
+
+class Preempted(CoordinationFailed):
+    pass
+
+
+class Invalidated(CoordinationFailed):
+    pass
+
+
+class Truncated(CoordinationFailed):
+    pass
+
+
+class Exhausted(CoordinationFailed):
+    pass
+
+
+class StaleTopology(CoordinationFailed):
+    pass
+
+
+class TopologyMismatch(CoordinationFailed):
+    pass
+
+
+class RangeUnavailable(CoordinationFailed):
+    pass

@@ -1,0 +1,146 @@
+"""Stable + Read round, then Persist.
+
+Rebuild of ref: accord-core/src/main/java/accord/coordinate/ExecuteTxn.java:53-162
+and Stabilise.java:47 — the stable round is fused with the read
+(Commit.stableAndRead, ref: messages/Commit.java:175): every replica gets the
+Stable distribution; one replica per execution shard additionally performs
+the read once its drain releases the txn.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+from .. import api
+from ..messages.commit import Commit, CommitKind, CommitNack, CommitOk
+from ..messages.read_data import ReadNack, ReadOk, ReadTxnData
+from ..primitives.deps import Deps
+from ..primitives.keys import Route
+from ..primitives.timestamp import Timestamp, TxnId
+from ..primitives.txn import Txn
+from ..utils import async_chain
+from .errors import Exhausted, Timeout
+from .persist import persist
+from .tracking import QuorumTracker, ReadTracker, RequestStatus
+
+
+def execute(node, txn_id: TxnId, txn: Txn, route: Route,
+            execute_at: Timestamp, deps: Deps) -> async_chain.AsyncChain:
+    """Returns chain of the client Result (settled at persist-start,
+    ref: CoordinationAdapter.java:189-194)."""
+    return _ExecuteTxn(node, txn_id, txn, route, execute_at, deps)._start()
+
+
+class _ExecuteTxn(api.Callback):
+    def __init__(self, node, txn_id, txn, route, execute_at, deps):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.execute_at = execute_at
+        self.deps = deps
+        self.all_topologies = node.topology().with_unsynced_epochs(
+            route.participants, txn_id.epoch(), execute_at.epoch())
+        exec_topology = self.all_topologies.for_epoch(execute_at.epoch())
+        from ..topology.topology import Topologies
+        self.read_tracker = ReadTracker(Topologies.single(exec_topology))
+        self.stable_tracker = QuorumTracker(self.all_topologies)
+        self.data = None
+        self.read_nodes: Set[int] = set()
+        self.result: async_chain.AsyncResult = async_chain.AsyncResult()
+        self.done = False
+        self.stable_done = False
+        self.read_done = False
+
+    def _read_nodes(self) -> Set[int]:
+        """One replica per execution shard, preferring ourselves then the
+        first live candidate (ref: ReadTracker initial contact)."""
+        chosen: Set[int] = set()
+        for t in self.read_tracker.trackers:
+            shard = t.shard
+            if any(n in chosen for n in shard.nodes):
+                continue
+            if self.node.node_id in shard.nodes:
+                chosen.add(self.node.node_id)
+            else:
+                chosen.add(shard.nodes[0])
+        return chosen
+
+    def _start(self) -> async_chain.AsyncChain:
+        self.read_nodes = self._read_nodes()
+        for n in self.read_nodes:
+            self.read_tracker.record_in_flight(n)
+        for to in sorted(self.stable_tracker.nodes()):
+            request = Commit(CommitKind.Stable, self.txn_id, self.txn,
+                             self.route, self.execute_at, self.deps,
+                             read=to in self.read_nodes)
+            self.node.send(to, request, self)
+        return self.result
+
+    # -- Callback -----------------------------------------------------------
+    def on_success(self, from_id: int, reply) -> None:
+        if self.done:
+            return
+        if isinstance(reply, CommitOk):
+            if self.stable_tracker.record_success(from_id) is RequestStatus.Success:
+                self.stable_done = True
+                self._maybe_finish()
+        elif isinstance(reply, ReadOk):
+            if reply.data is not None:
+                self.data = (reply.data if self.data is None
+                             else self.data.merge(reply.data))
+            if self.read_tracker.record_read_success(from_id) is RequestStatus.Success:
+                self.read_done = True
+                self._maybe_finish()
+        elif isinstance(reply, ReadNack):
+            self._read_failed(from_id)
+        elif isinstance(reply, CommitNack):
+            if reply.reason == "Insufficient":
+                # resend with full hydration (ref: ExecuteTxn stableMaximal),
+                # preserving the read leg if this was a read-designated node
+                request = Commit(CommitKind.Stable, self.txn_id, self.txn,
+                                 self.route, self.execute_at, self.deps,
+                                 read=from_id in self.read_nodes)
+                self.node.send(from_id, request, self)
+            else:
+                self._fail(Exhausted(self.txn_id))
+
+    def on_failure(self, from_id: int, failure: BaseException) -> None:
+        if self.done:
+            return
+        if self.stable_tracker.record_failure(from_id) is RequestStatus.Failed:
+            self._fail(Timeout(self.txn_id))
+            return
+        self._read_failed(from_id)
+
+    def _read_failed(self, from_id: int) -> None:
+        status, to_contact = self.read_tracker.record_read_failure(from_id)
+        if status is RequestStatus.Failed:
+            self._fail(Exhausted(self.txn_id))
+            return
+        if status is RequestStatus.Success:
+            self.read_done = True
+            self._maybe_finish()
+            return
+        for to in to_contact:
+            self.read_tracker.record_in_flight(to)
+            self.node.send(to, ReadTxnData(self.txn_id, self.route,
+                                           self.execute_at.epoch()), self)
+
+    # -- completion ---------------------------------------------------------
+    def _maybe_finish(self) -> None:
+        if self.done or not (self.stable_done and self.read_done):
+            return
+        self.done = True
+        writes = self.txn.execute(self.txn_id, self.execute_at, self.data)
+        result = (self.txn.result(self.txn_id, self.execute_at, self.data)
+                  if self.txn.query is not None else None)
+        persist(self.node, self.txn_id, self.txn, self.route, self.execute_at,
+                self.deps, writes, result)
+        # client is answered at persist-start (ref: CoordinationAdapter:189-194)
+        self.result.set_success(result)
+
+    def _fail(self, exc: BaseException) -> None:
+        if not self.done:
+            self.done = True
+            self.result.set_failure(exc)

@@ -1,0 +1,479 @@
+"""Per-node metadata shards: CommandStore / SafeCommandStore / CommandStores.
+
+Rebuild of ref: accord-core/src/main/java/accord/local/CommandStore.java:80,
+SafeCommandStore.java:56, CommandStores.java:78, PreLoadContext.java:42.
+
+A CommandStore is one single-threaded metadata shard owning a set of token
+ranges: all commands, per-key conflict indexes (CommandsForKey), and the
+watermark maps.  Tasks are submitted with a PreLoadContext and run with an
+exclusive SafeCommandStore view; in this build the "thread" is a deterministic
+task queue drained through the node's Scheduler, so the whole node group is
+simulator-controlled (and the store's array state can be shipped to the TPU
+between tasks without synchronisation).
+
+CommandStores is the shard group: it splits the node's owned ranges over a
+fixed number of stores (ShardDistributor.EvenSplit analogue) and scatter-
+gathers map-reduce-consume tasks across intersecting stores
+(ref: CommandStores.java:575-643).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..primitives.deps import PartialDeps
+from ..primitives.keys import Range, Ranges, RoutingKeys, Unseekables
+from ..primitives.timestamp import Kinds, Timestamp, TxnId
+from ..utils import async_chain, invariants
+from ..utils.interval_map import ReducingRangeMap
+from .command import Command
+from .commands_for_key import CommandsForKey, InternalStatus
+from .redundant import DurableBefore, MaxConflicts, RedundantBefore
+
+
+class PreLoadContext:
+    """Declares what a task needs in memory before running
+    (ref: local/PreLoadContext.java:42-90).  In-memory stores satisfy any
+    context immediately; a paging/journal store uses it to schedule loads."""
+
+    __slots__ = ("primary_txn_id", "additional_txn_ids", "keys")
+
+    def __init__(self, primary_txn_id: Optional[TxnId] = None,
+                 additional_txn_ids: Sequence[TxnId] = (),
+                 keys: Optional[Unseekables] = None):
+        self.primary_txn_id = primary_txn_id
+        self.additional_txn_ids = tuple(additional_txn_ids)
+        self.keys = keys
+
+    @classmethod
+    def empty(cls) -> "PreLoadContext":
+        return _EMPTY_CONTEXT
+
+    @classmethod
+    def for_txn(cls, txn_id: TxnId, keys: Optional[Unseekables] = None) -> "PreLoadContext":
+        return cls(txn_id, (), keys)
+
+
+_EMPTY_CONTEXT = PreLoadContext()
+
+
+class RangesForEpoch:
+    """Per-store epoch -> owned-ranges history
+    (ref: CommandStores.java:142-336)."""
+
+    __slots__ = ("_by_epoch",)
+
+    def __init__(self):
+        self._by_epoch: Dict[int, Ranges] = {}
+
+    def snapshot(self, epoch: int, ranges: Ranges) -> None:
+        self._by_epoch[epoch] = ranges
+
+    def at(self, epoch: int) -> Ranges:
+        if not self._by_epoch:
+            return Ranges.empty()
+        best = None
+        for e in sorted(self._by_epoch):
+            if e <= epoch:
+                best = e
+        if best is None:
+            best = min(self._by_epoch)
+        return self._by_epoch[best]
+
+    def current(self) -> Ranges:
+        if not self._by_epoch:
+            return Ranges.empty()
+        return self._by_epoch[max(self._by_epoch)]
+
+    def all_between(self, min_epoch: int, max_epoch: int) -> Ranges:
+        """Union of every snapshot in effect during [min_epoch, max_epoch]:
+        the snapshots declared inside the window plus the one already active
+        at min_epoch."""
+        out = self.at(min_epoch)
+        for e, r in self._by_epoch.items():
+            if min_epoch <= e <= max_epoch:
+                out = out.with_(r)
+        return out
+
+    def all(self) -> Ranges:
+        out = Ranges.empty()
+        for r in self._by_epoch.values():
+            out = out.with_(r)
+        return out
+
+
+class CommandStore:
+    """One single-threaded metadata shard (ref: local/CommandStore.java:80)."""
+
+    def __init__(self, store_id: int, node):
+        self.store_id = store_id
+        self.node = node                      # local.node.Node
+        self.ranges_for_epoch = RangesForEpoch()
+        self.commands: Dict[TxnId, Command] = {}
+        self.commands_for_key: Dict[int, CommandsForKey] = {}
+        # Range-domain txns indexed for the range scan path
+        # (ref: InMemoryCommandStore.rangeCommands TreeMap scan :524)
+        self.range_commands: Dict[TxnId, Ranges] = {}
+        self.max_conflicts = MaxConflicts()
+        self.redundant_before = RedundantBefore()
+        self.durable_before = DurableBefore()
+        self.reject_before: Optional[ReducingRangeMap] = None
+        self._queue: List[Callable[[], None]] = []
+        self._draining = False
+        # transient (non-durable) listeners: txn_id -> [fn(safe, command)]
+        # (ref: Command.TransientListener / ReadData registration)
+        self.transient_listeners: Dict[TxnId, List[Callable]] = {}
+        self.progress_log = node.progress_log_factory(self)
+
+    # -- executor contract (ref: CommandStore submit/execute) ---------------
+    def execute(self, context: PreLoadContext,
+                fn: Callable[["SafeCommandStore"], "object"]) -> async_chain.AsyncChain:
+        """Queue fn to run with exclusive access; returns chain of result."""
+        out: async_chain.AsyncResult = async_chain.AsyncResult()
+
+        def task():
+            safe = SafeCommandStore(self, context)
+            try:
+                result = fn(safe)
+            except BaseException as e:  # noqa: BLE001
+                safe.complete()
+                out.set_failure(e)
+                return
+            safe.complete()
+            out.set_success(result)
+
+        self._queue.append(task)
+        self._schedule_drain()
+        return out
+
+    def _schedule_drain(self) -> None:
+        if self._draining:
+            return
+        self._draining = True
+        self.node.scheduler.now(self._drain)
+
+    def _drain(self) -> None:
+        while self._queue:
+            task = self._queue.pop(0)
+            try:
+                task()
+            except BaseException as e:  # noqa: BLE001
+                self.node.agent.on_uncaught_exception(e)
+        self._draining = False
+
+    # -- state helpers ------------------------------------------------------
+    def cfk(self, token: int) -> CommandsForKey:
+        c = self.commands_for_key.get(token)
+        if c is None:
+            c = self.commands_for_key[token] = CommandsForKey(token)
+        return c
+
+    def command_if_present(self, txn_id: TxnId) -> Optional[Command]:
+        return self.commands.get(txn_id)
+
+    def owned_at(self, epoch: int) -> Ranges:
+        return self.ranges_for_epoch.at(epoch)
+
+    def owned_current(self) -> Ranges:
+        return self.ranges_for_epoch.current()
+
+    def unsafe_set_command(self, command: Command) -> None:
+        self.commands[command.txn_id] = command
+
+    def __repr__(self):
+        return f"CommandStore#{self.store_id}@{self.node.node_id}"
+
+
+class SafeCommandStore:
+    """Exclusive view of a CommandStore during one task
+    (ref: local/SafeCommandStore.java:56).  Listener notifications triggered
+    by updates are deferred until the task completes to avoid reentrancy."""
+
+    def __init__(self, store: CommandStore, context: PreLoadContext):
+        self.store = store
+        self.context = context
+        self._pending_notifications: List[Tuple[TxnId, TxnId]] = []
+        self._pending_transients: List[TxnId] = []
+        self._completed = False
+
+    # -- command access -----------------------------------------------------
+    def get(self, txn_id: TxnId) -> Command:
+        """Get or create the command record (ref: SafeCommandStore.get with
+        truncation-on-read via RedundantBefore, :79-189)."""
+        cmd = self.store.commands.get(txn_id)
+        if cmd is None:
+            cmd = Command(txn_id)
+            self.store.commands[txn_id] = cmd
+        return cmd
+
+    def if_present(self, txn_id: TxnId) -> Optional[Command]:
+        return self.store.commands.get(txn_id)
+
+    def update(self, command: Command, notify: bool = True) -> Command:
+        """Install a new version of the command; queues listener
+        notifications for any watchers."""
+        prev = self.store.commands.get(command.txn_id)
+        self.store.commands[command.txn_id] = command
+        if notify and prev is not None and command.save_status != prev.save_status:
+            for listener in command.listeners:
+                self._pending_notifications.append((listener, command.txn_id))
+            if command.txn_id in self.store.transient_listeners:
+                self._pending_transients.append(command.txn_id)
+        return command
+
+    def notify_listeners(self, command: Command) -> None:
+        for listener in command.listeners:
+            self._pending_notifications.append((listener, command.txn_id))
+
+    def add_transient_listener(self, txn_id: TxnId, fn: Callable) -> None:
+        self.store.transient_listeners.setdefault(txn_id, []).append(fn)
+
+    def remove_transient_listeners(self, txn_id: TxnId) -> None:
+        self.store.transient_listeners.pop(txn_id, None)
+
+    def notify_transient(self, command: Command) -> None:
+        fns = self.store.transient_listeners.get(command.txn_id)
+        if fns:
+            for fn in list(fns):
+                fn(self, command)
+
+    # -- cfk / scans --------------------------------------------------------
+    def cfk(self, token: int) -> CommandsForKey:
+        return self.store.cfk(token)
+
+    def map_reduce_active(self, keys_or_ranges, started_before: Timestamp,
+                          witnesses: Kinds, fn, acc):
+        """The PreAccept conflict scan over this store's owned slice
+        (ref: SafeCommandStore.java:269-286; InMemoryCommandStore.java:863-877).
+        Covers both the per-key indexes and the range-txn scan."""
+        owned = self.ranges(started_before.epoch())
+        if isinstance(keys_or_ranges, Ranges):
+            scan_ranges = keys_or_ranges.slice(owned)
+            for token, cfk in self.store.commands_for_key.items():
+                if scan_ranges.contains_token(token):
+                    acc = cfk.map_reduce_active(started_before, witnesses,
+                                                lambda tid, a, t=token: fn(t, tid, a), acc)
+            acc = self._scan_range_commands_ranges(scan_ranges, started_before,
+                                                   witnesses, fn, acc)
+        else:
+            for token in keys_or_ranges.tokens():
+                if not owned.contains_token(token):
+                    continue
+                cfk = self.store.commands_for_key.get(token)
+                if cfk is not None:
+                    acc = cfk.map_reduce_active(started_before, witnesses,
+                                                lambda tid, a, t=token: fn(t, tid, a), acc)
+                acc = self._scan_range_commands_token(token, started_before,
+                                                      witnesses, fn, acc)
+        return acc
+
+    def _scan_range_commands_token(self, token: int, started_before, witnesses,
+                                   fn, acc):
+        for tid, ranges in self.store.range_commands.items():
+            if tid >= started_before or not witnesses.test(tid.kind()):
+                continue
+            cmd = self.store.commands.get(tid)
+            if cmd is not None and cmd.is_invalidated():
+                continue
+            if ranges.contains_token(token):
+                acc = fn(Ranges.of(Range(token, token + 1)), tid, acc)
+        return acc
+
+    def _scan_range_commands_ranges(self, scan: Ranges, started_before,
+                                    witnesses, fn, acc):
+        for tid, ranges in self.store.range_commands.items():
+            if tid >= started_before or not witnesses.test(tid.kind()):
+                continue
+            cmd = self.store.commands.get(tid)
+            if cmd is not None and cmd.is_invalidated():
+                continue
+            inter = ranges.intersecting(scan)
+            if not inter.is_empty():
+                acc = fn(inter, tid, acc)
+        return acc
+
+    def map_reduce_full(self, keys_or_ranges, test_txn_id: TxnId,
+                        witnesses: Kinds, fn, acc):
+        """Recovery-time scan over ALL witnessed txns
+        (ref: SafeCommandStore mapReduceFull)."""
+        owned = self.ranges(test_txn_id.epoch())
+        if isinstance(keys_or_ranges, Ranges):
+            scan_ranges = keys_or_ranges.slice(owned)
+            for token, cfk in self.store.commands_for_key.items():
+                if scan_ranges.contains_token(token):
+                    acc = cfk.map_reduce_full(test_txn_id, witnesses,
+                                              lambda info, a, t=token: fn(t, info, a), acc)
+            for tid, ranges in self.store.range_commands.items():
+                if witnesses.test(tid.kind()) and not ranges.intersecting(scan_ranges).is_empty():
+                    cmd = self.store.commands.get(tid)
+                    info = _range_txn_info(tid, cmd)
+                    if info is not None:
+                        acc = fn(ranges[0].start, info, acc)
+        else:
+            for token in keys_or_ranges.tokens():
+                if not owned.contains_token(token):
+                    continue
+                cfk = self.store.commands_for_key.get(token)
+                if cfk is not None:
+                    acc = cfk.map_reduce_full(test_txn_id, witnesses,
+                                              lambda info, a, t=token: fn(t, info, a), acc)
+                for tid, ranges in self.store.range_commands.items():
+                    if witnesses.test(tid.kind()) and ranges.contains_token(token):
+                        cmd = self.store.commands.get(tid)
+                        info = _range_txn_info(tid, cmd)
+                        if info is not None:
+                            acc = fn(token, info, acc)
+        return acc
+
+    # -- watermarks ---------------------------------------------------------
+    def ranges(self, epoch: int) -> Ranges:
+        return self.store.owned_at(epoch)
+
+    def max_conflict(self, keys_or_ranges) -> Timestamp:
+        return self.store.max_conflicts.get_max(keys_or_ranges)
+
+    def update_max_conflicts(self, keys_or_ranges, ts: Timestamp) -> None:
+        self.store.max_conflicts.update(keys_or_ranges, ts)
+
+    def redundant_before(self) -> RedundantBefore:
+        return self.store.redundant_before
+
+    def durable_before(self) -> DurableBefore:
+        return self.store.durable_before
+
+    def progress_log(self):
+        return self.store.progress_log
+
+    def node(self):
+        return self.store.node
+
+    def time(self):
+        return self.store.node
+
+    def agent(self):
+        return self.store.node.agent
+
+    def data_store(self):
+        return self.store.node.data_store
+
+    # -- completion ---------------------------------------------------------
+    def complete(self) -> None:
+        """Flush deferred listener notifications (each as its own store task,
+        mirroring the reference's executor hand-off per listener update)."""
+        if self._completed:
+            return
+        self._completed = True
+        notifications, self._pending_notifications = self._pending_notifications, []
+        transients, self._pending_transients = self._pending_transients, []
+        if not notifications and not transients:
+            return
+        from . import commands as commands_mod
+
+        def run(safe: "SafeCommandStore"):
+            for listener_id, updated_id in notifications:
+                commands_mod.listener_update(safe, listener_id, updated_id)
+            for txn_id in transients:
+                cmd = safe.if_present(txn_id)
+                if cmd is not None:
+                    safe.notify_transient(cmd)
+        self.store.execute(PreLoadContext.empty(), run)
+
+
+def _range_txn_info(tid: TxnId, cmd: Optional[Command]):
+    from .commands_for_key import InternalStatus, TxnInfo
+    if cmd is None:
+        return TxnInfo(tid, InternalStatus.TRANSITIVELY_KNOWN)
+    if cmd.is_invalidated():
+        return TxnInfo(tid, InternalStatus.INVALIDATED)
+    from .status import Status
+    if cmd.has_been(Status.Applied):
+        st = InternalStatus.APPLIED
+    elif cmd.has_been(Status.Stable):
+        st = InternalStatus.STABLE
+    elif cmd.has_been(Status.Committed):
+        st = InternalStatus.COMMITTED
+    elif cmd.has_been(Status.Accepted):
+        st = InternalStatus.ACCEPTED
+    else:
+        st = InternalStatus.PREACCEPTED
+    return TxnInfo(tid, st, cmd.execute_at)
+
+
+class CommandStores:
+    """The shard group for one node (ref: local/CommandStores.java:78)."""
+
+    def __init__(self, node, num_stores: int = 1):
+        self.node = node
+        self.num_stores = num_stores
+        self.stores: List[CommandStore] = []
+        self._next_id = 0
+
+    # -- topology -----------------------------------------------------------
+    def update_topology(self, topology, epoch: Optional[int] = None) -> None:
+        """Assign this node's owned ranges across stores
+        (ref: CommandStores.updateTopology :401-482).  Ranges are split
+        evenly by token span (ShardDistributor.EvenSplit analogue)."""
+        epoch = epoch if epoch is not None else topology.epoch
+        owned = topology.ranges_for_node(self.node.node_id)
+        if not self.stores:
+            for _ in range(self.num_stores):
+                store = CommandStore(self._next_id, self.node)
+                self._next_id += 1
+                self.stores.append(store)
+        chunks = self._split(owned, len(self.stores))
+        for store, chunk in zip(self.stores, chunks):
+            store.ranges_for_epoch.snapshot(epoch, chunk)
+
+    @staticmethod
+    def _split(ranges: Ranges, n: int) -> List[Ranges]:
+        if n == 1 or ranges.is_empty():
+            return [ranges] + [Ranges.empty()] * (n - 1)
+        total = sum(r.end - r.start for r in ranges)
+        per = max(1, total // n)
+        chunks: List[List[Range]] = [[] for _ in range(n)]
+        i, budget = 0, per
+        for r in ranges:
+            start = r.start
+            while start < r.end:
+                take = min(budget, r.end - start)
+                chunks[i].append(Range(start, start + take))
+                start += take
+                budget -= take
+                if budget == 0 and i < n - 1:
+                    i += 1
+                    budget = per
+        return [Ranges(c) for c in chunks]
+
+    # -- scatter-gather -----------------------------------------------------
+    def intersecting(self, select: Unseekables, min_epoch: int,
+                     max_epoch: int) -> List[CommandStore]:
+        out = []
+        for store in self.stores:
+            owned = store.ranges_for_epoch.all_between(min_epoch, max_epoch)
+            if not owned.is_empty() and (
+                    select.intersects(owned) if not isinstance(select, Ranges)
+                    else owned.intersects(select)):
+                out.append(store)
+        return out
+
+    def for_each(self, context: PreLoadContext, select: Unseekables,
+                 min_epoch: int, max_epoch: int,
+                 fn: Callable[[SafeCommandStore], None]) -> async_chain.AsyncChain:
+        stores = self.intersecting(select, min_epoch, max_epoch)
+        chains = [s.execute(context, fn) for s in stores]
+        return async_chain.all_of(chains).map(lambda _: None)
+
+    def map_reduce(self, context: PreLoadContext, select: Unseekables,
+                   min_epoch: int, max_epoch: int,
+                   map_fn: Callable[[SafeCommandStore], "object"],
+                   reduce_fn: Callable[["object", "object"], "object"]
+                   ) -> async_chain.AsyncChain:
+        """(ref: CommandStores.mapReduce :575-643)."""
+        stores = self.intersecting(select, min_epoch, max_epoch)
+        if not stores:
+            return async_chain.success(None)
+        chains = [s.execute(context, map_fn) for s in stores]
+        return async_chain.reduce(chains, reduce_fn)
+
+    def unsafe_all_stores(self) -> List[CommandStore]:
+        return list(self.stores)

@@ -1,0 +1,518 @@
+"""All protocol state transitions, as pure-ish functions over SafeCommandStore.
+
+Rebuild of ref: accord-core/src/main/java/accord/local/Commands.java:98-1192 —
+preaccept/accept/commit/precommit/apply/commitInvalidate (:131-527), the
+execution drain maybeExecute (:656-733), initialiseWaitingOn/updateWaitingOn
+(:735-830), updateDependencyAndMaybeExecute (:832) and listener fan-out.
+
+The listener-DFS NotifyWaitingOn walker (:1011-1192) is replaced by (a) the
+same-store deferred listener queue (SafeCommandStore.complete) and (b) the
+batched device drain (accord_tpu.ops.drain) for the high-throughput path.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Tuple
+
+from ..primitives.deps import PartialDeps
+from ..primitives.keys import Ranges, Route
+from ..primitives.timestamp import Ballot, Timestamp, TxnId, TxnKind
+from ..primitives.txn import PartialTxn
+from ..primitives.writes import Writes
+from ..utils import invariants
+from .command import Command, WaitingOn
+from .command_store import PreLoadContext, SafeCommandStore
+from .commands_for_key import InternalStatus
+from .redundant import RedundantStatus
+from .status import Durability, SaveStatus, Status, save_status_for
+
+
+class AcceptOutcome(enum.IntEnum):
+    """(ref: Commands.AcceptOutcome)."""
+    Success = 0
+    Redundant = 1
+    RejectedBallot = 2
+    Insufficient = 3
+    Truncated = 4
+
+
+class CommitOutcome(enum.IntEnum):
+    Success = 0
+    Redundant = 1
+    Insufficient = 2
+    Rejected = 3
+
+
+class ApplyOutcome(enum.IntEnum):
+    Success = 0
+    Redundant = 1
+    Insufficient = 2
+
+
+# ---------------------------------------------------------------------------
+# PreAccept (ref: Commands.java:131-196)
+# ---------------------------------------------------------------------------
+
+def preaccept(safe: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
+              route: Route, progress_key: Optional[int],
+              permit_fast_path: bool = True
+              ) -> Tuple[AcceptOutcome, Optional[Timestamp]]:
+    cmd = safe.get(txn_id)
+    if cmd.has_been(Status.PreAccepted):
+        return AcceptOutcome.Redundant, cmd.execute_at
+    if cmd.promised != Ballot.ZERO:
+        return AcceptOutcome.RejectedBallot, None
+    if safe.redundant_before().status(txn_id, partial_txn.keys) in (
+            RedundantStatus.SHARD_REDUNDANT,):
+        return AcceptOutcome.Truncated, None
+
+    witnessed_at = _compute_witnessed_at(safe, txn_id, partial_txn, permit_fast_path)
+    safe.update_max_conflicts(partial_txn.keys, witnessed_at)
+
+    new_cmd = cmd.updated(
+        save_status=SaveStatus.PreAccepted,
+        route=route if cmd.route is None else cmd.route.with_(route),
+        progress_key=progress_key,
+        partial_txn=partial_txn if cmd.partial_txn is None
+        else cmd.partial_txn.with_partial(partial_txn),
+        execute_at=witnessed_at)
+    safe.update(new_cmd)
+    _register_txn(safe, txn_id, partial_txn, InternalStatus.PREACCEPTED)
+    safe.progress_log().pre_accepted(safe, txn_id)
+    return AcceptOutcome.Success, witnessed_at
+
+
+def _compute_witnessed_at(safe: SafeCommandStore, txn_id: TxnId,
+                          partial_txn: PartialTxn,
+                          permit_fast_path: bool) -> Timestamp:
+    """Propose the witnessed timestamp: the txn's own id if it still beats
+    every conflict (fast path), else a fresh unique timestamp above the
+    conflict floor (ref: CommandStore.preaccept logic)."""
+    if txn_id.kind().is_sync_point():
+        # sync points execute at their own id (ref: Txn.Kind.SyncPoint docs)
+        return txn_id
+    max_conflict = safe.max_conflict(partial_txn.keys)
+    node = safe.node()
+    if permit_fast_path and txn_id > max_conflict and txn_id.epoch() >= node.epoch():
+        return txn_id
+    return node.unique_now_at_least(max_conflict).with_epoch_at_least(txn_id.epoch())
+
+
+def _register_txn(safe: SafeCommandStore, txn_id: TxnId,
+                  partial_txn: PartialTxn, status: InternalStatus,
+                  execute_at: Optional[Timestamp] = None) -> None:
+    if not txn_id.kind().is_globally_visible():
+        return
+    keys = partial_txn.keys if partial_txn is not None else None
+    if keys is None:
+        return
+    if isinstance(keys, Ranges):
+        existing = safe.store.range_commands.get(txn_id)
+        safe.store.range_commands[txn_id] = (keys if existing is None
+                                             else existing.with_(keys))
+    else:
+        for key in keys:
+            safe.cfk(key.token()).update(txn_id, status, execute_at)
+
+
+def _update_cfk_status(safe: SafeCommandStore, cmd: Command,
+                       status: InternalStatus,
+                       execute_at: Optional[Timestamp] = None) -> None:
+    if not cmd.txn_id.kind().is_globally_visible():
+        return
+    if cmd.partial_txn is None:
+        return
+    keys = cmd.partial_txn.keys
+    if isinstance(keys, Ranges):
+        return  # range txns tracked via range_commands + command status
+    for key in keys:
+        safe.cfk(key.token()).update(cmd.txn_id, status, execute_at)
+
+
+# ---------------------------------------------------------------------------
+# Accept (ref: Commands.java:198-280)
+# ---------------------------------------------------------------------------
+
+def accept(safe: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
+           keys, progress_key: Optional[int], execute_at: Timestamp,
+           partial_deps: PartialDeps) -> Tuple[AcceptOutcome, Optional[Ballot]]:
+    cmd = safe.get(txn_id)
+    if cmd.has_been(Status.PreCommitted):
+        return AcceptOutcome.Redundant, None
+    if cmd.promised > ballot:
+        return AcceptOutcome.RejectedBallot, cmd.promised
+
+    new_status = (SaveStatus.AcceptedWithDefinition if cmd.is_defined()
+                  else SaveStatus.Accepted)
+    new_cmd = cmd.updated(
+        save_status=new_status,
+        route=route if cmd.route is None else cmd.route.with_(route),
+        progress_key=progress_key if cmd.progress_key is None else cmd.progress_key,
+        promised=ballot, accepted=ballot,
+        execute_at=execute_at,
+        partial_deps=partial_deps)
+    safe.update(new_cmd)
+    safe.update_max_conflicts(keys, execute_at)
+    _update_cfk_status(safe, new_cmd, InternalStatus.ACCEPTED)
+    safe.progress_log().accepted(safe, txn_id)
+    return AcceptOutcome.Success, None
+
+
+def accept_invalidate(safe: SafeCommandStore, txn_id: TxnId,
+                      ballot: Ballot) -> Tuple[AcceptOutcome, Optional[Ballot]]:
+    """(ref: Commands.acceptInvalidate)."""
+    cmd = safe.get(txn_id)
+    if cmd.has_been(Status.PreCommitted):
+        return AcceptOutcome.Redundant, None
+    if cmd.promised > ballot:
+        return AcceptOutcome.RejectedBallot, cmd.promised
+    new_status = (SaveStatus.AcceptedInvalidateWithDefinition if cmd.is_defined()
+                  else SaveStatus.AcceptedInvalidate)
+    safe.update(cmd.updated(save_status=new_status, promised=ballot,
+                            accepted=ballot))
+    return AcceptOutcome.Success, None
+
+
+# ---------------------------------------------------------------------------
+# Commit / Stable (ref: Commands.java:306-462)
+# ---------------------------------------------------------------------------
+
+def commit(safe: SafeCommandStore, txn_id: TxnId, target_stable: bool,
+           ballot: Ballot, route: Route, partial_txn: Optional[PartialTxn],
+           execute_at: Timestamp, partial_deps: Optional[PartialDeps],
+           progress_key: Optional[int] = None) -> CommitOutcome:
+    cmd = safe.get(txn_id)
+    if cmd.has_been(Status.PreCommitted):
+        known_at = cmd.execute_at_if_known()
+        if known_at is not None and known_at != execute_at:
+            safe.agent().on_inconsistent_timestamp(cmd, known_at, execute_at)
+    if target_stable:
+        if cmd.is_stable() or cmd.is_invalidated() or cmd.is_truncated():
+            return CommitOutcome.Redundant
+    else:
+        if cmd.has_been(Status.Committed):
+            return CommitOutcome.Redundant
+    if cmd.promised > ballot:
+        return CommitOutcome.Rejected
+
+    merged_txn = cmd.partial_txn
+    if partial_txn is not None:
+        merged_txn = (partial_txn if merged_txn is None
+                      else merged_txn.with_partial(partial_txn))
+    if merged_txn is None:
+        return CommitOutcome.Insufficient
+    if partial_deps is None and cmd.partial_deps is None:
+        return CommitOutcome.Insufficient
+    deps = partial_deps if partial_deps is not None else cmd.partial_deps
+
+    new_cmd = cmd.updated(
+        save_status=SaveStatus.Committed,
+        route=route if cmd.route is None else cmd.route.with_(route),
+        progress_key=progress_key if cmd.progress_key is None else cmd.progress_key,
+        partial_txn=merged_txn,
+        execute_at=execute_at,
+        partial_deps=deps)
+    new_cmd = safe.update(new_cmd)
+    safe.update_max_conflicts(merged_txn.keys, execute_at)
+    _register_txn(safe, txn_id, merged_txn, InternalStatus.COMMITTED, execute_at)
+    safe.progress_log().precommitted(safe, txn_id)
+
+    if target_stable:
+        return stable(safe, txn_id)
+    return CommitOutcome.Success
+
+
+def stable(safe: SafeCommandStore, txn_id: TxnId) -> CommitOutcome:
+    """Commit -> Stable: freeze deps, build the WaitingOn frontier, try to
+    execute (ref: Commands.commit stable path + initialiseWaitingOn)."""
+    cmd = safe.get(txn_id)
+    if cmd.is_stable() or cmd.is_invalidated() or cmd.is_truncated():
+        return CommitOutcome.Redundant
+    invariants.check_state(cmd.has_been(Status.Committed),
+                           "stable before committed: %s", cmd)
+    waiting_on = initialise_waiting_on(safe, txn_id, cmd.execute_at,
+                                       cmd.partial_deps)
+    new_cmd = cmd.updated(save_status=SaveStatus.Stable, waiting_on=waiting_on)
+    safe.update(new_cmd)
+    _update_cfk_status(safe, new_cmd, InternalStatus.STABLE, new_cmd.execute_at)
+    safe.progress_log().stable(safe, txn_id)
+    maybe_execute(safe, txn_id)
+    return CommitOutcome.Success
+
+
+def precommit(safe: SafeCommandStore, txn_id: TxnId,
+              execute_at: Timestamp) -> CommitOutcome:
+    """(ref: Commands.precommit)."""
+    cmd = safe.get(txn_id)
+    if cmd.has_been(Status.PreCommitted):
+        known_at = cmd.execute_at_if_known()
+        if known_at is not None and known_at != execute_at:
+            safe.agent().on_inconsistent_timestamp(cmd, known_at, execute_at)
+        return CommitOutcome.Redundant
+    safe.update(cmd.updated(
+        save_status=save_status_for(Status.PreCommitted, cmd.known()),
+        execute_at=execute_at))
+    safe.progress_log().precommitted(safe, txn_id)
+    return CommitOutcome.Success
+
+
+def commit_invalidate(safe: SafeCommandStore, txn_id: TxnId) -> None:
+    """(ref: Commands.commitInvalidate)."""
+    cmd = safe.get(txn_id)
+    if cmd.has_been(Status.PreCommitted) and cmd.known().execute_at.is_decided_and_known_to_execute():
+        invariants.illegal_state("invalidating a pre-committed txn %s", txn_id)
+    if cmd.is_invalidated():
+        return
+    new_cmd = cmd.updated(save_status=SaveStatus.Invalidated,
+                          durability=Durability.UniversalOrInvalidated)
+    safe.update(new_cmd)
+    safe.notify_listeners(new_cmd)
+    _update_cfk_status(safe, new_cmd, InternalStatus.INVALIDATED)
+    safe.store.range_commands.pop(txn_id, None)
+    safe.progress_log().clear(txn_id)
+
+
+# ---------------------------------------------------------------------------
+# Apply (ref: Commands.java:464-527)
+# ---------------------------------------------------------------------------
+
+def apply(safe: SafeCommandStore, txn_id: TxnId, route: Route,
+          execute_at: Timestamp, partial_deps: Optional[PartialDeps],
+          partial_txn: Optional[PartialTxn], writes: Optional[Writes],
+          result) -> ApplyOutcome:
+    cmd = safe.get(txn_id)
+    if cmd.has_been(Status.PreApplied):
+        return ApplyOutcome.Redundant
+    if not cmd.has_been(Status.Committed):
+        outcome = commit(safe, txn_id, False, Ballot.MAX, route, partial_txn,
+                         execute_at, partial_deps)
+        if outcome is CommitOutcome.Insufficient:
+            return ApplyOutcome.Insufficient
+        cmd = safe.get(txn_id)
+    known_at = cmd.execute_at_if_known()
+    if known_at is not None and known_at != execute_at:
+        safe.agent().on_inconsistent_timestamp(cmd, known_at, execute_at)
+
+    waiting_on = cmd.waiting_on
+    if waiting_on is None:
+        waiting_on = initialise_waiting_on(safe, txn_id, execute_at,
+                                           cmd.partial_deps)
+    new_cmd = cmd.updated(save_status=SaveStatus.PreApplied,
+                          waiting_on=waiting_on, writes=writes, result=result)
+    safe.update(new_cmd)
+    safe.progress_log().executed(safe, txn_id)
+    maybe_execute(safe, txn_id)
+    return ApplyOutcome.Success
+
+
+# ---------------------------------------------------------------------------
+# WaitingOn construction + the execution drain
+# (ref: Commands.java:656-857)
+# ---------------------------------------------------------------------------
+
+def initialise_waiting_on(safe: SafeCommandStore, txn_id: TxnId,
+                          execute_at: Timestamp,
+                          partial_deps: Optional[PartialDeps]) -> WaitingOn:
+    """Build the execution frontier from the stable deps: one bit per dep we
+    own locally; bits already satisfiable are cleared inline
+    (ref: Commands.initialiseWaitingOn :735-830)."""
+    if partial_deps is None:
+        return WaitingOn.none()
+    owned = safe.ranges(execute_at.epoch()).with_(safe.ranges(txn_id.epoch()))
+    dep_ids: List[TxnId] = []
+    seen = set()
+    for token in partial_deps.key_deps.keys:
+        if owned.contains_token(token):
+            for d in partial_deps.key_deps.txn_ids_for(token):
+                if d not in seen and d != txn_id:
+                    seen.add(d)
+                    dep_ids.append(d)
+    for rng in partial_deps.range_deps.ranges:
+        if owned.intersects(Ranges.of(rng)):
+            for d in partial_deps.range_deps.intersecting_range(rng):
+                if d not in seen and d != txn_id:
+                    seen.add(d)
+                    dep_ids.append(d)
+    dep_ids.sort()
+
+    waiting_on = WaitingOn.all_of(dep_ids)
+    for d in dep_ids:
+        waiting_on = _maybe_clear_dep(safe, txn_id, execute_at, waiting_on, d)
+    return waiting_on
+
+
+def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
+                     execute_at: Timestamp, waiting_on: WaitingOn,
+                     dep: TxnId) -> WaitingOn:
+    dep_cmd = safe.if_present(dep)
+    if safe.redundant_before().status(dep, _dep_participants(safe, dep)) in (
+            RedundantStatus.SHARD_REDUNDANT, RedundantStatus.PRE_BOOTSTRAP_OR_STALE):
+        return waiting_on.with_done(dep, True)
+    if dep_cmd is None:
+        # not yet witnessed locally: register a placeholder that will notify us
+        placeholder = Command(dep).with_listener(txn_id)
+        safe.update(placeholder, notify=False)
+        _witness_transitively(safe, dep)
+        return waiting_on
+    if dep_cmd.is_invalidated() or dep_cmd.is_truncated() or dep_cmd.save_status is SaveStatus.Applied:
+        return waiting_on.with_done(dep, True)
+    dep_execute_at = dep_cmd.execute_at_if_known()
+    if dep_execute_at is not None and dep_execute_at > execute_at:
+        # executes after us: not our dependency (ref: updateWaitingOn)
+        return waiting_on.with_done(dep, False)
+    safe.update(dep_cmd.with_listener(txn_id), notify=False)
+    return waiting_on
+
+
+def _witness_transitively(safe: SafeCommandStore, dep: TxnId) -> None:
+    safe.progress_log().waiting(dep, 0, None, None)
+
+
+def _dep_participants(safe: SafeCommandStore, dep: TxnId):
+    cmd = safe.if_present(dep)
+    if cmd is not None and cmd.route is not None:
+        return cmd.route.participants
+    return Ranges.empty()
+
+
+def maybe_execute(safe: SafeCommandStore, txn_id: TxnId,
+                  always_notify: bool = False) -> bool:
+    """The executeAt-gated drain step for one txn
+    (ref: Commands.maybeExecute :656-733)."""
+    cmd = safe.get(txn_id)
+    if cmd.save_status not in (SaveStatus.Stable, SaveStatus.PreApplied):
+        if always_notify:
+            safe.notify_listeners(cmd)
+        return False
+    if cmd.is_waiting():
+        if always_notify:
+            safe.notify_listeners(cmd)
+        return False
+
+    if cmd.save_status is SaveStatus.Stable:
+        new_cmd = cmd.updated(save_status=SaveStatus.ReadyToExecute)
+        safe.update(new_cmd)
+        safe.notify_listeners(new_cmd)
+        safe.notify_transient(new_cmd)
+        safe.progress_log().ready_to_execute(safe, txn_id)
+        return True
+
+    # PreApplied: perform the writes then mark Applied.  Transient listeners
+    # (pending reads) are notified synchronously BEFORE the writes apply so
+    # they observe the pre-apply store state (the read gate contract in
+    # messages/read_data.read_on_store).
+    new_cmd = cmd.updated(save_status=SaveStatus.Applying)
+    safe.update(new_cmd, notify=False)
+    safe.notify_transient(new_cmd)
+    _apply_writes(safe, new_cmd)
+    return True
+
+
+def _apply_writes(safe: SafeCommandStore, cmd: Command) -> None:
+    store = safe.store
+    owned = safe.ranges(cmd.execute_at.epoch())
+
+    def on_done(_result, failure):
+        if failure is not None:
+            store.node.agent.on_uncaught_exception(failure)
+            return
+        store.execute(PreLoadContext.for_txn(cmd.txn_id),
+                      lambda s: post_apply(s, cmd.txn_id))
+
+    if cmd.writes is not None and not cmd.writes.is_empty():
+        cmd.writes.apply_to(store.node.data_store, owned).begin(on_done)
+    else:
+        on_done(None, None)
+
+
+def post_apply(safe: SafeCommandStore, txn_id: TxnId) -> None:
+    """(ref: Commands.postApply :565-648)."""
+    cmd = safe.get(txn_id)
+    if cmd.save_status is not SaveStatus.Applying:
+        return
+    new_cmd = cmd.updated(save_status=SaveStatus.Applied)
+    safe.update(new_cmd)
+    _update_cfk_status(safe, new_cmd, InternalStatus.APPLIED, new_cmd.execute_at)
+    safe.notify_listeners(new_cmd)
+    safe.notify_transient(new_cmd)
+    safe.progress_log().durable_local(safe, txn_id)
+
+
+# ---------------------------------------------------------------------------
+# Listener fan-out (ref: Commands.java listenerUpdate + :776-857)
+# ---------------------------------------------------------------------------
+
+def listener_update(safe: SafeCommandStore, listener_id: TxnId,
+                    updated_id: TxnId) -> None:
+    listener = safe.if_present(listener_id)
+    if listener is None or listener.waiting_on is None:
+        return
+    if listener.save_status not in (SaveStatus.Stable, SaveStatus.PreApplied):
+        return
+    dep = safe.if_present(updated_id)
+    if dep is None:
+        return
+    update_dependency_and_maybe_execute(safe, listener, dep)
+
+
+def update_dependency_and_maybe_execute(safe: SafeCommandStore,
+                                        listener: Command,
+                                        dep: Command) -> None:
+    """(ref: Commands.updateDependencyAndMaybeExecute :832)."""
+    if not listener.waiting_on.is_waiting_on(dep.txn_id):
+        return
+    new_waiting = listener.waiting_on
+    remove_listener = False
+    if dep.save_status is SaveStatus.Applied or dep.is_invalidated() or dep.is_truncated():
+        new_waiting = new_waiting.with_done(dep.txn_id, True)
+        remove_listener = True
+    else:
+        dep_execute_at = dep.execute_at_if_known()
+        if (dep_execute_at is not None and listener.execute_at is not None
+                and dep_execute_at > listener.execute_at):
+            new_waiting = new_waiting.with_done(dep.txn_id, False)
+            remove_listener = True
+    if new_waiting is listener.waiting_on:
+        return
+    updated = listener.updated(waiting_on=new_waiting)
+    safe.update(updated, notify=False)
+    if remove_listener:
+        safe.update(dep.without_listener(listener.txn_id), notify=False)
+    maybe_execute(safe, listener.txn_id)
+
+
+# ---------------------------------------------------------------------------
+# Durability + truncation entry points (ref: Commands.java:879-975)
+# ---------------------------------------------------------------------------
+
+def set_durability(safe: SafeCommandStore, txn_id: TxnId,
+                   durability: Durability) -> None:
+    cmd = safe.get(txn_id)
+    if durability <= cmd.durability:
+        return
+    safe.update(cmd.updated(durability=cmd.durability.merge(durability)),
+                notify=False)
+    if durability.is_durable():
+        safe.progress_log().durable(safe, txn_id)
+
+
+def set_truncated_apply(safe: SafeCommandStore, txn_id: TxnId) -> None:
+    cmd = safe.get(txn_id)
+    if cmd.is_truncated():
+        return
+    new_cmd = cmd.updated(save_status=SaveStatus.TruncatedApply,
+                          partial_txn=None, partial_deps=None,
+                          waiting_on=None, writes=None, result=None)
+    safe.update(new_cmd)
+    safe.notify_listeners(new_cmd)
+
+
+def set_erased(safe: SafeCommandStore, txn_id: TxnId) -> None:
+    cmd = safe.get(txn_id)
+    new_cmd = cmd.updated(save_status=SaveStatus.Erased,
+                          partial_txn=None, partial_deps=None,
+                          waiting_on=None, writes=None, result=None,
+                          route=None)
+    safe.update(new_cmd)
+    safe.notify_listeners(new_cmd)

@@ -1,0 +1,170 @@
+"""Per-key conflict index — the PreAccept hot structure.
+
+Rebuild of ref: accord-core/src/main/java/accord/local/CommandsForKey.java:132
+(TxnInfo ladder :293-410, mapReduceActive :614-650, mapReduceFull :553-612).
+
+This is the host (correctness) implementation: a sorted vector of TxnInfo per
+key with the scan API.  The batched device analogue — the same scan as a
+masked searchsorted/prefix kernel over the CSR key->txn adjacency, vmapped
+over keys and in-flight txns — lives in accord_tpu.ops.deps_kernels and is
+validated against this implementation.
+
+The reference additionally compresses deps via ``missing[]`` arrays and
+transitive-dependency elision against maxAppliedWrite (CommandsForKey.java:73-131).
+Here we keep the full (uncompressed, always-correct) dep set host-side and
+apply pruning only through RedundantBefore watermarks; compression is a
+device-format concern.
+"""
+
+from __future__ import annotations
+
+import bisect
+import enum
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..primitives.timestamp import Kinds, Timestamp, TxnId
+from ..utils import invariants
+
+
+class InternalStatus(enum.IntEnum):
+    """Compressed per-key view of a txn's protocol state
+    (ref: CommandsForKey.java InternalStatus)."""
+    TRANSITIVELY_KNOWN = 0   # witnessed only via another txn's deps
+    PREACCEPTED = 1
+    ACCEPTED = 2
+    COMMITTED = 3            # executeAt decided
+    STABLE = 4
+    APPLIED = 5
+    INVALIDATED = 6
+
+    def has_execute_at(self) -> bool:
+        return InternalStatus.COMMITTED <= self <= InternalStatus.APPLIED
+
+
+class TxnInfo:
+    """(ref: CommandsForKey.java:293-410) — TxnId + per-key status +
+    executeAt."""
+
+    __slots__ = ("txn_id", "status", "execute_at")
+
+    def __init__(self, txn_id: TxnId, status: InternalStatus,
+                 execute_at: Optional[Timestamp] = None):
+        self.txn_id = txn_id
+        self.status = status
+        self.execute_at = execute_at if execute_at is not None else txn_id
+
+    def __repr__(self):
+        return f"TxnInfo({self.txn_id}, {self.status.name})"
+
+
+class CommandsForKey:
+    """All (globally visible) transactions witnessed on one key, ordered by
+    TxnId, with a parallel executeAt-ordered view of committed txns."""
+
+    __slots__ = ("token", "_ids", "_infos", "prune_before")
+
+    def __init__(self, token: int):
+        self.token = token
+        self._ids: List[TxnId] = []        # sorted
+        self._infos: Dict[TxnId, TxnInfo] = {}
+        # txns with txnId < prune_before are redundant (covered by
+        # RedundantBefore) and excluded from deps
+        self.prune_before: Optional[TxnId] = None
+
+    # -- update path --------------------------------------------------------
+    def update(self, txn_id: TxnId, status: InternalStatus,
+               execute_at: Optional[Timestamp] = None) -> None:
+        """Witness or advance a txn on this key
+        (ref: CommandsForKey insert/update :652+)."""
+        if not txn_id.kind().is_globally_visible():
+            return
+        info = self._infos.get(txn_id)
+        if info is None:
+            self._infos[txn_id] = TxnInfo(txn_id, status, execute_at)
+            bisect.insort(self._ids, txn_id)
+        else:
+            # never regress
+            if status < info.status and not (
+                    status == InternalStatus.INVALIDATED):
+                return
+            info.status = max(info.status, status)
+            if execute_at is not None and status.has_execute_at():
+                info.execute_at = execute_at
+
+    def witness_transitive(self, txn_id: TxnId) -> None:
+        if txn_id not in self._infos:
+            self.update(txn_id, InternalStatus.TRANSITIVELY_KNOWN)
+
+    def remove(self, txn_id: TxnId) -> None:
+        if txn_id in self._infos:
+            del self._infos[txn_id]
+            i = bisect.bisect_left(self._ids, txn_id)
+            if i < len(self._ids) and self._ids[i] == txn_id:
+                del self._ids[i]
+
+    def set_prune_before(self, txn_id: TxnId) -> None:
+        if self.prune_before is None or txn_id > self.prune_before:
+            self.prune_before = txn_id
+
+    # -- scan API -----------------------------------------------------------
+    def map_reduce_active(self, started_before: Timestamp, witnesses: Kinds,
+                          fn: Callable[[TxnId, "object"], "object"], acc):
+        """Fold over active txns with txnId < started_before whose kind the
+        querying txn must witness (ref: CommandsForKey.java:614-650).
+        Skips invalidated txns and anything below the prune watermark."""
+        hi = bisect.bisect_left(self._ids, started_before)
+        lo = 0
+        if self.prune_before is not None:
+            lo = bisect.bisect_left(self._ids, self.prune_before)
+        for i in range(lo, hi):
+            tid = self._ids[i]
+            info = self._infos[tid]
+            if info.status is InternalStatus.INVALIDATED:
+                continue
+            if not witnesses.test(tid.kind()):
+                continue
+            acc = fn(tid, acc)
+        return acc
+
+    def map_reduce_full(self, test_txn_id: TxnId, witnesses: Kinds,
+                        fn: Callable[[TxnInfo, "object"], "object"], acc):
+        """Fold over ALL txns (any bound, any status) for recovery queries
+        (ref: CommandsForKey.java:553-612)."""
+        for tid in self._ids:
+            info = self._infos[tid]
+            if not witnesses.test(tid.kind()):
+                continue
+            acc = fn(info, acc)
+        return acc
+
+    # -- queries ------------------------------------------------------------
+    def get(self, txn_id: TxnId) -> Optional[TxnInfo]:
+        return self._infos.get(txn_id)
+
+    def size(self) -> int:
+        return len(self._ids)
+
+    def txn_ids(self) -> List[TxnId]:
+        return list(self._ids)
+
+    def max_committed_execute_at(self) -> Optional[Timestamp]:
+        best: Optional[Timestamp] = None
+        for info in self._infos.values():
+            if info.status.has_execute_at() or info.status is InternalStatus.APPLIED:
+                if best is None or info.execute_at > best:
+                    best = info.execute_at
+        return best
+
+    def max_applied_before(self, bound: Timestamp) -> Optional[Timestamp]:
+        best: Optional[Timestamp] = None
+        for info in self._infos.values():
+            if info.status is InternalStatus.APPLIED and info.execute_at < bound:
+                if best is None or info.execute_at > best:
+                    best = info.execute_at
+        return best
+
+    def last_witnessed(self) -> Optional[TxnId]:
+        return self._ids[-1] if self._ids else None
+
+    def __repr__(self):
+        return f"CommandsForKey({self.token}, n={len(self._ids)})"

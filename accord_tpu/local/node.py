@@ -1,0 +1,210 @@
+"""The per-process node facade.
+
+Rebuild of ref: accord-core/src/main/java/accord/local/Node.java:100-780 —
+owns the MessageSink, TopologyManager, CommandStores, the HLC
+(``unique_now`` CAS loop :341-366), the coordinate() entry point (:567-596),
+receive() dispatch (:715-736), epoch await (:296-329) and home/progress key
+selection (:598-673).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from .. import api
+from ..primitives.keys import Ranges, Route, RoutingKeys, Seekables
+from ..primitives.timestamp import Domain, Timestamp, TxnId, TxnKind
+from ..primitives.txn import Txn
+from ..topology.manager import TopologyManager
+from ..topology.topology import Topologies, Topology
+from ..utils import async_chain, invariants
+from .command_store import CommandStores, PreLoadContext
+
+
+class Node:
+    """(ref: local/Node.java)."""
+
+    def __init__(self, node_id: int,
+                 message_sink: api.MessageSink,
+                 config_service: api.ConfigurationService,
+                 scheduler: api.Scheduler,
+                 data_store: api.DataStore,
+                 agent: api.Agent,
+                 random,
+                 now_micros: Callable[[], int],
+                 progress_log_factory: Optional[Callable] = None,
+                 num_stores: int = 2,
+                 local_config: Optional[api.LocalConfig] = None):
+        self.node_id = node_id
+        self.message_sink = message_sink
+        self.config_service = config_service
+        self.scheduler = scheduler
+        self.data_store = data_store
+        self.agent = agent
+        self.random = random
+        self.now_micros = now_micros
+        self.local_config = local_config or api.LocalConfig()
+        self.progress_log_factory = (progress_log_factory
+                                     or (lambda store: api.NoOpProgressLog()))
+        self.topology_manager = TopologyManager(node_id)
+        self.command_stores = CommandStores(self, num_stores)
+        self._hlc = 0
+        self._coordinating: Dict[TxnId, object] = {}  # active coordinations
+
+    # -- time (ref: Node.java:341-366) --------------------------------------
+    def unique_now(self) -> Timestamp:
+        now = self.now_micros()
+        self._hlc = max(self._hlc + 1, now)
+        return Timestamp.from_values(self.epoch(), self._hlc, self.node_id)
+
+    def unique_now_at_least(self, at_least: Timestamp) -> Timestamp:
+        now = self.now_micros()
+        self._hlc = max(self._hlc + 1, now, at_least.hlc() + 1)
+        epoch = max(self.epoch(), at_least.epoch())
+        return Timestamp.from_values(epoch, self._hlc, self.node_id)
+
+    def now(self) -> Timestamp:
+        return Timestamp.from_values(self.epoch(), self.now_micros(), self.node_id)
+
+    def next_txn_id(self, kind: TxnKind, domain: Domain) -> TxnId:
+        ts = self.unique_now()
+        return TxnId.create(ts.epoch(), ts.hlc(), kind, domain, self.node_id)
+
+    # -- topology -----------------------------------------------------------
+    def epoch(self) -> int:
+        return self.topology_manager.epoch()
+
+    def topology(self) -> TopologyManager:
+        return self.topology_manager
+
+    def on_topology_update(self, topology: Topology) -> None:
+        """(ref: Node.java:247 ConfigurationService.Listener)."""
+        if self.topology_manager.has_epoch(topology.epoch):
+            return
+        self.topology_manager.on_topology_update(topology)
+        self.command_stores.update_topology(topology)
+
+    def with_epoch(self, epoch: int, fn: Callable[[], None]) -> None:
+        """Run fn once the epoch's topology is known (ref: Node.java:296-329)."""
+        if self.topology_manager.has_epoch(epoch):
+            fn()
+            return
+        self.config_service.fetch_topology_for_epoch(epoch)
+        self.topology_manager.await_epoch(epoch).begin(
+            lambda _t, fail: fn() if fail is None else
+            self.agent.on_uncaught_exception(fail))
+
+    # -- routing (ref: Node.java:598-673) -----------------------------------
+    def compute_route(self, txn_id: TxnId, keys: Seekables) -> Route:
+        home_key = self.select_home_key(txn_id, keys)
+        return Route.full(home_key, keys.to_unseekables())
+
+    def select_home_key(self, txn_id: TxnId, keys: Seekables) -> int:
+        """Pick a home key among the txn's keys, preferring one this node
+        owns (ref: Node.selectHomeKey)."""
+        topology = self.topology_manager.current()
+        owned = topology.ranges_for_node(self.node_id)
+        if isinstance(keys, Ranges):
+            for r in keys:
+                if owned.contains_token(r.start):
+                    return r.start
+            return keys[0].start
+        for k in keys:
+            if owned.contains_token(k.token()):
+                return k.token()
+        return keys[0].token()
+
+    def select_progress_key(self, txn_id: TxnId, route: Route) -> Optional[int]:
+        """The home key if we replicate it, else None (ref: Node.java:652-673)."""
+        topology = self.topology_manager.current()
+        owned = topology.ranges_for_node(self.node_id)
+        return route.home_key if owned.contains_token(route.home_key) else None
+
+    def is_home_shard_replica(self, txn_id: TxnId, route: Route) -> bool:
+        owned = self.topology_manager.current().ranges_for_node(self.node_id)
+        return owned.contains_token(route.home_key)
+
+    # -- messaging ----------------------------------------------------------
+    def send(self, to: int, request,
+             callback: Optional[api.Callback] = None) -> None:
+        if callback is not None:
+            self.message_sink.send_with_callback(to, request, callback)
+        else:
+            self.message_sink.send(to, request)
+
+    def send_to_all(self, nodes, request_factory,
+                    callback: Optional[api.Callback] = None) -> None:
+        for to in sorted(nodes):
+            self.send(to, request_factory(to), callback)
+
+    def reply(self, to: int, reply_context, reply) -> None:
+        self.message_sink.reply(to, reply_context, reply)
+
+    def receive(self, request, from_id: int, reply_context) -> None:
+        """(ref: Node.java:715-736)."""
+        wait_for = getattr(request, "wait_for_epoch", 0)
+        if wait_for > self.topology_manager.epoch():
+            self.config_service.fetch_topology_for_epoch(wait_for)
+            self.topology_manager.await_epoch(wait_for).begin(
+                lambda _t, fail: self.receive(request, from_id, reply_context)
+                if fail is None else None)
+            return
+        self.scheduler.now(lambda: self._process(request, from_id, reply_context))
+
+    def _process(self, request, from_id: int, reply_context) -> None:
+        try:
+            request.process(self, from_id, reply_context)
+        except BaseException as e:  # noqa: BLE001
+            try:
+                self.message_sink.reply_with_unknown_failure(from_id, reply_context, e)
+            except BaseException:
+                pass
+            self.agent.on_handled_exception(e)
+
+    # -- local scatter-gather (ref: Node.java mapReduceConsumeLocal) --------
+    def map_reduce_consume_local(self, context: PreLoadContext, select,
+                                 min_epoch: int, max_epoch: int, map_fn,
+                                 reduce_fn, consume: Callable) -> None:
+        chain = self.command_stores.map_reduce(context, select, min_epoch,
+                                               max_epoch, map_fn, reduce_fn)
+        chain.begin(lambda result, fail: consume(result, fail))
+
+    def for_each_local(self, context: PreLoadContext, select, min_epoch: int,
+                       max_epoch: int, fn) -> async_chain.AsyncChain:
+        return self.command_stores.for_each(context, select, min_epoch,
+                                            max_epoch, fn)
+
+    # -- coordination entry (ref: Node.java:567-596) ------------------------
+    def coordinate(self, txn: Txn,
+                   txn_id: Optional[TxnId] = None) -> async_chain.AsyncResult:
+        from ..coordinate.coordinate_transaction import CoordinateTransaction
+        if txn_id is None:
+            txn_id = self.next_txn_id(txn.kind, txn.domain())
+        result = async_chain.AsyncResult()
+        self._coordinating[txn_id] = result
+        result.begin(lambda _r, _f: self._coordinating.pop(txn_id, None))
+
+        def start():
+            CoordinateTransaction.coordinate(self, txn_id, txn).begin(result.settle)
+
+        self.with_epoch(txn_id.epoch(), start)
+        return result
+
+    def recover(self, txn_id: TxnId, route: Route) -> async_chain.AsyncResult:
+        """(ref: Node.java:685-713)."""
+        from ..coordinate.recover import Recover
+        existing = self._coordinating.get(txn_id)
+        if existing is not None:
+            return existing
+        result = async_chain.AsyncResult()
+        self._coordinating[txn_id] = result
+        result.begin(lambda _r, _f: self._coordinating.pop(txn_id, None))
+
+        def start():
+            Recover.recover(self, txn_id, route).begin(result.settle)
+
+        self.with_epoch(txn_id.epoch(), start)
+        return result
+
+    def __repr__(self):
+        return f"Node({self.node_id})"

@@ -1,0 +1,191 @@
+"""Watermark maps: MaxConflicts, RedundantBefore, DurableBefore.
+
+Rebuild of ref: accord-core/src/main/java/accord/local/MaxConflicts.java:32,
+RedundantBefore.java:49, DurableBefore.java:39.  All three are range-keyed
+step functions (ReducingRangeMap) — sorted boundary arrays, which is also
+their device format for the deps floor in the PreAccept kernel.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from ..primitives.keys import Range, Ranges, RoutingKeys, Unseekables
+from ..primitives.timestamp import Timestamp, TxnId, max_timestamp
+from ..utils.interval_map import ReducingRangeMap
+
+
+class MaxConflicts:
+    """range -> max Timestamp witnessed; consulted to propose executeAt
+    (ref: local/MaxConflicts.java)."""
+
+    __slots__ = ("_map",)
+
+    def __init__(self):
+        self._map: ReducingRangeMap = ReducingRangeMap.empty()
+
+    def get_max(self, keys_or_ranges) -> Timestamp:
+        ranges = _as_ranges(keys_or_ranges)
+        out = self._map.fold_over_ranges(ranges, lambda v, acc: max_timestamp(acc, v), None)
+        return out if out is not None else Timestamp.NONE
+
+    def update(self, keys_or_ranges, ts: Timestamp) -> None:
+        ranges = _as_ranges(keys_or_ranges)
+        self._map = self._map.add(ranges, ts, lambda a, b: a if a >= b else b)
+
+
+class RedundantStatus(enum.IntEnum):
+    """(ref: local/RedundantStatus.java)."""
+    NOT_OWNED = 0
+    LIVE = 1
+    PARTIALLY_PRE_BOOTSTRAP_OR_STALE = 2
+    PRE_BOOTSTRAP_OR_STALE = 3
+    PARTIALLY_SHARD_REDUNDANT = 4
+    SHARD_REDUNDANT = 5
+
+
+class RedundantEntry:
+    """(ref: RedundantBefore.Entry)."""
+
+    __slots__ = ("redundant_before", "bootstrapped_at", "stale_until_at_least")
+
+    def __init__(self, redundant_before: TxnId = TxnId.NONE,
+                 bootstrapped_at: TxnId = TxnId.NONE,
+                 stale_until_at_least: Optional[Timestamp] = None):
+        self.redundant_before = redundant_before
+        self.bootstrapped_at = bootstrapped_at
+        self.stale_until_at_least = stale_until_at_least
+
+    def merge(self, other: "RedundantEntry") -> "RedundantEntry":
+        stale = self.stale_until_at_least
+        if other.stale_until_at_least is not None:
+            stale = max_timestamp(stale, other.stale_until_at_least)
+        return RedundantEntry(
+            max(self.redundant_before, other.redundant_before),
+            max(self.bootstrapped_at, other.bootstrapped_at),
+            stale)
+
+    def status_of(self, txn_id: TxnId) -> RedundantStatus:
+        if self.stale_until_at_least is not None or txn_id < self.bootstrapped_at:
+            return RedundantStatus.PRE_BOOTSTRAP_OR_STALE
+        if txn_id < self.redundant_before:
+            return RedundantStatus.SHARD_REDUNDANT
+        return RedundantStatus.LIVE
+
+    def __eq__(self, o):
+        return (isinstance(o, RedundantEntry)
+                and self.redundant_before == o.redundant_before
+                and self.bootstrapped_at == o.bootstrapped_at
+                and self.stale_until_at_least == o.stale_until_at_least)
+
+
+class RedundantBefore:
+    """Range-keyed redundancy watermarks (ref: local/RedundantBefore.java:49)."""
+
+    __slots__ = ("_map",)
+
+    def __init__(self):
+        self._map: ReducingRangeMap = ReducingRangeMap.empty()
+
+    def add_redundant(self, ranges: Ranges, redundant_before: TxnId) -> None:
+        self._merge(ranges, RedundantEntry(redundant_before=redundant_before))
+
+    def add_bootstrapped(self, ranges: Ranges, bootstrapped_at: TxnId) -> None:
+        self._merge(ranges, RedundantEntry(bootstrapped_at=bootstrapped_at))
+
+    def add_stale(self, ranges: Ranges, stale_until: Timestamp) -> None:
+        self._merge(ranges, RedundantEntry(stale_until_at_least=stale_until))
+
+    def _merge(self, ranges: Ranges, entry: RedundantEntry) -> None:
+        self._map = self._map.add(ranges, entry, lambda a, b: a.merge(b))
+
+    def status(self, txn_id: TxnId, participants) -> RedundantStatus:
+        ranges = _as_ranges(participants)
+        statuses = [e.status_of(txn_id) for e in self._map.values_intersecting(ranges)]
+        if not statuses:
+            return RedundantStatus.LIVE
+        if all(s is RedundantStatus.PRE_BOOTSTRAP_OR_STALE for s in statuses):
+            return RedundantStatus.PRE_BOOTSTRAP_OR_STALE
+        if any(s is RedundantStatus.PRE_BOOTSTRAP_OR_STALE for s in statuses):
+            return RedundantStatus.PARTIALLY_PRE_BOOTSTRAP_OR_STALE
+        if all(s is RedundantStatus.SHARD_REDUNDANT for s in statuses):
+            return RedundantStatus.SHARD_REDUNDANT
+        if any(s is RedundantStatus.SHARD_REDUNDANT for s in statuses):
+            return RedundantStatus.PARTIALLY_SHARD_REDUNDANT
+        return RedundantStatus.LIVE
+
+    def is_redundant(self, txn_id: TxnId, participants) -> bool:
+        return self.status(txn_id, participants) in (
+            RedundantStatus.SHARD_REDUNDANT, RedundantStatus.PRE_BOOTSTRAP_OR_STALE)
+
+    def min_redundant_before(self, token: int) -> TxnId:
+        e = self._map.get(token)
+        return e.redundant_before if e is not None else TxnId.NONE
+
+    def deps_floor(self, token: int) -> TxnId:
+        """The floor below which deps need not be collected for this key
+        (ref: RedundantBefore.collectDeps usage in PreAccept.java:245-264)."""
+        e = self._map.get(token)
+        if e is None:
+            return TxnId.NONE
+        return max(e.redundant_before, e.bootstrapped_at)
+
+
+class DurableBefore:
+    """Global durability watermarks per range: {majority, universal}
+    (ref: local/DurableBefore.java:39)."""
+
+    __slots__ = ("_map",)
+
+    class Entry:
+        __slots__ = ("majority_before", "universal_before")
+
+        def __init__(self, majority_before: TxnId = TxnId.NONE,
+                     universal_before: TxnId = TxnId.NONE):
+            self.majority_before = majority_before
+            self.universal_before = universal_before
+
+        def merge(self, other: "DurableBefore.Entry") -> "DurableBefore.Entry":
+            return DurableBefore.Entry(
+                max(self.majority_before, other.majority_before),
+                max(self.universal_before, other.universal_before))
+
+        def __eq__(self, o):
+            return (isinstance(o, DurableBefore.Entry)
+                    and self.majority_before == o.majority_before
+                    and self.universal_before == o.universal_before)
+
+    def __init__(self):
+        self._map: ReducingRangeMap = ReducingRangeMap.empty()
+
+    def add_majority(self, ranges: Ranges, before: TxnId) -> None:
+        self._map = self._map.add(ranges, DurableBefore.Entry(majority_before=before),
+                                  lambda a, b: a.merge(b))
+
+    def add_universal(self, ranges: Ranges, before: TxnId) -> None:
+        self._map = self._map.add(ranges, DurableBefore.Entry(universal_before=before),
+                                  lambda a, b: a.merge(b))
+
+    def is_majority_durable(self, txn_id: TxnId, token: int) -> bool:
+        e = self._map.get(token)
+        return e is not None and txn_id < e.majority_before
+
+    def is_universally_durable(self, txn_id: TxnId, token: int) -> bool:
+        e = self._map.get(token)
+        return e is not None and txn_id < e.universal_before
+
+    def min_majority_before(self, ranges: Ranges) -> TxnId:
+        entries = self._map.values_intersecting(ranges)
+        if not entries:
+            return TxnId.NONE
+        return min(e.majority_before for e in entries)
+
+
+def _as_ranges(keys_or_ranges) -> Ranges:
+    if isinstance(keys_or_ranges, Ranges):
+        return keys_or_ranges
+    if hasattr(keys_or_ranges, "to_ranges"):
+        return keys_or_ranges.to_ranges()
+    # Keys
+    return Ranges([Range(k.token(), k.token() + 1) for k in keys_or_ranges])

@@ -1,0 +1,89 @@
+"""Apply: deliver writes + result to replicas.
+
+Rebuild of ref: accord-core/src/main/java/accord/messages/Apply.java:47-200
+(Kind {Minimal, Maximal}; ApplyReply {Redundant/Applied/Insufficient}).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from ..local import commands
+from ..local.command_store import PreLoadContext, SafeCommandStore
+from ..primitives.keys import Route
+from ..primitives.timestamp import Timestamp, TxnId
+from ..primitives.txn import Txn
+from ..primitives.writes import Writes
+from .base import MessageType, Reply, TxnRequest
+
+
+class ApplyReplyKind(enum.IntEnum):
+    Applied = 0
+    Redundant = 1
+    Insufficient = 2
+
+
+class ApplyReply(Reply):
+    type = MessageType.APPLY_RSP
+
+    def __init__(self, kind: ApplyReplyKind):
+        self.kind = kind
+
+    def is_ok(self) -> bool:
+        return self.kind in (ApplyReplyKind.Applied, ApplyReplyKind.Redundant)
+
+    def __repr__(self):
+        return f"ApplyReply({self.kind.name})"
+
+
+class Apply(TxnRequest):
+    """(ref: messages/Apply.java).  kind='minimal' relies on the replica
+    already having txn+deps; 'maximal' carries them for stragglers."""
+
+    type = MessageType.APPLY_MINIMAL_REQ
+
+    def __init__(self, kind: str, txn_id: TxnId, route: Route,
+                 execute_at: Timestamp, deps, writes: Optional[Writes],
+                 result, txn: Optional[Txn] = None):
+        super().__init__(txn_id, route, execute_at.epoch())
+        self.kind = kind
+        self.execute_at = execute_at
+        self.deps = deps
+        self.writes = writes
+        self.result = result
+        self.txn = txn
+        if kind == "maximal":
+            self.type = MessageType.APPLY_MAXIMAL_REQ
+
+    def process(self, node, from_id: int, reply_context) -> None:
+        txn_id, route = self.txn_id, self.route
+        min_epoch, max_epoch = txn_id.epoch(), self.execute_at.epoch()
+
+        def map_fn(safe: SafeCommandStore):
+            owned = safe.store.ranges_for_epoch.all_between(min_epoch, max_epoch)
+            partial_txn = self.txn.slice(owned, False) if self.txn is not None else None
+            partial_deps = self.deps.slice(owned) if self.deps is not None else None
+            outcome = commands.apply(safe, txn_id, route, self.execute_at,
+                                     partial_deps, partial_txn, self.writes,
+                                     self.result)
+            return {commands.ApplyOutcome.Success: ApplyReplyKind.Applied,
+                    commands.ApplyOutcome.Redundant: ApplyReplyKind.Redundant,
+                    commands.ApplyOutcome.Insufficient: ApplyReplyKind.Insufficient,
+                    }[outcome]
+
+        def reduce_fn(a, b):
+            return max(a, b)
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(
+                    from_id, reply_context, failure)
+            else:
+                node.reply(from_id, reply_context,
+                           ApplyReply(result if result is not None
+                                      else ApplyReplyKind.Redundant))
+
+        node.map_reduce_consume_local(
+            PreLoadContext.for_txn(txn_id), route.participants,
+            min_epoch, max_epoch, map_fn, reduce_fn, consume)

@@ -1,0 +1,119 @@
+"""Message plumbing: Request/Reply bases, MessageType registry.
+
+Rebuild of ref: accord-core/src/main/java/accord/messages/TxnRequest.java:42-130,
+MessageType.java:34-116, Callback.java, Reply.java.
+
+Unlike the reference (which slices a per-destination ``scope`` on the
+coordinator to save bandwidth), requests here carry the full route and each
+replica slices to its owned ranges on receipt — same behaviour, simpler wire
+contract; the simulator and maelstrom adapter serialize these objects whole.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from ..primitives.keys import Route
+from ..primitives.timestamp import TxnId
+
+
+class MessageType(enum.Enum):
+    """Verb registry (ref: messages/MessageType.java:34-116).
+    has_side_effects drives journal persistence."""
+
+    SIMPLE_RSP = (False,)
+    FAILURE_RSP = (False,)
+    PRE_ACCEPT_REQ = (True,)
+    PRE_ACCEPT_RSP = (False,)
+    ACCEPT_REQ = (True,)
+    ACCEPT_RSP = (False,)
+    ACCEPT_INVALIDATE_REQ = (True,)
+    ACCEPT_INVALIDATE_RSP = (False,)
+    GET_DEPS_REQ = (False,)
+    GET_DEPS_RSP = (False,)
+    GET_EPHEMERAL_READ_DEPS_REQ = (False,)
+    GET_EPHEMERAL_READ_DEPS_RSP = (False,)
+    GET_MAX_CONFLICT_REQ = (False,)
+    GET_MAX_CONFLICT_RSP = (False,)
+    COMMIT_SLOW_PATH_REQ = (True,)
+    COMMIT_MAXIMAL_REQ = (True,)
+    STABLE_FAST_PATH_REQ = (True,)
+    STABLE_SLOW_PATH_REQ = (True,)
+    STABLE_MAXIMAL_REQ = (True,)
+    COMMIT_INVALIDATE_REQ = (True,)
+    APPLY_MINIMAL_REQ = (True,)
+    APPLY_MAXIMAL_REQ = (True,)
+    APPLY_RSP = (False,)
+    READ_REQ = (False,)
+    READ_EPHEMERAL_REQ = (False,)
+    READ_RSP = (False,)
+    BEGIN_RECOVER_REQ = (True,)
+    BEGIN_RECOVER_RSP = (False,)
+    BEGIN_INVALIDATE_REQ = (True,)
+    BEGIN_INVALIDATE_RSP = (False,)
+    WAIT_ON_COMMIT_REQ = (False,)
+    WAIT_ON_COMMIT_RSP = (False,)
+    WAIT_UNTIL_APPLIED_REQ = (False,)
+    APPLY_THEN_WAIT_UNTIL_APPLIED_REQ = (True,)
+    INFORM_OF_TXN_REQ = (True,)
+    INFORM_DURABLE_REQ = (True,)
+    INFORM_HOME_DURABLE_REQ = (True,)
+    CHECK_STATUS_REQ = (False,)
+    CHECK_STATUS_RSP = (False,)
+    FETCH_DATA_REQ = (False,)
+    FETCH_DATA_RSP = (False,)
+    SET_SHARD_DURABLE_REQ = (True,)
+    SET_GLOBALLY_DURABLE_REQ = (True,)
+    QUERY_DURABLE_BEFORE_REQ = (False,)
+    QUERY_DURABLE_BEFORE_RSP = (False,)
+    PROPAGATE_PRE_ACCEPT_MSG = (True,)
+    PROPAGATE_STABLE_MSG = (True,)
+    PROPAGATE_APPLY_MSG = (True,)
+    PROPAGATE_OTHER_MSG = (True,)
+
+    def __init__(self, has_side_effects: bool):
+        self.has_side_effects = has_side_effects
+
+
+class Request:
+    """Base request: processed on the replica (ref: messages/Request.java)."""
+
+    type: MessageType = MessageType.SIMPLE_RSP
+    wait_for_epoch: int = 0
+
+    def process(self, node, from_id: int, reply_context) -> None:
+        raise NotImplementedError
+
+
+class Reply:
+    """(ref: messages/Reply.java)."""
+
+    type: MessageType = MessageType.SIMPLE_RSP
+
+    def is_final(self) -> bool:
+        return True
+
+
+class FailureReply(Reply):
+    type = MessageType.FAILURE_RSP
+
+    def __init__(self, failure: BaseException):
+        self.failure = failure
+
+    def __repr__(self):
+        return f"FailureReply({self.failure!r})"
+
+
+class TxnRequest(Request):
+    """A request about one txn addressed to the replicas of its route
+    (ref: messages/TxnRequest.java).  wait_for_epoch gates processing until
+    the replica knows the epoch."""
+
+    def __init__(self, txn_id: TxnId, route: Route, wait_for_epoch: int):
+        self.txn_id = txn_id
+        self.route = route
+        self.wait_for_epoch = wait_for_epoch
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.txn_id})"

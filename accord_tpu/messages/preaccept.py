@@ -1,0 +1,124 @@
+"""PreAccept: witness a txn, propose witnessedAt, compute deps.
+
+Rebuild of ref: accord-core/src/main/java/accord/messages/PreAccept.java:37-335.
+The replica-side deps computation (calculate_partial_deps) is THE hot loop:
+per key it is CommandsForKey.map_reduce_active (host path) and, batched, the
+deps-scan kernel in accord_tpu.ops.deps_kernels (device path).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..local import commands
+from ..local.command_store import PreLoadContext, SafeCommandStore
+from ..primitives.deps import Deps, DepsBuilder, PartialDeps
+from ..primitives.keys import Range, Ranges, Route
+from ..primitives.timestamp import Timestamp, TxnId
+from ..primitives.txn import Txn
+from ..utils import invariants
+from .base import MessageType, Reply, TxnRequest
+
+
+def calculate_partial_deps(safe: SafeCommandStore, txn_id: TxnId, keys,
+                           started_before: Timestamp,
+                           covering: Ranges) -> PartialDeps:
+    """Scan this store's conflict indexes for dependencies of txn_id
+    (ref: PreAccept.calculatePartialDeps :245-265): all active txns with
+    lower id whose kind must be witnessed, floored by RedundantBefore."""
+    builder = DepsBuilder()
+    witnesses = txn_id.kind().witnesses()
+
+    def fold(key_or_ranges, dep_id: TxnId, acc):
+        if dep_id == txn_id:
+            return acc
+        if isinstance(key_or_ranges, int):
+            if dep_id >= safe.redundant_before().deps_floor(key_or_ranges):
+                acc.add_key(key_or_ranges, dep_id)
+        else:
+            for rng in key_or_ranges:
+                acc.add_range(rng, dep_id)
+        return acc
+
+    safe.map_reduce_active(keys, started_before, witnesses, fold, builder)
+    return builder.build_partial(covering)
+
+
+class PreAcceptOk(Reply):
+    type = MessageType.PRE_ACCEPT_RSP
+
+    def __init__(self, txn_id: TxnId, witnessed_at: Timestamp,
+                 deps: PartialDeps):
+        self.txn_id = txn_id
+        self.witnessed_at = witnessed_at
+        self.deps = deps
+
+    def is_ok(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"PreAcceptOk({self.txn_id}@{self.witnessed_at})"
+
+
+class PreAcceptNack(Reply):
+    type = MessageType.PRE_ACCEPT_RSP
+
+    def is_ok(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return "PreAcceptNack"
+
+
+class PreAccept(TxnRequest):
+    """(ref: messages/PreAccept.java)."""
+
+    type = MessageType.PRE_ACCEPT_REQ
+
+    def __init__(self, txn_id: TxnId, txn: Txn, route: Route, max_epoch: int):
+        super().__init__(txn_id, route, max_epoch)
+        self.txn = txn
+        self.max_epoch = max_epoch
+
+    def process(self, node, from_id: int, reply_context) -> None:
+        txn_id, txn, route = self.txn_id, self.txn, self.route
+        min_epoch = txn_id.epoch()
+
+        def map_fn(safe: SafeCommandStore):
+            owned = safe.store.ranges_for_epoch.all_between(min_epoch, self.max_epoch)
+            partial_txn = txn.slice(owned, route.home_key is not None)
+            progress_key = node.select_progress_key(txn_id, route)
+            outcome, witnessed_at = commands.preaccept(
+                safe, txn_id, partial_txn, route, progress_key)
+            if outcome is commands.AcceptOutcome.RejectedBallot:
+                return PreAcceptNack()
+            if outcome is commands.AcceptOutcome.Truncated:
+                return PreAcceptNack()
+            if outcome is commands.AcceptOutcome.Redundant:
+                cmd = safe.get(txn_id)
+                witnessed_at = cmd.execute_at
+            deps = calculate_partial_deps(safe, txn_id, partial_txn.keys,
+                                          txn_id, owned)
+            return PreAcceptOk(txn_id, witnessed_at, deps)
+
+        def reduce_fn(a, b):
+            """(ref: PreAccept.java:140-156): max-merge witnessedAt, union deps."""
+            if not a.is_ok():
+                return a
+            if not b.is_ok():
+                return b
+            witnessed = a.witnessed_at if a.witnessed_at >= b.witnessed_at else b.witnessed_at
+            return PreAcceptOk(txn_id, witnessed,
+                               a.deps.with_partial(b.deps))
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(from_id, reply_context, failure)
+            elif result is None:
+                node.reply(from_id, reply_context, PreAcceptNack())
+            else:
+                node.reply(from_id, reply_context, result)
+
+        node.map_reduce_consume_local(
+            PreLoadContext.for_txn(txn_id), route.participants,
+            min_epoch, self.max_epoch, map_fn, reduce_fn, consume)

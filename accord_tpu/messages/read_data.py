@@ -1,0 +1,154 @@
+"""Replica-side execution of reads: wait for ReadyToExecute, then read.
+
+Rebuild of ref: accord-core/src/main/java/accord/messages/ReadData.java:52-300,
+ReadTxnData.java.  A read registers a transient listener per store until the
+command's SaveStatus reaches ReadyToExecute (deps with lower executeAt all
+applied — the drain gate), then runs the SPI Read and merges Data across
+stores.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..local.command_store import PreLoadContext, SafeCommandStore
+from ..local.status import SaveStatus
+from ..primitives.keys import Ranges, Route
+from ..primitives.timestamp import Timestamp, TxnId
+from ..utils import async_chain
+from .base import MessageType, Reply, TxnRequest
+
+
+class ReadOk(Reply):
+    type = MessageType.READ_RSP
+
+    def __init__(self, data, unavailable: Optional[Ranges] = None):
+        self.data = data
+        self.unavailable = unavailable
+
+    def is_ok(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"ReadOk({self.data})"
+
+
+class ReadNack(Reply):
+    type = MessageType.READ_RSP
+
+    def __init__(self, reason: str = "NotCommitted"):
+        self.reason = reason
+
+    def is_ok(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return f"ReadNack({self.reason})"
+
+
+def merge_datas(datas) -> object:
+    """Merge per-store / per-replica Data payloads (None-tolerant)."""
+    result = None
+    for d in datas:
+        if d is None:
+            continue
+        result = d if result is None else result.merge(d)
+    return result
+
+
+class ReadRedundant(RuntimeError):
+    """The command already applied locally — its pre-state is gone; the
+    coordinator must use a different replica or the persisted outcome."""
+
+
+def read_on_store(safe: SafeCommandStore, txn_id: TxnId
+                  ) -> async_chain.AsyncChain:
+    """Wait (if needed) for txn_id to become ready on this store, then
+    perform its reads over this store's owned keys.  Returns chain of Data
+    or None (ref: ReadData waitUntil + beginRead :264).
+
+    The read gate: deps with lower executeAt must have applied
+    (ReadyToExecute, or PreApplied with an empty frontier), and our own
+    writes must NOT have applied yet.  maybe_execute notifies transient
+    listeners synchronously before applying writes, so a listener firing at
+    Applying still sees the pre-apply store state."""
+    out: async_chain.AsyncResult = async_chain.AsyncResult()
+
+    def try_read(s: SafeCommandStore, cmd, via_listener: bool) -> bool:
+        if cmd.is_invalidated() or cmd.is_truncated():
+            out.set_failure(ReadRedundant(f"read of invalidated/truncated {txn_id}"))
+            return True
+        st = cmd.save_status
+        if st is SaveStatus.ReadyToExecute or (
+                st is SaveStatus.PreApplied and not cmd.is_waiting()):
+            _begin_read(s, cmd, out)
+            return True
+        if st is SaveStatus.Applying:
+            if via_listener:
+                # synchronous pre-apply notification: state still clean
+                _begin_read(s, cmd, out)
+            else:
+                out.set_failure(ReadRedundant(f"{txn_id} already applying"))
+            return True
+        if st is SaveStatus.Applied:
+            out.set_failure(ReadRedundant(f"{txn_id} already applied"))
+            return True
+        return False
+
+    cmd = safe.get(txn_id)
+    if try_read(safe, cmd, via_listener=False):
+        return out
+
+    def listener(s: SafeCommandStore, updated) -> None:
+        if try_read(s, updated, via_listener=True):
+            s.remove_transient_listeners(txn_id)
+
+    safe.add_transient_listener(txn_id, listener)
+    return out
+
+
+def _begin_read(safe: SafeCommandStore, cmd,
+                out: async_chain.AsyncResult) -> None:
+    node = safe.store.node
+    partial_txn = cmd.partial_txn
+    if partial_txn is None or partial_txn.read is None:
+        out.set_success(None)
+        return
+    owned = safe.ranges(cmd.execute_at.epoch())
+    keys = partial_txn.read.keys().slice(owned)
+    chains = []
+    for key in keys:
+        chains.append(partial_txn.read.read(key, safe, cmd.execute_at,
+                                            node.data_store))
+    if not chains:
+        out.set_success(None)
+        return
+    async_chain.all_of(chains).map(merge_datas).begin(out.settle)
+
+
+class ReadTxnData(TxnRequest):
+    """Standalone read verb (ref: messages/ReadTxnData.java)."""
+
+    type = MessageType.READ_REQ
+
+    def __init__(self, txn_id: TxnId, route: Route, execute_at_epoch: int):
+        super().__init__(txn_id, route, execute_at_epoch)
+        self.execute_at_epoch = execute_at_epoch
+
+    def process(self, node, from_id: int, reply_context) -> None:
+        txn_id = self.txn_id
+        stores = node.command_stores.intersecting(
+            self.route.participants, txn_id.epoch(), self.execute_at_epoch)
+        if not stores:
+            node.reply(from_id, reply_context, ReadNack("NotOwned"))
+            return
+        chains = [s.execute(PreLoadContext.for_txn(txn_id),
+                            lambda safe: read_on_store(safe, txn_id))
+                  for s in stores]
+        # each store task returns a chain; flatten then merge data
+        async_chain.all_of(chains).flat_map(async_chain.all_of).map(merge_datas).begin(
+            lambda data, fail:
+            node.reply(from_id, reply_context,
+                       ReadNack("Redundant" if isinstance(fail, ReadRedundant)
+                                else "Failed") if fail is not None
+                       else ReadOk(data)))

@@ -1,0 +1,320 @@
+"""Deterministic in-process cluster: discrete-event simulation.
+
+Rebuild of ref: accord-core/src/test/java/accord/impl/basic/Cluster.java:102,
+NodeSink.java:46, RandomDelayQueue.java, PendingQueue.java.  One seeded
+RandomSource drives simulated time, per-link latency, delivery actions
+(DELIVER / DROP / DELIVER_WITH_FAILURE / FAILURE) and partitions — the whole
+distributed system is a pure function of (seed, workload).
+"""
+
+from __future__ import annotations
+
+import enum
+import heapq
+import itertools
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .. import api
+from ..local.node import Node
+from ..topology.topology import Topology
+from ..utils import async_chain
+from ..utils.random_source import RandomSource
+
+
+class Action(enum.Enum):
+    """(ref: impl/basic/NodeSink.java:46)."""
+    DELIVER = 0
+    DROP = 1
+    DELIVER_WITH_FAILURE = 2   # deliver, but report failure to the sender
+    FAILURE = 3                # don't deliver, report failure
+
+
+class PendingQueue:
+    """Simulated-time priority queue (ref: impl/basic/PendingQueue.java)."""
+
+    def __init__(self):
+        self._heap: List[Tuple[int, int, Callable[[], None]]] = []
+        self._seq = itertools.count()
+        self.now = 0
+
+    def add(self, at_micros: int, fn: Callable[[], None]) -> Tuple[int, int]:
+        entry = (max(at_micros, self.now), next(self._seq), fn)
+        heapq.heappush(self._heap, entry)
+        return entry[:2]
+
+    def pop(self) -> Optional[Callable[[], None]]:
+        while self._heap:
+            at, seq, fn = heapq.heappop(self._heap)
+            if fn is None:
+                continue
+            self.now = max(self.now, at)
+            return fn
+        return None
+
+    def is_empty(self) -> bool:
+        return not any(fn is not None for _, _, fn in self._heap)
+
+
+class _Scheduled(api.Scheduled):
+    def __init__(self):
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def is_cancelled(self) -> bool:
+        return self.cancelled
+
+
+class SimScheduler(api.Scheduler):
+    """(ref: the simulated Scheduler in impl/basic)."""
+
+    def __init__(self, queue: PendingQueue):
+        self.queue = queue
+
+    def now(self, run: Callable[[], None]) -> None:
+        self.queue.add(self.queue.now, run)
+
+    def once(self, delay_micros: int, run: Callable[[], None]) -> api.Scheduled:
+        handle = _Scheduled()
+
+        def fire():
+            if not handle.cancelled:
+                run()
+        self.queue.add(self.queue.now + delay_micros, fire)
+        return handle
+
+    def recurring(self, interval_micros: int, run: Callable[[], None]) -> api.Scheduled:
+        handle = _Scheduled()
+
+        def fire():
+            if handle.cancelled:
+                return
+            run()
+            self.queue.add(self.queue.now + interval_micros, fire)
+        self.queue.add(self.queue.now + interval_micros, fire)
+        return handle
+
+
+class _ReplyContext:
+    __slots__ = ("reply_to", "callback_id")
+
+    def __init__(self, reply_to: int, callback_id: int):
+        self.reply_to = reply_to
+        self.callback_id = callback_id
+
+
+class NodeSink(api.MessageSink):
+    """Simulated network out for one node (ref: impl/basic/NodeSink.java)."""
+
+    def __init__(self, node_id: int, cluster: "Cluster"):
+        self.node_id = node_id
+        self.cluster = cluster
+        self._callbacks: Dict[int, api.Callback] = {}
+        self._callback_seq = itertools.count(1)
+
+    def send(self, to: int, request) -> None:
+        self.cluster.route_request(self.node_id, to, request, callback_id=0)
+
+    def send_with_callback(self, to: int, request, callback: api.Callback) -> None:
+        cid = next(self._callback_seq)
+        self._callbacks[cid] = callback
+        self.cluster.route_request(self.node_id, to, request, callback_id=cid)
+        timeout = self.cluster.request_timeout_micros
+
+        def on_timeout():
+            cb = self._callbacks.pop(cid, None)
+            if cb is not None:
+                from ..coordinate.errors import Timeout as TimeoutError_
+                self.cluster.schedule_at_node(
+                    self.node_id,
+                    lambda: cb.on_failure(to, TimeoutError_(msg=f"timeout to {to}")))
+        self.cluster.queue.add(self.cluster.queue.now + timeout, on_timeout)
+
+    def reply(self, to: int, reply_context: _ReplyContext, reply) -> None:
+        self.cluster.route_reply(self.node_id, to, reply_context, reply)
+
+    # -- inbound (called by cluster on delivery) ----------------------------
+    def deliver_reply(self, from_id: int, reply_context: _ReplyContext, reply) -> None:
+        cid = reply_context.callback_id
+        cb = self._callbacks.get(cid)
+        if cb is None:
+            return
+        final = reply.is_final() if hasattr(reply, "is_final") else True
+        if final:
+            del self._callbacks[cid]
+        from ..messages.base import FailureReply
+        if isinstance(reply, FailureReply):
+            cb.on_failure(from_id, reply.failure)
+        else:
+            cb.on_success(from_id, reply)
+
+    def fail_callback(self, cid: int, from_id: int, failure: BaseException) -> None:
+        cb = self._callbacks.pop(cid, None)
+        if cb is not None:
+            cb.on_failure(from_id, failure)
+
+
+class SimConfigService(api.ConfigurationService):
+    """Static/epoch-list configuration service
+    (ref: maelstrom/SimpleConfigService.java + test MockConfigurationService)."""
+
+    def __init__(self, cluster: "Cluster", node_id: int):
+        self.cluster = cluster
+        self.node_id = node_id
+        self.listeners: List = []
+
+    def register_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    def current_topology(self) -> Topology:
+        return self.cluster.topologies[-1]
+
+    def get_topology_for_epoch(self, epoch: int) -> Optional[Topology]:
+        for t in self.cluster.topologies:
+            if t.epoch == epoch:
+                return t
+        return None
+
+    def fetch_topology_for_epoch(self, epoch: int) -> None:
+        t = self.get_topology_for_epoch(epoch)
+        if t is not None:
+            node = self.cluster.nodes[self.node_id]
+            self.cluster.schedule_at_node(
+                self.node_id, lambda: node.on_topology_update(t))
+
+    def acknowledge_epoch(self, epoch_ready, start_sync: bool = True) -> None:
+        # gossip "sync complete" to everyone (ref: onRemoteSyncComplete)
+        epoch = epoch_ready.epoch
+        for other in self.cluster.nodes.values():
+            self.cluster.schedule_at_node(
+                other.node_id,
+                lambda o=other: o.topology_manager.on_epoch_sync_complete(
+                    self.node_id, epoch))
+
+
+class SimAgent(api.Agent):
+    """(ref: test impl TestAgent)."""
+
+    def __init__(self, cluster: "Cluster"):
+        self.cluster = cluster
+
+    def on_uncaught_exception(self, failure: BaseException) -> None:
+        self.cluster.failures.append(failure)
+
+    def on_handled_exception(self, failure: BaseException) -> None:
+        pass
+
+    def on_inconsistent_timestamp(self, command, prev, next_ts) -> None:
+        self.cluster.failures.append(
+            AssertionError(f"inconsistent timestamp {prev} vs {next_ts} on {command}"))
+
+
+class Cluster:
+    """(ref: impl/basic/Cluster.java)."""
+
+    def __init__(self, node_ids: Optional[Sequence[int]] = None,
+                 topology: Topology = None,
+                 seed: int = 0, num_stores: int = 2,
+                 data_store_factory: Optional[Callable[[int], api.DataStore]] = None,
+                 progress_log_factory=None,
+                 mean_latency_micros: int = 1_000,
+                 request_timeout_micros: int = 1_000_000):
+        node_ids = list(node_ids if node_ids is not None else topology.nodes())
+        self.random = RandomSource(seed)
+        self.queue = PendingQueue()
+        self.topologies: List[Topology] = [topology] if topology else []
+        self.nodes: Dict[int, Node] = {}
+        self.sinks: Dict[int, NodeSink] = {}
+        self.failures: List[BaseException] = []
+        self.mean_latency_micros = mean_latency_micros
+        self.request_timeout_micros = request_timeout_micros
+        self.partitioned: Set[frozenset] = set()  # pairs that cannot talk
+        self.drop_probability = 0.0
+        self.stats: Dict[str, int] = {}
+
+        scheduler = SimScheduler(self.queue)
+        for nid in node_ids:
+            sink = NodeSink(nid, self)
+            self.sinks[nid] = sink
+            data_store = (data_store_factory(nid) if data_store_factory
+                          else _NullDataStore())
+            node = Node(
+                node_id=nid, message_sink=sink,
+                config_service=SimConfigService(self, nid),
+                scheduler=scheduler, data_store=data_store,
+                agent=SimAgent(self), random=self.random.fork(),
+                now_micros=lambda: self.queue.now,
+                progress_log_factory=progress_log_factory,
+                num_stores=num_stores)
+            self.nodes[nid] = node
+        if topology is not None:
+            for node in self.nodes.values():
+                node.on_topology_update(topology)
+
+    # -- network ------------------------------------------------------------
+    def _latency(self) -> int:
+        # uniform in [mean/2, 3*mean/2] (ref: RandomDelayQueue LatencySupplier)
+        m = self.mean_latency_micros
+        return m // 2 + self.random.next_int(m + 1)
+
+    def _action(self, src: int, dst: int) -> Action:
+        if src != dst:
+            if frozenset((src, dst)) in self.partitioned:
+                return Action.DROP
+            if self.drop_probability and self.random.decide(self.drop_probability):
+                return Action.DROP
+        return Action.DELIVER
+
+    def route_request(self, src: int, dst: int, request, callback_id: int) -> None:
+        self.stats[type(request).__name__] = self.stats.get(type(request).__name__, 0) + 1
+        action = self._action(src, dst)
+        if action is Action.DROP:
+            return
+        ctx = _ReplyContext(src, callback_id)
+        at = self.queue.now + (self._latency() if src != dst else 0)
+        self.queue.add(at, lambda: self.nodes[dst].receive(request, src, ctx))
+
+    def route_reply(self, src: int, dst: int, ctx: _ReplyContext, reply) -> None:
+        self.stats[type(reply).__name__] = self.stats.get(type(reply).__name__, 0) + 1
+        if self._action(src, dst) is Action.DROP:
+            return
+        at = self.queue.now + (self._latency() if src != dst else 0)
+        self.queue.add(at, lambda: self.sinks[dst].deliver_reply(src, ctx, reply))
+
+    def schedule_at_node(self, node_id: int, fn: Callable[[], None]) -> None:
+        self.queue.add(self.queue.now, fn)
+
+    # -- partitions / chaos -------------------------------------------------
+    def partition(self, a: int, b: int) -> None:
+        self.partitioned.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        self.partitioned.clear()
+
+    # -- run loop -----------------------------------------------------------
+    def run_until_quiescent(self, max_micros: int = 60_000_000) -> None:
+        deadline = self.queue.now + max_micros
+        while self.queue.now <= deadline:
+            fn = self.queue.pop()
+            if fn is None:
+                return
+            fn()
+
+    def run_for(self, micros: int) -> None:
+        deadline = self.queue.now + micros
+        while self._peek_time() is not None and self._peek_time() <= deadline:
+            fn = self.queue.pop()
+            if fn is None:
+                break
+            fn()
+        self.queue.now = max(self.queue.now, deadline)
+
+    def _peek_time(self) -> Optional[int]:
+        while self.queue._heap and self.queue._heap[0][2] is None:
+            heapq.heappop(self.queue._heap)
+        return self.queue._heap[0][0] if self.queue._heap else None
+
+
+class _NullDataStore(api.DataStore):
+    pass

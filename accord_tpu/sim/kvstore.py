@@ -1,0 +1,140 @@
+"""Simple versioned KV workload implementing the data-plane SPI.
+
+Modelled on the reference's list-append test store
+(ref: accord-core/src/test/java/accord/impl/list/ListStore.java,
+ListRead/ListUpdate/ListQuery, and maelstrom/MaelstromRead etc.): values are
+append-lists so the strict-serializability verifier can reconstruct order.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .. import api
+from ..primitives.keys import IntKey, Keys, Ranges
+from ..primitives.timestamp import Timestamp, TxnId, TxnKind, Domain
+from ..primitives.txn import Txn
+from ..utils import async_chain
+
+
+class KVDataStore(api.DataStore):
+    """Versioned store: token -> (list value, last-applied executeAt)."""
+
+    def __init__(self, node_id: int):
+        self.node_id = node_id
+        self.data: Dict[int, Tuple[tuple, Timestamp]] = {}
+
+    def get(self, token: int) -> tuple:
+        entry = self.data.get(token)
+        return entry[0] if entry is not None else ()
+
+    def apply_append(self, token: int, values: tuple,
+                     execute_at: Timestamp) -> None:
+        entry = self.data.get(token)
+        if entry is not None:
+            if entry[1] == execute_at:
+                return  # idempotent re-apply of the same txn
+            # out-of-order apply is a protocol violation — surface it loudly
+            # rather than silently dropping the write
+            assert entry[1] < execute_at, (
+                f"out-of-order apply on key {token}: applying {execute_at} "
+                f"after {entry[1]} (node {self.node_id})")
+        current = entry[0] if entry is not None else ()
+        self.data[token] = (current + values, execute_at)
+
+
+class KVData(api.Data):
+    """token -> list snapshot (ref: maelstrom/Data + list/ListData)."""
+
+    def __init__(self, values: Optional[Dict[int, tuple]] = None):
+        self.values: Dict[int, tuple] = dict(values or {})
+
+    def merge(self, other: "KVData") -> "KVData":
+        out = dict(self.values)
+        out.update(other.values)
+        return KVData(out)
+
+    def __repr__(self):
+        return f"KVData({self.values})"
+
+
+class KVRead(api.Read):
+    def __init__(self, keys: Keys):
+        self._keys = keys
+
+    def keys(self) -> Keys:
+        return self._keys
+
+    def read(self, key, safe_store, execute_at, store: KVDataStore):
+        return async_chain.success(KVData({key.token(): store.get(key.token())}))
+
+    def slice(self, ranges: Ranges) -> "KVRead":
+        return KVRead(self._keys.slice(ranges))
+
+    def merge(self, other: Optional["KVRead"]) -> "KVRead":
+        if other is None:
+            return self
+        return KVRead(self._keys.with_(other._keys))
+
+
+class KVWrite(api.Write):
+    def __init__(self, appends: Dict[int, tuple]):
+        self.appends = appends
+
+    def apply(self, key, txn_id: TxnId, execute_at, store: KVDataStore):
+        vals = self.appends.get(key.token())
+        if vals:
+            store.apply_append(key.token(), vals, execute_at)
+        return async_chain.success(None)
+
+
+class KVUpdate(api.Update):
+    """Blind append update (list-append workload)."""
+
+    def __init__(self, appends: Dict[int, tuple]):
+        self.appends = dict(appends)
+
+    def keys(self) -> Keys:
+        return Keys([IntKey(t) for t in self.appends])
+
+    def apply(self, execute_at, data) -> KVWrite:
+        return KVWrite(self.appends)
+
+    def slice(self, ranges: Ranges) -> "KVUpdate":
+        return KVUpdate({t: v for t, v in self.appends.items()
+                         if ranges.contains_token(t)})
+
+    def merge(self, other: Optional["KVUpdate"]) -> "KVUpdate":
+        if other is None:
+            return self
+        out = dict(self.appends)
+        out.update(other.appends)
+        return KVUpdate(out)
+
+
+class KVResult(api.Result):
+    def __init__(self, txn_id: TxnId, reads: Dict[int, tuple],
+                 appends: Dict[int, tuple]):
+        self.txn_id = txn_id
+        self.reads = reads
+        self.appends = appends
+
+    def __repr__(self):
+        return f"KVResult(reads={self.reads}, appends={self.appends})"
+
+
+class KVQuery(api.Query):
+    def compute(self, txn_id, execute_at, keys, data, read, update) -> KVResult:
+        reads = dict(data.values) if data is not None else {}
+        appends = update.appends if update is not None else {}
+        return KVResult(txn_id, reads, appends)
+
+
+def kv_txn(read_tokens: List[int], appends: Dict[int, tuple]) -> Txn:
+    """Build a read/append transaction over IntKeys."""
+    all_tokens = sorted(set(read_tokens) | set(appends))
+    keys = Keys([IntKey(t) for t in all_tokens])
+    kind = TxnKind.Write if appends else TxnKind.Read
+    read = KVRead(Keys([IntKey(t) for t in sorted(set(read_tokens))]))
+    update = KVUpdate(appends) if appends else None
+    return Txn(kind, keys, read, update, KVQuery())

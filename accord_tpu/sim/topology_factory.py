@@ -1,0 +1,31 @@
+"""Topology construction helpers for tests / maelstrom
+(ref: accord-maelstrom/src/main/java/accord/maelstrom/TopologyFactory.java:
+hash-space split into `shards` ranges x rf)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..primitives.keys import MAX_TOKEN, MIN_TOKEN, Range
+from ..topology.shard import Shard
+from ..topology.topology import Topology
+
+
+def build_topology(epoch: int, node_ids: Sequence[int], rf: int,
+                   num_shards: int,
+                   min_token: int = 0, max_token: int = 1_000_000,
+                   fast_path_all: bool = True) -> Topology:
+    """Split [min_token, max_token) into num_shards ranges, replicating each
+    on rf consecutive nodes (round-robin)."""
+    node_ids = sorted(node_ids)
+    n = len(node_ids)
+    assert rf <= n
+    span = max_token - min_token
+    shards: List[Shard] = []
+    for i in range(num_shards):
+        start = min_token + span * i // num_shards
+        end = min_token + span * (i + 1) // num_shards
+        replicas = [node_ids[(i + j) % n] for j in range(rf)]
+        electorate = frozenset(replicas) if fast_path_all else frozenset()
+        shards.append(Shard(Range(start, end), replicas, electorate))
+    return Topology(epoch, shards)

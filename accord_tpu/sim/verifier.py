@@ -1,0 +1,141 @@
+"""Strict-serializability verification of client-observed results.
+
+Rebuild of ref: accord-core/src/test/java/accord/verify/
+StrictSerializabilityVerifier.java:58 (adapted to the list-append workload):
+every client reply must be consistent with SOME total order of transactions
+that (a) respects per-key list-prefix semantics and (b) respects real time —
+if txn A completed before txn B began, A must not observe effects of B and B
+must observe at least A's effects on any key both touch.
+
+The list-append workload makes this checkable per key without graph search:
+each applied append is tagged uniquely, so a read of key k pins the exact
+prefix of appends it observed.  We check:
+  1. prefix consistency: every observed list is a prefix of the final list
+     (no lost, reordered, or phantom appends);
+  2. monotonic real time per key: if read R1 completed before R2 started,
+     R1's observed prefix must be <= R2's;
+  3. own-write visibility ordering: a txn that appended v must have its
+     append placed after the prefix it read.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import invariants
+
+
+class HistoryViolation(AssertionError):
+    pass
+
+
+class _Observation:
+    __slots__ = ("start", "end", "token", "prefix_len", "op_id")
+
+    def __init__(self, start: int, end: int, token: int, prefix_len: int,
+                 op_id: int):
+        self.start = start
+        self.end = end
+        self.token = token
+        self.prefix_len = prefix_len
+        self.op_id = op_id
+
+
+class StrictSerializabilityVerifier:
+    """Collects client operations and verifies on demand."""
+
+    def __init__(self):
+        self._next_op = 0
+        # per token: list of (observed prefix tuple, op)
+        self.reads: List[_Observation] = []
+        self.read_values: Dict[int, Dict[int, tuple]] = {}  # op_id -> token -> value
+        self.writes: Dict[int, Dict[int, tuple]] = {}       # op_id -> token -> appended
+        self.op_times: Dict[int, Tuple[int, int]] = {}
+        self.finals: Dict[int, tuple] = {}
+
+    def begin(self) -> int:
+        op = self._next_op
+        self._next_op += 1
+        return op
+
+    def on_result(self, op_id: int, start_micros: int, end_micros: int,
+                  reads: Dict[int, tuple], appends: Dict[int, tuple]) -> None:
+        self.op_times[op_id] = (start_micros, end_micros)
+        self.read_values[op_id] = dict(reads)
+        self.writes[op_id] = dict(appends)
+        for token, value in reads.items():
+            self.reads.append(_Observation(start_micros, end_micros, token,
+                                           len(value), op_id))
+
+    def set_final(self, token: int, value: tuple) -> None:
+        self.finals[token] = value
+
+    # -- checks -------------------------------------------------------------
+    def verify(self) -> None:
+        self._check_prefixes()
+        self._check_realtime()
+        self._check_own_writes()
+
+    def _check_prefixes(self) -> None:
+        """Every observed list must be a prefix of the final list; appended
+        values must appear exactly once in the final list."""
+        for op_id, reads in self.read_values.items():
+            for token, observed in reads.items():
+                final = self.finals.get(token)
+                if final is None:
+                    continue
+                if tuple(final[:len(observed)]) != tuple(observed):
+                    raise HistoryViolation(
+                        f"op {op_id} read {observed} on key {token}, not a "
+                        f"prefix of final {final}")
+        for token, final in self.finals.items():
+            seen = {}
+            for v in final:
+                if v in seen:
+                    raise HistoryViolation(
+                        f"duplicate append {v!r} on key {token}: {final}")
+                seen[v] = True
+
+    def _check_realtime(self) -> None:
+        """If op A ended before op B started, B must observe at least as long
+        a prefix on any key both read (per-key real-time monotonicity)."""
+        by_token: Dict[int, List[_Observation]] = {}
+        for obs in self.reads:
+            by_token.setdefault(obs.token, []).append(obs)
+        for token, obss in by_token.items():
+            obss.sort(key=lambda o: o.end)
+            max_completed_prefix = -1
+            completed: List[_Observation] = []
+            for obs in sorted(obss, key=lambda o: o.start):
+                # all observations that completed before obs started
+                floor = max((o.prefix_len for o in obss if o.end < obs.start),
+                            default=0)
+                if obs.prefix_len < floor:
+                    raise HistoryViolation(
+                        f"real-time violation on key {token}: op {obs.op_id} "
+                        f"(start {obs.start}) observed prefix {obs.prefix_len} "
+                        f"< {floor} observed by an earlier-completed op")
+
+    def _check_own_writes(self) -> None:
+        """A txn that read prefix P of key k and appended v must have v at
+        a position >= len(P) in the final order (its write follows its read
+        in the serial order)."""
+        for op_id, appends in self.writes.items():
+            reads = self.read_values.get(op_id, {})
+            for token, values in appends.items():
+                final = self.finals.get(token)
+                if final is None or not values:
+                    continue
+                for v in values:
+                    if v not in final:
+                        raise HistoryViolation(
+                            f"committed append {v!r} of op {op_id} missing "
+                            f"from final {final} on key {token}")
+                observed = reads.get(token)
+                if observed is not None:
+                    pos = final.index(values[0])
+                    if pos < len(observed):
+                        raise HistoryViolation(
+                            f"op {op_id} appended {values[0]!r} at position "
+                            f"{pos} but had read prefix of length "
+                            f"{len(observed)} on key {token}")

@@ -1,0 +1,106 @@
+"""First end-to-end slice: 3-node cluster, fast/slow path, execution drain.
+
+Modelled on the reference's mocked-cluster integration tier
+(ref: accord-core/src/test/java/accord/coordinate/CoordinateTransactionTest.java)."""
+
+import pytest
+
+from accord_tpu.sim.cluster import Cluster
+from accord_tpu.sim.kvstore import KVDataStore, KVResult, kv_txn
+from accord_tpu.sim.topology_factory import build_topology
+
+
+def make_cluster(seed=1, nodes=(1, 2, 3), rf=3, shards=4, **kw):
+    topology = build_topology(1, nodes, rf, shards)
+    return Cluster(topology=topology, seed=seed,
+                   data_store_factory=KVDataStore, **kw)
+
+
+def submit(cluster, node_id, txn):
+    """Submit and collect the (result, failure) pair."""
+    out = []
+    cluster.nodes[node_id].coordinate(txn).begin(lambda r, f: out.append((r, f)))
+    return out
+
+
+def test_single_write_txn_commits():
+    cluster = make_cluster()
+    out = submit(cluster, 1, kv_txn([10], {10: ("a",)}))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert len(out) == 1, "txn did not complete"
+    result, failure = out[0]
+    assert failure is None, f"txn failed: {failure}"
+    assert isinstance(result, KVResult)
+    assert result.reads == {10: ()}  # first txn reads empty
+
+
+def test_read_sees_prior_write():
+    cluster = make_cluster()
+    out1 = submit(cluster, 1, kv_txn([10], {10: ("a",)}))
+    cluster.run_until_quiescent()
+    out2 = submit(cluster, 2, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert out1[0][1] is None and out2[0][1] is None
+    assert out2[0][0].reads == {10: ("a",)}
+
+
+def test_sequential_appends_ordered():
+    cluster = make_cluster()
+    for i in range(5):
+        out = submit(cluster, 1 + (i % 3), kv_txn([7], {7: (f"v{i}",)}))
+        cluster.run_until_quiescent()
+        assert out[0][1] is None
+    out = submit(cluster, 1, kv_txn([7], {}))
+    cluster.run_until_quiescent()
+    assert out[0][0].reads == {7: ("v0", "v1", "v2", "v3", "v4")}
+    assert cluster.failures == []
+
+
+def test_concurrent_txns_all_commit():
+    cluster = make_cluster(seed=7)
+    outs = []
+    for i in range(10):
+        node = 1 + (i % 3)
+        outs.append(submit(cluster, node, kv_txn([5], {5: (f"n{node}.{i}",)})))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    for out in outs:
+        assert len(out) == 1 and out[0][1] is None, f"failed: {out}"
+    # all appends present exactly once
+    final = submit(cluster, 1, kv_txn([5], {}))
+    cluster.run_until_quiescent()
+    vals = final[0][0].reads[5]
+    assert len(vals) == 10
+    assert len(set(vals)) == 10
+
+
+def test_multi_key_cross_shard_txn():
+    cluster = make_cluster(seed=3)
+    # keys in different shards (shard size = 250k)
+    out = submit(cluster, 1, kv_txn([100, 300_000, 600_000],
+                                    {100: ("x",), 600_000: ("y",)}))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert out[0][1] is None
+    check = submit(cluster, 3, kv_txn([100, 300_000, 600_000], {}))
+    cluster.run_until_quiescent()
+    assert check[0][0].reads == {100: ("x",), 300_000: (), 600_000: ("y",)}
+
+
+def test_deterministic_same_seed():
+    def run(seed):
+        cluster = make_cluster(seed=seed)
+        outs = []
+        for i in range(6):
+            outs.append(submit(cluster, 1 + (i % 3), kv_txn([9], {9: (f"v{i}",)})))
+        cluster.run_until_quiescent()
+        final = submit(cluster, 1, kv_txn([9], {}))
+        cluster.run_until_quiescent()
+        return final[0][0].reads[9], dict(cluster.stats)
+
+    a1, s1 = run(42)
+    a2, s2 = run(42)
+    assert a1 == a2
+    assert s1 == s2

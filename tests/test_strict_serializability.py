@@ -1,0 +1,112 @@
+"""Randomized cluster workloads checked by the strict-serializability verifier
+(ref model: accord-core/src/test/java/accord/burn/BurnTest.java randomized
+workloads + verify/StrictSerializabilityVerifier.java)."""
+
+import pytest
+
+from accord_tpu.sim.cluster import Cluster
+from accord_tpu.sim.kvstore import KVDataStore, kv_txn
+from accord_tpu.sim.topology_factory import build_topology
+from accord_tpu.sim.verifier import HistoryViolation, StrictSerializabilityVerifier
+from accord_tpu.utils.random_source import RandomSource
+
+
+def run_workload(seed: int, n_txns: int, n_keys: int, nodes=(1, 2, 3), rf=3,
+                 shards=4, concurrent: int = 4):
+    topology = build_topology(1, nodes, rf, shards)
+    cluster = Cluster(topology=topology, seed=seed,
+                      data_store_factory=KVDataStore)
+    rng = RandomSource(seed * 31 + 7)
+    verifier = StrictSerializabilityVerifier()
+    pending = [0]
+    submitted = [0]
+    keys = [1000 + 2000 * i for i in range(n_keys)]
+
+    def submit_one():
+        if submitted[0] >= n_txns:
+            return
+        submitted[0] += 1
+        pending[0] += 1
+        op = verifier.begin()
+        node_id = rng.pick(sorted(cluster.nodes))
+        read_keys = rng.sample(keys, min(len(keys), 1 + rng.next_int(3)))
+        appends = {}
+        if rng.decide(0.7):
+            for t in rng.sample(read_keys, 1 + rng.next_int(len(read_keys))):
+                appends[t] = (f"op{op}.{t}",)
+        start = cluster.queue.now
+
+        def on_done(result, failure):
+            pending[0] -= 1
+            if failure is None:
+                verifier.on_result(op, start, cluster.queue.now,
+                                   result.reads, result.appends)
+            # schedule the next txn
+            submit_one()
+
+        cluster.nodes[node_id].coordinate(
+            kv_txn(read_keys, appends)).begin(on_done)
+
+    for _ in range(min(concurrent, n_txns)):
+        submit_one()
+    cluster.run_until_quiescent(max_micros=600_000_000)
+    assert cluster.failures == [], cluster.failures[:3]
+    assert pending[0] == 0, f"{pending[0]} txns never completed"
+
+    # final reads
+    finals = {}
+    for t in keys:
+        out = []
+        cluster.nodes[sorted(cluster.nodes)[0]].coordinate(
+            kv_txn([t], {})).begin(lambda r, f, tok=t: out.append((tok, r, f)))
+        cluster.run_until_quiescent()
+        tok, r, f = out[0]
+        assert f is None
+        finals[tok] = r.reads[tok]
+    for t, v in finals.items():
+        verifier.set_final(t, v)
+    verifier.verify()
+    return cluster, verifier
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_random_workload_strict_serializable(seed):
+    run_workload(seed, n_txns=40, n_keys=4)
+
+
+def test_hot_key_contention():
+    run_workload(99, n_txns=60, n_keys=1, concurrent=8)
+
+
+def test_5_nodes_rf5():
+    run_workload(7, n_txns=40, n_keys=6, nodes=(1, 2, 3, 4, 5), rf=5,
+                 shards=8, concurrent=6)
+
+
+def test_verifier_detects_lost_write():
+    v = StrictSerializabilityVerifier()
+    op = v.begin()
+    v.on_result(op, 0, 10, {}, {5: ("a",)})
+    v.set_final(5, ())
+    with pytest.raises(HistoryViolation):
+        v.verify()
+
+
+def test_verifier_detects_stale_read():
+    v = StrictSerializabilityVerifier()
+    op1 = v.begin()
+    v.on_result(op1, 0, 10, {5: ("a", "b")}, {})
+    op2 = v.begin()
+    v.on_result(op2, 20, 30, {5: ("a",)}, {})  # later op reads shorter prefix
+    v.set_final(5, ("a", "b"))
+    with pytest.raises(HistoryViolation):
+        v.verify()
+
+
+def test_verifier_detects_non_prefix_read():
+    v = StrictSerializabilityVerifier()
+    op = v.begin()
+    v.on_result(op, 0, 10, {5: ("b",)}, {})
+    v.set_final(5, ("a", "b"))
+    with pytest.raises(HistoryViolation):
+        v.verify()

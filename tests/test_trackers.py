@@ -1,0 +1,150 @@
+"""Tracker reconciliation tests
+(ref model: accord-core/src/test/java/accord/coordinate/tracking/
+TrackerReconciler.java and friends — randomized event sequences reconciled
+against an independent model)."""
+
+import pytest
+
+from accord_tpu.coordinate.tracking import (
+    FastPathTracker, InvalidationTracker, QuorumTracker, ReadTracker,
+    RecoveryTracker, RequestStatus)
+from accord_tpu.primitives.keys import Range
+from accord_tpu.sim.topology_factory import build_topology
+from accord_tpu.topology.topology import Topologies
+from accord_tpu.utils.random_source import RandomSource
+
+
+def topo(nodes=(1, 2, 3, 4, 5), rf=5, shards=1):
+    return Topologies.single(build_topology(1, nodes, rf, shards))
+
+
+def test_quorum_tracker_success_at_majority():
+    t = QuorumTracker(topo())
+    assert t.record_success(1) is RequestStatus.NoChange
+    assert t.record_success(2) is RequestStatus.NoChange
+    assert t.record_success(3) is RequestStatus.Success
+
+
+def test_quorum_tracker_fails_past_max_failures():
+    t = QuorumTracker(topo())
+    assert t.record_failure(1) is RequestStatus.NoChange
+    assert t.record_failure(2) is RequestStatus.NoChange
+    assert t.record_failure(3) is RequestStatus.Failed
+
+
+def test_fast_path_achieved():
+    t = FastPathTracker(topo())  # rf=5: f=2, electorate=5, fast quorum=(2+5)//2+1=4
+    for n in (1, 2, 3):
+        t.record_success(n, fast_path_vote=True)
+    assert not t.has_fast_path_accepted()
+    assert t.record_success(4, fast_path_vote=True) is RequestStatus.Success
+    assert t.has_fast_path_accepted()
+
+
+def test_fast_path_rejected_falls_to_slow_quorum():
+    t = FastPathTracker(topo())
+    # two electorate rejects make fast quorum (4 of 5) impossible
+    assert t.record_success(1, fast_path_vote=False) is RequestStatus.NoChange
+    assert t.record_success(2, fast_path_vote=False) is RequestStatus.NoChange
+    # third success completes slow quorum with fast path already rejected
+    assert t.record_success(3, fast_path_vote=True) is RequestStatus.Success
+    assert not t.has_fast_path_accepted()
+
+
+def test_fast_path_failure_settles_decision():
+    """Regression: a node failure that completes the fast-path reject must
+    report Success (was: hang)."""
+    t = FastPathTracker(topo())
+    t.record_success(1, fast_path_vote=True)
+    t.record_success(2, fast_path_vote=True)
+    t.record_success(3, fast_path_vote=False)
+    # successes=3 (slow quorum met), fast accepts=2, rejects=1: undecided.
+    # node 4 fails -> rejects=2 -> fast path impossible -> decided.
+    assert t.record_failure(4) is RequestStatus.Success
+    assert not t.has_fast_path_accepted()
+
+
+def test_read_tracker_alternatives():
+    t = ReadTracker(topo())
+    t.record_in_flight(1)
+    status, more = t.record_read_failure(1)
+    assert status is RequestStatus.NoChange
+    assert len(more) == 1 and more[0] != 1
+    t.record_in_flight(more[0])
+    assert t.record_read_success(more[0]) is RequestStatus.Success
+
+
+def test_read_tracker_exhaustion():
+    t = ReadTracker(topo(nodes=(1, 2, 3), rf=3))
+    for n in (1, 2, 3):
+        t.record_in_flight(n)
+    assert t.record_read_failure(1)[0] is RequestStatus.NoChange
+    assert t.record_read_failure(2)[0] is RequestStatus.NoChange
+    assert t.record_read_failure(3)[0] is RequestStatus.Failed
+
+
+def test_recovery_tracker_superseding_rejects():
+    t = RecoveryTracker(topo())  # rf=5: f=2, recovery_fast_path_size=1
+    t.record_success(1, rejects_fast_path=True)
+    assert t.superseding_rejects()
+    t2 = RecoveryTracker(topo())
+    t2.record_success(1, rejects_fast_path=False)
+    assert not t2.superseding_rejects()
+
+
+def test_invalidation_tracker_single_shard_quorum():
+    t = InvalidationTracker(topo(shards=2))
+    # quorum on one shard suffices
+    outcomes = [t.record_promise(n) for n in (1, 2, 3)]
+    assert RequestStatus.Success in outcomes
+
+
+def test_multi_shard_quorum_per_shard():
+    t = QuorumTracker(topo(nodes=(1, 2, 3, 4, 5), rf=3, shards=2))
+    # shard0 replicas: 1,2,3 ; shard1 replicas: depends on round robin
+    shard_nodes = [tr.shard.nodes for tr in t.trackers]
+    # reach quorum on shard 0 only
+    for n in shard_nodes[0][:2]:
+        t.record_success(n)
+    # tracker not done until every shard has quorum
+    done = t.waiting_on_shards == 0
+    assert not done
+    for n in shard_nodes[1][:2]:
+        t.record_success(n)
+
+
+def test_random_reconciliation_against_model():
+    """Randomized: QuorumTracker reconciled against a naive per-shard model."""
+    rng = RandomSource(5)
+    for trial in range(200):
+        n = 3 + rng.next_int(5)
+        rf = min(n, 2 + rng.next_int(4))
+        shards = 1 + rng.next_int(4)
+        top = Topologies.single(build_topology(1, tuple(range(1, n + 1)), rf, shards))
+        tracker = QuorumTracker(top)
+        model_succ = {i: set() for i in range(len(tracker.trackers))}
+        model_fail = {i: set() for i in range(len(tracker.trackers))}
+        nodes = sorted(top.nodes())
+        rng2 = RandomSource(trial)
+        terminal = None
+        for _ in range(3 * n):
+            node = rng2.pick(nodes)
+            if rng2.decide(0.7):
+                status = tracker.record_success(node)
+                for i, tr in enumerate(tracker.trackers):
+                    if tr.shard.contains_node(node):
+                        model_succ[i].add(node)
+            else:
+                status = tracker.record_failure(node)
+                for i, tr in enumerate(tracker.trackers):
+                    if tr.shard.contains_node(node):
+                        model_fail[i].add(node)
+            if status is not RequestStatus.NoChange and terminal is None:
+                terminal = status
+                # verify against model at the moment of termination
+                if status is RequestStatus.Success:
+                    for i, tr in enumerate(tracker.trackers):
+                        assert len(model_succ[i]) >= tr.shard.slow_path_quorum_size
+                else:
+                    assert any(len(model_fail[i]) > tr.shard.max_failures
+                               for i, tr in enumerate(tracker.trackers))
