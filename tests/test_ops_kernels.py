@@ -1,0 +1,223 @@
+"""Device kernels vs host implementation — randomized equivalence.
+
+The deps kernel must compute exactly the dep set the host CommandsForKey /
+RangeDeps scan computes (ref semantics: local/CommandsForKey.java:614-650);
+the drain kernel must execute exactly the txns a naive executeAt-ordered
+topological executor would.
+"""
+
+import numpy as np
+import pytest
+
+from accord_tpu.ops import deps_kernel as dk
+from accord_tpu.ops import drain_kernel as drk
+from accord_tpu.ops.packing import pack_timestamps, unpack_timestamp
+from accord_tpu.primitives.keys import Range
+from accord_tpu.primitives.timestamp import Domain, Timestamp, TxnId, TxnKind
+from accord_tpu.utils.random_source import RandomSource
+
+import jax.numpy as jnp
+
+
+def _tid(rs, hlc, kind=None, node=None):
+    kind = kind if kind is not None else rs.pick([TxnKind.Read, TxnKind.Write,
+                                                  TxnKind.SyncPoint])
+    node = node if node is not None else rs.next_int(4) + 1
+    dom = Domain.Key if kind is not TxnKind.SyncPoint else Domain.Range
+    return TxnId.create(1, hlc, kind, dom, node)
+
+
+def _random_entries(rs, n, n_keys=12, max_iv=3):
+    entries = []
+    used_hlc = set()
+    for _ in range(n):
+        hlc = rs.next_int(10_000) + 1
+        while hlc in used_hlc:
+            hlc = rs.next_int(10_000) + 1
+        used_hlc.add(hlc)
+        tid = _tid(rs, hlc)
+        status = rs.pick([dk.SLOT_PREACCEPTED, dk.SLOT_ACCEPTED, dk.SLOT_COMMITTED,
+                          dk.SLOT_STABLE, dk.SLOT_APPLIED, dk.SLOT_INVALIDATED])
+        n_iv = rs.next_int(max_iv) + 1
+        toks, rngs = [], []
+        for _ in range(n_iv):
+            if rs.next_boolean():
+                toks.append(rs.next_int(n_keys))
+            else:
+                s = rs.next_int(n_keys)
+                rngs.append(Range(s, s + rs.next_int(3) + 1))
+        entries.append((tid, status, toks, rngs))
+    return entries
+
+
+def _host_deps(entries, bound, witnesses, toks, rngs, prune=None):
+    """Reference semantics, direct from the definition."""
+    out = []
+    ivs = [(t, t) for t in toks] + [(r.start, r.end - 1) for r in rngs]
+    for tid, status, etoks, erngs in entries:
+        if status in (dk.SLOT_FREE, dk.SLOT_INVALIDATED):
+            continue
+        if not witnesses.test(tid.kind()):
+            continue
+        if not tid < bound:
+            continue
+        if prune is not None and tid < prune:
+            continue
+        eivs = [(t, t) for t in etoks] + [(r.start, r.end - 1) for r in erngs]
+        if any(ql <= eh and el <= qh for ql, qh in ivs for el, eh in eivs):
+            out.append(tid)
+    return sorted(out)
+
+
+def _host_max_conflict(entries, toks, rngs):
+    ivs = [(t, t) for t in toks] + [(r.start, r.end - 1) for r in rngs]
+    best = None
+    for tid, status, etoks, erngs in entries:
+        if status in (dk.SLOT_FREE, dk.SLOT_INVALIDATED):
+            continue
+        eivs = [(t, t) for t in etoks] + [(r.start, r.end - 1) for r in erngs]
+        if any(ql <= eh and el <= qh for ql, qh in ivs for el, eh in eivs):
+            if best is None or tid > best:
+                best = tid
+    return best
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 7])
+def test_deps_kernel_matches_host(seed):
+    rs = RandomSource(seed)
+    entries = _random_entries(rs, 40)
+    table = dk.build_table(entries, capacity=64, max_intervals=6)
+
+    queries = []
+    for _ in range(16):
+        bound = _tid(rs, rs.next_int(12_000) + 1)
+        toks = [rs.next_int(12) for _ in range(rs.next_int(2) + 1)]
+        s = rs.next_int(12)
+        rngs = [Range(s, s + rs.next_int(4) + 1)] if rs.next_boolean() else []
+        queries.append((bound, bound.kind().witnesses(), toks, rngs))
+    q = dk.build_query(queries, max_intervals=6)
+
+    dep_mask, (mc_msb, mc_lsb, mc_node) = dk.calculate_deps(table, q)
+    got = dk.extract_deps(table, dep_mask)
+
+    for i, (bound, wit, toks, rngs) in enumerate(queries):
+        want = _host_deps(entries, bound, wit, toks, rngs)
+        assert got[i] == want, f"query {i}: {got[i]} != {want}"
+        want_mc = _host_max_conflict(entries, toks, rngs)
+        got_mc = unpack_timestamp(int(mc_msb[i]), int(mc_lsb[i]), int(mc_node[i]))
+        if want_mc is None:
+            assert got_mc == Timestamp.NONE
+        else:
+            assert got_mc._key() == want_mc._key()
+
+
+def test_deps_kernel_prune_floor():
+    rs = RandomSource(5)
+    entries = _random_entries(rs, 30)
+    table = dk.build_table(entries, capacity=32, max_intervals=6)
+    prune = _tid(rs, 5000, kind=TxnKind.Write, node=0)
+    bound = _tid(rs, 11_000)
+    toks = list(range(0, 12, 2))
+    q = dk.build_query([(bound, bound.kind().witnesses(), toks, [])], max_intervals=6)
+    pm, pl, pn = pack_timestamps([prune])
+    dep_mask, _ = dk.calculate_deps(table, q, jnp.asarray(pm[0]), jnp.asarray(pl[0]),
+                                    jnp.asarray(pn[0]))
+    got = dk.extract_deps(table, dep_mask)[0]
+    want = _host_deps(entries, bound, bound.kind().witnesses(), toks, [], prune=prune)
+    assert got == want
+
+
+def test_deps_kernel_excludes_self_for_accept_bound():
+    """Accept-phase deps use bound = executeAt > own TxnId; the txn must not
+    end up depending on itself (ref: PreAccept/Accept self-exclusion)."""
+    me = TxnId.create(1, 100, TxnKind.Write, Domain.Key, 1)
+    other = TxnId.create(1, 150, TxnKind.Write, Domain.Key, 2)
+    exec_at = TxnId.create(1, 200, TxnKind.Write, Domain.Key, 1)
+    entries = [(me, dk.SLOT_ACCEPTED, [1], []),
+               (other, dk.SLOT_PREACCEPTED, [1], [])]
+    table = dk.build_table(entries, capacity=4, max_intervals=2)
+    q = dk.build_query([(exec_at, me.kind().witnesses(), [1], [], me)],
+                       max_intervals=2)
+    dep_mask, _ = dk.calculate_deps(table, q)
+    assert dk.extract_deps(table, dep_mask)[0] == [other]
+
+
+def test_deps_kernel_unsigned_lsb():
+    """HLCs past 2^47 set the int64 sign bit of lsb — compare must stay unsigned."""
+    big = 1 << 50
+    a = TxnId.create(1, big + 1, TxnKind.Write, Domain.Key, 1)
+    b = TxnId.create(1, big + 2, TxnKind.Write, Domain.Key, 1)
+    small = TxnId.create(1, 10, TxnKind.Write, Domain.Key, 1)
+    entries = [(a, dk.SLOT_PREACCEPTED, [1], []),
+               (small, dk.SLOT_PREACCEPTED, [1], [])]
+    table = dk.build_table(entries, capacity=4, max_intervals=2)
+    q = dk.build_query([(b, b.kind().witnesses(), [1], [])], max_intervals=2)
+    dep_mask, _ = dk.calculate_deps(table, q)
+    assert dk.extract_deps(table, dep_mask)[0] == [small, a]
+
+
+# -- drain --------------------------------------------------------------------
+
+def _host_drain(n, adj, status, exec_at):
+    """Naive reactive executor over the same rule set."""
+    applied = [status[i] == dk.SLOT_APPLIED for i in range(n)]
+    changed = True
+    while changed:
+        changed = False
+        for i in range(n):
+            if status[i] != dk.SLOT_STABLE or applied[i]:
+                continue
+            ok = True
+            for j in range(n):
+                if not adj[i][j] or applied[j]:
+                    continue
+                if status[j] in (dk.SLOT_INVALIDATED, dk.SLOT_FREE):
+                    continue
+                if status[j] < dk.SLOT_COMMITTED:
+                    ok = False      # undecided dep blocks
+                elif exec_at[j] < exec_at[i]:
+                    ok = False      # earlier-executing dep not applied
+            if ok:
+                applied[i] = True
+                changed = True
+    return applied
+
+
+@pytest.mark.parametrize("seed", [11, 23, 42])
+def test_drain_matches_host(seed):
+    rs = RandomSource(seed)
+    n = 32
+    status, exec_at = [], []
+    for i in range(n):
+        status.append(rs.pick([dk.SLOT_FREE, dk.SLOT_PREACCEPTED, dk.SLOT_COMMITTED,
+                               dk.SLOT_STABLE, dk.SLOT_APPLIED, dk.SLOT_INVALIDATED]))
+        exec_at.append(_tid(rs, 100 + i))  # distinct executeAt per slot
+    adj = [[rs.next_int(4) == 0 and i != j for j in range(n)] for i in range(n)]
+
+    em, el, en = pack_timestamps(exec_at)
+    state = drk.DrainState(adj=jnp.asarray(np.array(adj)),
+                           status=jnp.asarray(np.array(status, np.int32)),
+                           exec_msb=jnp.asarray(em), exec_lsb=jnp.asarray(el),
+                           exec_node=jnp.asarray(en))
+    applied, newly = drk.drain(state)
+    want = _host_drain(n, adj, status, exec_at)
+    assert list(np.asarray(applied)) == want
+    for i in range(n):
+        assert bool(newly[i]) == (want[i] and status[i] != dk.SLOT_APPLIED)
+
+
+def test_drain_chain_depth():
+    """A pure chain drains fully in one call (fixpoint iterates to depth)."""
+    n = 16
+    adj = np.zeros((n, n), bool)
+    for i in range(1, n):
+        adj[i, i - 1] = True
+    status = np.full(n, dk.SLOT_STABLE, np.int32)
+    exec_at = [_tid(RandomSource(1), 100 + i, kind=TxnKind.Write, node=1)
+               for i in range(n)]
+    em, el, en = pack_timestamps(exec_at)
+    state = drk.DrainState(jnp.asarray(adj), jnp.asarray(status),
+                           jnp.asarray(em), jnp.asarray(el), jnp.asarray(en))
+    applied, newly = drk.drain(state)
+    assert bool(jnp.all(applied))
+    assert bool(jnp.all(newly))

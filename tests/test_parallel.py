@@ -1,0 +1,90 @@
+"""Sharded kernels on the virtual 8-device CPU mesh == unsharded results."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accord_tpu.ops import deps_kernel as dk
+from accord_tpu.ops import drain_kernel as drk
+from accord_tpu.ops.packing import pack_timestamps
+from accord_tpu.parallel import (make_mesh, shard_table, sharded_calculate_deps,
+                                 sharded_drain)
+from accord_tpu.primitives.keys import Range
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from accord_tpu.utils.random_source import RandomSource
+
+from tests.test_ops_kernels import _random_entries, _tid
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def test_sharded_deps_matches_unsharded(mesh):
+    rs = RandomSource(17)
+    entries = _random_entries(rs, 50)
+    table = dk.build_table(entries, capacity=64, max_intervals=6)
+
+    queries = []
+    for _ in range(8):
+        bound = _tid(rs, rs.next_int(12_000) + 1)
+        toks = [rs.next_int(12) for _ in range(2)]
+        queries.append((bound, bound.kind().witnesses(), toks, []))
+    q = dk.build_query(queries, max_intervals=6)
+
+    want_mask, (wm, wl, wn) = dk.calculate_deps(table, q)
+
+    st = shard_table(mesh, table)
+    fn = sharded_calculate_deps(mesh)
+    got_mask, (gm, gl, gn) = fn(st, q)
+
+    np.testing.assert_array_equal(np.asarray(got_mask), np.asarray(want_mask))
+    np.testing.assert_array_equal(np.asarray(gm), np.asarray(wm))
+    np.testing.assert_array_equal(np.asarray(gl), np.asarray(wl))
+    np.testing.assert_array_equal(np.asarray(gn), np.asarray(wn))
+
+
+def test_sharded_deps_prune_floor(mesh):
+    from accord_tpu.ops.packing import to_i64
+    rs = RandomSource(31)
+    entries = _random_entries(rs, 40)
+    table = dk.build_table(entries, capacity=64, max_intervals=6)
+    prune = _tid(rs, 6000, kind=TxnKind.Write, node=0)
+    bound = _tid(rs, 11_000)
+    q = dk.build_query([(bound, bound.kind().witnesses(), [1, 3, 5], [])],
+                       max_intervals=6)
+    import numpy as _np
+    pm = jnp.asarray(_np.int64(to_i64(prune.msb)))
+    pl = jnp.asarray(_np.int64(to_i64(prune.lsb)))
+    pn = jnp.asarray(_np.int32(prune.node))
+    want_mask, _ = dk.calculate_deps(table, q, pm, pl, pn)
+    st = shard_table(mesh, table)
+    fn = sharded_calculate_deps(mesh)
+    got_mask, _ = fn(st, q, pm, pl, pn)
+    np.testing.assert_array_equal(np.asarray(got_mask), np.asarray(want_mask))
+
+
+def test_sharded_drain_matches_unsharded(mesh):
+    rs = RandomSource(29)
+    n = 64
+    status = np.array([rs.pick([dk.SLOT_FREE, dk.SLOT_PREACCEPTED,
+                                dk.SLOT_COMMITTED, dk.SLOT_STABLE,
+                                dk.SLOT_APPLIED, dk.SLOT_INVALIDATED])
+                       for _ in range(n)], np.int32)
+    exec_at = [_tid(rs, 100 + i) for i in range(n)]
+    adj = np.array([[rs.next_int(5) == 0 and i != j for j in range(n)]
+                    for i in range(n)])
+    em, el, en = pack_timestamps(exec_at)
+    state = drk.DrainState(jnp.asarray(adj), jnp.asarray(status),
+                           jnp.asarray(em), jnp.asarray(el), jnp.asarray(en))
+
+    want_applied, want_newly = drk.drain(state)
+
+    fn = sharded_drain(mesh)
+    got_applied, got_newly = fn(state)
+    np.testing.assert_array_equal(np.asarray(got_applied), np.asarray(want_applied))
+    np.testing.assert_array_equal(np.asarray(got_newly), np.asarray(want_newly))
