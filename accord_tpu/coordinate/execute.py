@@ -25,20 +25,25 @@ from .tracking import QuorumTracker, ReadTracker, RequestStatus
 
 
 def execute(node, txn_id: TxnId, txn: Txn, route: Route,
-            execute_at: Timestamp, deps: Deps) -> async_chain.AsyncChain:
+            execute_at: Timestamp, deps: Deps,
+            ballot=None) -> async_chain.AsyncChain:
     """Returns chain of the client Result (settled at persist-start,
-    ref: CoordinationAdapter.java:189-194)."""
-    return _ExecuteTxn(node, txn_id, txn, route, execute_at, deps)._start()
+    ref: CoordinationAdapter.java:189-194).  A recovery coordinator passes
+    its ballot so its Stable distribution overrides lower promises."""
+    return _ExecuteTxn(node, txn_id, txn, route, execute_at, deps,
+                       ballot)._start()
 
 
 class _ExecuteTxn(api.Callback):
-    def __init__(self, node, txn_id, txn, route, execute_at, deps):
+    def __init__(self, node, txn_id, txn, route, execute_at, deps, ballot=None):
+        from ..primitives.timestamp import Ballot
         self.node = node
         self.txn_id = txn_id
         self.txn = txn
         self.route = route
         self.execute_at = execute_at
         self.deps = deps
+        self.ballot = ballot if ballot is not None else Ballot.ZERO
         self.all_topologies = node.topology().with_unsynced_epochs(
             route.participants, txn_id.epoch(), execute_at.epoch())
         exec_topology = self.all_topologies.for_epoch(execute_at.epoch())
@@ -73,7 +78,7 @@ class _ExecuteTxn(api.Callback):
         for to in sorted(self.stable_tracker.nodes()):
             request = Commit(CommitKind.Stable, self.txn_id, self.txn,
                              self.route, self.execute_at, self.deps,
-                             read=to in self.read_nodes)
+                             read=to in self.read_nodes, ballot=self.ballot)
             self.node.send(to, request, self)
         return self.result
 
@@ -100,7 +105,8 @@ class _ExecuteTxn(api.Callback):
                 # preserving the read leg if this was a read-designated node
                 request = Commit(CommitKind.Stable, self.txn_id, self.txn,
                                  self.route, self.execute_at, self.deps,
-                                 read=from_id in self.read_nodes)
+                                 read=from_id in self.read_nodes,
+                                 ballot=self.ballot)
                 self.node.send(from_id, request, self)
             else:
                 self._fail(Exhausted(self.txn_id))

@@ -247,12 +247,13 @@ class RecoveryTracker(AbstractTracker):
         return self._record(node, fn)
 
     def superseding_rejects(self) -> bool:
-        """True if some shard has enough electorate rejects to prove the
-        fast path was NOT taken (ref: Recover.java fast-path reconstruction:
-        rejects >= recoveryFastPathSize makes fast quorum impossible)."""
+        """True if some shard has enough electorate rejects that the original
+        fast-path quorum cannot have existed (ref:
+        tracking/RecoveryTracker.java rejectsFastPath: rejects >
+        electorate - fastPathQuorumSize)."""
         for t in self.trackers:
             votes = len(t.rejects_fast_path_votes)  # type: ignore[attr-defined]
-            if votes > 0 and votes >= t.shard.recovery_fast_path_size:
+            if t.shard.rejects_fast_path(votes):
                 return True
         return False
 

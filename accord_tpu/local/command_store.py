@@ -231,6 +231,16 @@ class SafeCommandStore:
     def remove_transient_listeners(self, txn_id: TxnId) -> None:
         self.store.transient_listeners.pop(txn_id, None)
 
+    def remove_transient_listener(self, txn_id: TxnId, fn: Callable) -> None:
+        fns = self.store.transient_listeners.get(txn_id)
+        if fns is not None:
+            try:
+                fns.remove(fn)
+            except ValueError:
+                pass
+            if not fns:
+                del self.store.transient_listeners[txn_id]
+
     def notify_transient(self, command: Command) -> None:
         fns = self.store.transient_listeners.get(command.txn_id)
         if fns:

@@ -56,12 +56,12 @@ class ApplyOutcome(enum.IntEnum):
 
 def preaccept(safe: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
               route: Route, progress_key: Optional[int],
-              permit_fast_path: bool = True
+              permit_fast_path: bool = True, ballot: Ballot = Ballot.ZERO
               ) -> Tuple[AcceptOutcome, Optional[Timestamp]]:
     cmd = safe.get(txn_id)
     if cmd.has_been(Status.PreAccepted):
         return AcceptOutcome.Redundant, cmd.execute_at
-    if cmd.promised != Ballot.ZERO:
+    if cmd.promised > ballot:
         return AcceptOutcome.RejectedBallot, None
     if safe.redundant_before().status(txn_id, partial_txn.keys) in (
             RedundantStatus.SHARD_REDUNDANT,):
@@ -128,6 +128,28 @@ def _update_cfk_status(safe: SafeCommandStore, cmd: Command,
         return  # range txns tracked via range_commands + command status
     for key in keys:
         safe.cfk(key.token()).update(cmd.txn_id, status, execute_at)
+
+
+def recover(safe: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
+            route: Route, progress_key: Optional[int],
+            ballot: Ballot) -> Tuple[AcceptOutcome, Optional[Ballot]]:
+    """BeginRecovery's local transition: promise the recovery ballot and
+    witness the txn if unseen (ref: Commands.java recover + preacceptOrRecover).
+    Never grants a fast-path vote — the witnessed timestamp for an unseen txn
+    is computed with the fast path disabled."""
+    cmd = safe.get(txn_id)
+    if cmd.is_truncated():
+        return AcceptOutcome.Truncated, None
+    if cmd.promised > ballot:
+        return AcceptOutcome.RejectedBallot, cmd.promised
+    if not cmd.has_been(Status.PreAccepted):
+        outcome, _ = preaccept(safe, txn_id, partial_txn, route, progress_key,
+                               permit_fast_path=False, ballot=ballot)
+        if outcome not in (AcceptOutcome.Success, AcceptOutcome.Redundant):
+            return outcome, None
+        cmd = safe.get(txn_id)
+    safe.update(cmd.updated(promised=ballot), notify=False)
+    return AcceptOutcome.Success, None
 
 
 # ---------------------------------------------------------------------------

@@ -334,6 +334,17 @@ _STATUS_KNOWN = {
 }
 
 
+def recovery_rank(status: "Status", accepted) -> tuple:
+    """Knowledge ordering for recovery replies (ref: Status.java:871
+    Status.max): higher phase wins; within a ballot-tie-broken phase
+    (Accept/Commit) the higher ballot wins even over a higher status —
+    AcceptedInvalidate@b1 beats Accepted@ZERO."""
+    from ..primitives.timestamp import Ballot
+    phase = status.phase
+    ballot = accepted if phase.tie_break_with_ballot else Ballot.ZERO
+    return (phase, ballot, status)
+
+
 class LocalExecution(enum.IntEnum):
     """Local progress refinement (ref: SaveStatus.java LocalExecution)."""
     NotReady = 0

@@ -1,0 +1,154 @@
+"""CheckStatus: read-only quorum probe of a transaction's state.
+
+Rebuild of ref: accord-core/src/main/java/accord/messages/CheckStatus.java
+(911 LoC; replies merge through the Known lattice).  Used by MaybeRecover to
+decide whether anyone is making progress before escalating to full recovery,
+and by FetchData to pull missing knowledge.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+from ..local.command_store import PreLoadContext, SafeCommandStore
+from ..local.status import Durability, Known, SaveStatus, Status
+from ..primitives.keys import Route
+from ..primitives.timestamp import Ballot, Timestamp, TxnId
+from .base import MessageType, Reply, Request
+
+
+class IncludeInfo(enum.IntEnum):
+    No = 0
+    Route = 1
+    All = 2
+
+
+class CheckStatusOk(Reply):
+    type = MessageType.CHECK_STATUS_RSP
+
+    def __init__(self, save_status: SaveStatus, promised: Ballot,
+                 accepted: Ballot, execute_at: Optional[Timestamp],
+                 durability: Durability, route: Optional[Route],
+                 home_key: Optional[int],
+                 partial_txn=None, partial_deps=None, writes=None, result=None):
+        self.save_status = save_status
+        self.promised = promised
+        self.accepted = accepted
+        self.execute_at = execute_at
+        self.durability = durability
+        self.route = route
+        self.home_key = home_key
+        self.partial_txn = partial_txn
+        self.partial_deps = partial_deps
+        self.writes = writes
+        self.result = result
+
+    def is_ok(self) -> bool:
+        return True
+
+    @property
+    def known(self) -> Known:
+        return self.save_status.known
+
+    def merge(self, that: "CheckStatusOk") -> "CheckStatusOk":
+        """Keep the reply with most knowledge per field
+        (ref: CheckStatus.CheckStatusOk.merge)."""
+        hi, lo = (self, that)
+        if (that.save_status, that.accepted) > (self.save_status, self.accepted):
+            hi, lo = (that, self)
+        route = hi.route
+        if route is None or (lo.route is not None and lo.route.is_full
+                             and not route.is_full):
+            route = lo.route if lo.route is not None else route
+        return CheckStatusOk(
+            hi.save_status,
+            max(hi.promised, lo.promised),
+            hi.accepted,
+            hi.execute_at if hi.execute_at is not None else lo.execute_at,
+            hi.durability.merge(lo.durability),
+            route,
+            hi.home_key if hi.home_key is not None else lo.home_key,
+            _merge_partial_txn(hi.partial_txn, lo.partial_txn),
+            hi.partial_deps if hi.partial_deps is not None else lo.partial_deps,
+            hi.writes if hi.writes is not None else lo.writes,
+            hi.result if hi.result is not None else lo.result)
+
+    def __repr__(self):
+        return (f"CheckStatusOk({self.save_status.name}, promised={self.promised}, "
+                f"durability={self.durability.name})")
+
+
+def _merge_partial_txn(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a.with_partial(b)
+
+
+class CheckStatusNack(Reply):
+    type = MessageType.CHECK_STATUS_RSP
+
+    def is_ok(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return "CheckStatusNack"
+
+
+class CheckStatus(Request):
+    """(ref: messages/CheckStatus.java).  Not a TxnRequest: it may be sent
+    with only a routing hint, before the route is known."""
+
+    type = MessageType.CHECK_STATUS_REQ
+
+    def __init__(self, txn_id: TxnId, query, epoch: int,
+                 include_info: IncludeInfo = IncludeInfo.No):
+        self.txn_id = txn_id
+        self.query = query            # Unseekables to probe
+        self.epoch = epoch
+        self.include_info = include_info
+        self.wait_for_epoch = epoch
+
+    def process(self, node, from_id: int, reply_context) -> None:
+        txn_id = self.txn_id
+        include = self.include_info
+
+        def map_fn(safe: SafeCommandStore):
+            cmd = safe.if_present(txn_id)
+            if cmd is None or cmd.save_status is SaveStatus.Uninitialised:
+                return CheckStatusNack()
+            full = include is IncludeInfo.All
+            return CheckStatusOk(
+                cmd.save_status, cmd.promised, cmd.accepted, cmd.execute_at,
+                cmd.durability,
+                cmd.route if include >= IncludeInfo.Route else None,
+                cmd.route.home_key if cmd.route is not None else None,
+                cmd.partial_txn if full else None,
+                cmd.partial_deps if full else None,
+                cmd.writes if full else None,
+                cmd.result if full else None)
+
+        def reduce_fn(a, b):
+            if not a.is_ok():
+                return b
+            if not b.is_ok():
+                return a
+            return a.merge(b)
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(
+                    from_id, reply_context, failure)
+            elif result is None:
+                node.reply(from_id, reply_context, CheckStatusNack())
+            else:
+                node.reply(from_id, reply_context, result)
+
+        node.map_reduce_consume_local(
+            PreLoadContext.for_txn(txn_id), self.query,
+            self.epoch, self.epoch, map_fn, reduce_fn, consume)
+
+    def __repr__(self):
+        return f"CheckStatus({self.txn_id})"

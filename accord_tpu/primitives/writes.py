@@ -94,5 +94,9 @@ class ProgressToken:
         return (self.durability >= o.durability and self.status_phase >= o.status_phase
                 and self.promised >= o.promised and self.accepted >= o.accepted)
 
+    def __gt__(self, o: "ProgressToken"):
+        """Strictly more progress on at least one axis, no regression."""
+        return self >= o and not self == o
+
 
 _NONE = ProgressToken(0, 0, Ballot.ZERO, Ballot.ZERO)

@@ -231,6 +231,8 @@ class Cluster:
         self.request_timeout_micros = request_timeout_micros
         self.partitioned: Set[frozenset] = set()  # pairs that cannot talk
         self.drop_probability = 0.0
+        # test hook (ref: test NetworkFilter): return True to drop a request
+        self.message_filter: Optional[Callable[[int, int, object], bool]] = None
         self.stats: Dict[str, int] = {}
 
         scheduler = SimScheduler(self.queue)
@@ -270,6 +272,8 @@ class Cluster:
         self.stats[type(request).__name__] = self.stats.get(type(request).__name__, 0) + 1
         action = self._action(src, dst)
         if action is Action.DROP:
+            return
+        if self.message_filter is not None and self.message_filter(src, dst, request):
             return
         ctx = _ReplyContext(src, callback_id)
         at = self.queue.now + (self._latency() if src != dst else 0)

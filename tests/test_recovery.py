@@ -1,0 +1,256 @@
+"""Recovery: coordinator death, fast-path reconstruction, invalidation.
+
+Modelled on ref: accord-core/src/test/java/accord/coordinate/RecoverTest.java
+plus the NetworkFilter-driven mocked-cluster tier.
+"""
+
+import pytest
+
+from accord_tpu.coordinate.errors import CoordinationFailed, Preempted, Timeout
+from accord_tpu.coordinate.recover import Recover, maybe_recover
+from accord_tpu.messages.accept import Accept
+from accord_tpu.messages.commit import Commit, CommitInvalidate
+from accord_tpu.messages.preaccept import PreAccept
+from accord_tpu.primitives.writes import ProgressToken
+from accord_tpu.local.status import SaveStatus, Status
+from accord_tpu.sim.kvstore import KVDataStore, KVResult, kv_txn
+from accord_tpu.sim.topology_factory import build_topology
+
+from tests.test_e2e_basic import make_cluster, submit
+
+
+def _drop(cluster, pred):
+    cluster.message_filter = pred
+
+
+def _statuses(cluster, txn_id):
+    """txn status on every store of every node that knows it."""
+    out = {}
+    for nid, node in cluster.nodes.items():
+        for store in node.command_stores.unsafe_all_stores():
+            cmd = store.command_if_present(txn_id)
+            if cmd is not None and cmd.save_status is not SaveStatus.Uninitialised:
+                out.setdefault(nid, []).append(cmd.save_status)
+    return out
+
+
+def _submit_stalled_after_preaccept(cluster, node_id=1, keys=(10,)):
+    """Drive a txn through PreAccept, dropping the coordinator's Commit —
+    simulates the coordinator dying after the fast-path decision."""
+    _drop(cluster, lambda src, dst, req: isinstance(req, (Commit,))
+          and src == node_id)
+    txn = kv_txn(list(keys), {k: ("orphan",) for k in keys})
+    out = submit(cluster, node_id, txn)
+    cluster.run_until_quiescent()
+    # coordinate() failed (stable round timed out); PreAccepted cluster-wide
+    assert out and out[0][1] is not None, "txn should have stalled"
+    _drop(cluster, None)
+    return txn
+
+
+def _find_txn_id(cluster, keys):
+    """Fish the stalled TxnId out of any replica's conflict index."""
+    for node in cluster.nodes.values():
+        for store in node.command_stores.unsafe_all_stores():
+            for token, cfk in store.commands_for_key.items():
+                if token in keys and cfk.size():
+                    return cfk.txn_ids()[0]
+    raise AssertionError("stalled txn not found")
+
+
+def test_recover_completes_preaccepted_txn():
+    """All replicas PreAccepted at txnId, coordinator gone: recovery must
+    re-propose executeAt=txnId and complete the txn."""
+    cluster = make_cluster(seed=11)
+    txn = _submit_stalled_after_preaccept(cluster)
+    txn_id = _find_txn_id(cluster, {10})
+
+    node3 = cluster.nodes[3]
+    route = node3.compute_route(txn_id, txn.keys)
+    out = []
+    Recover.recover(node3, txn_id, route, txn).begin(
+        lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert out and out[0][1] is None, f"recovery failed: {out}"
+    outcome, _ = out[0][0]
+    assert outcome == "executed"
+
+    # the orphaned write must now be visible
+    read = submit(cluster, 2, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert read[0][1] is None
+    assert read[0][0].reads == {10: ("orphan",)}
+
+
+def test_recover_invalidates_unwitnessed_fast_path():
+    """PreAccept reached only the coordinator's replica: the fast path is
+    provably rejected at recovery quorum -> invalidate."""
+    cluster = make_cluster(seed=13)
+    _drop(cluster, lambda src, dst, req: isinstance(req, PreAccept)
+          and dst != 1)
+    txn = kv_txn([10], {10: ("ghost",)})
+    out = submit(cluster, 1, txn)
+    cluster.run_until_quiescent()
+    assert out[0][1] is not None, "txn should have stalled"
+    _drop(cluster, None)
+    txn_id = _find_txn_id(cluster, {10})
+
+    node2 = cluster.nodes[2]
+    route = node2.compute_route(txn_id, txn.keys)
+    rec = []
+    Recover.recover(node2, txn_id, route, txn).begin(
+        lambda r, f: rec.append((r, f)))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert rec and rec[0][1] is None, f"recovery failed: {rec}"
+    outcome, _ = rec[0][0]
+    assert outcome == "invalidated"
+
+    # ghost write must never become visible
+    read = submit(cluster, 3, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert read[0][1] is None
+    assert read[0][0].reads == {10: ()}
+
+
+def test_recover_adopts_completed_txn():
+    """Recovery of an already-applied txn re-persists the known outcome."""
+    cluster = make_cluster(seed=17)
+    out = submit(cluster, 1, kv_txn([10], {10: ("done",)}))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+    txn_id = _find_txn_id(cluster, {10})
+
+    txn = kv_txn([10], {10: ("done",)})
+    node2 = cluster.nodes[2]
+    route = node2.compute_route(txn_id, txn.keys)
+    rec = []
+    Recover.recover(node2, txn_id, route, txn).begin(
+        lambda r, f: rec.append((r, f)))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert rec and rec[0][1] is None, f"recovery failed: {rec}"
+    outcome, _ = rec[0][0]
+    assert outcome in ("applied", "executed")
+
+    read = submit(cluster, 3, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert read[0][0].reads == {10: ("done",)}
+
+
+def test_recover_without_definition_fetches_it():
+    """node.recover(txn_id, route) with no Txn: CheckStatus(All) must fetch
+    the definition, then complete recovery."""
+    cluster = make_cluster(seed=19)
+    txn = _submit_stalled_after_preaccept(cluster)
+    txn_id = _find_txn_id(cluster, {10})
+
+    node2 = cluster.nodes[2]
+    route = node2.compute_route(txn_id, txn.keys)
+    out = []
+    node2.recover(txn_id, route).begin(lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert out and out[0][1] is None, f"recovery failed: {out}"
+
+    read = submit(cluster, 3, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert read[0][0].reads == {10: ("orphan",)}
+
+
+def test_recovery_preempts_original_coordinator():
+    """A promised recovery ballot causes the original coordinator's late
+    rounds to be rejected (Preempted), never double-applied."""
+    cluster = make_cluster(seed=23)
+    txn = _submit_stalled_after_preaccept(cluster)
+    txn_id = _find_txn_id(cluster, {10})
+
+    node3 = cluster.nodes[3]
+    route = node3.compute_route(txn_id, txn.keys)
+    rec = []
+    Recover.recover(node3, txn_id, route, txn).begin(
+        lambda r, f: rec.append((r, f)))
+    cluster.run_until_quiescent()
+    assert rec and rec[0][1] is None
+
+    # original coordinator retries its slow path under ballot ZERO: rejected
+    from accord_tpu.coordinate.propose import propose
+    from accord_tpu.primitives.timestamp import Ballot
+    from accord_tpu.primitives.deps import Deps
+    out = []
+    propose(cluster.nodes[1], Ballot.ZERO, txn_id, txn, route, txn_id,
+            Deps.none()).begin(lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    assert out and isinstance(out[0][1], (Preempted,)), \
+        f"stale coordinator should be preempted: {out}"
+    # and the store state was not corrupted
+    assert cluster.failures == []
+
+
+def test_maybe_recover_skips_when_progressed():
+    """MaybeRecover sees a completed txn and reports progress instead of
+    recovering."""
+    cluster = make_cluster(seed=29)
+    out = submit(cluster, 1, kv_txn([10], {10: ("x",)}))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+    txn_id = _find_txn_id(cluster, {10})
+
+    node2 = cluster.nodes[2]
+    route = node2.compute_route(txn_id, kv_txn([10], {}).keys)
+    res = []
+    maybe_recover(node2, txn_id, route, ProgressToken.none()).begin(
+        lambda r, f: res.append((r, f)))
+    cluster.run_until_quiescent()
+    assert res and res[0][1] is None
+    assert res[0][0][0] == "progressed"
+
+
+def test_recovery_rank_ballot_tie_break():
+    """An accepted invalidation under a higher ballot must outrank a stale
+    Accepted@ZERO (ref: Status.java Status.max ballot tie-break) — both at
+    the coordinator and in the per-node reduce."""
+    from accord_tpu.local.status import Status, recovery_rank
+    from accord_tpu.primitives.timestamp import Ballot
+    b1 = Ballot.from_values(1, 100, 1)
+    assert recovery_rank(Status.AcceptedInvalidate, b1) > \
+        recovery_rank(Status.Accepted, Ballot.ZERO)
+    # higher phase still wins regardless of ballot
+    assert recovery_rank(Status.Committed, Ballot.ZERO) > \
+        recovery_rank(Status.AcceptedInvalidate, b1)
+    # within Commit phase, ballot breaks ties
+    assert recovery_rank(Status.Committed, b1) > \
+        recovery_rank(Status.Committed, Ballot.ZERO)
+
+    from accord_tpu.coordinate.recover import _max_accepted_or_later
+
+    class FakeOk:
+        def __init__(self, status, accepted):
+            self.status = status
+            self.accepted = accepted
+
+    inval = FakeOk(Status.AcceptedInvalidate, b1)
+    acc = FakeOk(Status.Accepted, Ballot.ZERO)
+    pre = FakeOk(Status.PreAccepted, Ballot.ZERO)
+    assert _max_accepted_or_later([acc, inval, pre]) is inval
+    assert _max_accepted_or_later([pre]) is None
+
+
+def test_recovery_determinism():
+    """Same seed -> identical recovery outcome and message counts."""
+    def run(seed):
+        cluster = make_cluster(seed=seed)
+        txn = _submit_stalled_after_preaccept(cluster)
+        txn_id = _find_txn_id(cluster, {10})
+        node3 = cluster.nodes[3]
+        route = node3.compute_route(txn_id, txn.keys)
+        out = []
+        Recover.recover(node3, txn_id, route, txn).begin(
+            lambda r, f: out.append((r, f)))
+        cluster.run_until_quiescent()
+        return out[0][0][0], dict(cluster.stats)
+
+    a = run(31)
+    b = run(31)
+    assert a == b

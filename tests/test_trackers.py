@@ -84,8 +84,14 @@ def test_read_tracker_exhaustion():
 
 
 def test_recovery_tracker_superseding_rejects():
-    t = RecoveryTracker(topo())  # rf=5: f=2, recovery_fast_path_size=1
+    # rf=5: electorate 5, fast quorum 4 -> one reject still leaves a fast
+    # quorum possible; two rejects prove it impossible
+    # (ref: tracking/RecoveryTracker.java rejectsFastPath:
+    #  rejects > electorate - fastPathQuorumSize)
+    t = RecoveryTracker(topo())
     t.record_success(1, rejects_fast_path=True)
+    assert not t.superseding_rejects()
+    t.record_success(2, rejects_fast_path=True)
     assert t.superseding_rejects()
     t2 = RecoveryTracker(topo())
     t2.record_success(1, rejects_fast_path=False)
