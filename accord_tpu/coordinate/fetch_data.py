@@ -1,0 +1,75 @@
+"""FetchData: pull a txn's known state from peers and apply it locally.
+
+Rebuild of ref: accord-core/src/main/java/accord/coordinate/FetchData.java:42
++ messages/Propagate.java:63 — the fetch is a CheckStatus(All) quorum probe;
+the "propagate" half applies whatever knowledge came back to the local
+stores, upgrading them to the most advanced remote state (commit, or apply
+with the outcome).  Used by the progress log to unblock local txns waiting
+on dependencies whose Commit/Apply messages this node missed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..local import commands
+from ..local.command_store import PreLoadContext
+from ..local.status import Status
+from ..messages.check_status import CheckStatusOk, IncludeInfo
+from ..primitives.timestamp import Ballot, TxnId
+from ..utils import async_chain
+from .errors import Timeout
+
+
+def fetch_data(node, txn_id: TxnId, participants, epoch: int
+               ) -> async_chain.AsyncChain:
+    """CheckStatus(All) a quorum, then propagate the merged knowledge into
+    the local stores.  Settles with the merged CheckStatusOk (or None if the
+    txn is unknown cluster-wide)."""
+    from .recover import _check_status_quorum
+    result = async_chain.AsyncResult()
+
+    def on_done(merged: Optional[CheckStatusOk], failure):
+        if failure is not None:
+            result.set_failure(failure)
+            return
+        if merged is not None:
+            propagate(node, txn_id, participants, merged)
+        result.set_success(merged)
+
+    _check_status_quorum(node, txn_id, participants, epoch,
+                         IncludeInfo.All, on_done)
+    return result
+
+
+def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
+    """Apply remotely-learned knowledge to the local stores
+    (ref: messages/Propagate.java).  Only ever upgrades: the underlying
+    transitions are no-ops when local state is already as advanced."""
+    status = ok.save_status.status
+
+    def apply_fn(safe):
+        if status is Status.Invalidated:
+            commands.commit_invalidate(safe, txn_id)
+            return
+        if ok.route is None or ok.partial_txn is None:
+            return
+        owned = safe.ranges(txn_id.epoch())
+        partial_txn = ok.partial_txn.slice(owned, True)
+        if status >= Status.PreApplied and ok.writes is not None \
+                and ok.execute_at is not None:
+            deps = ok.partial_deps.slice(owned) if ok.partial_deps is not None else None
+            commands.apply(safe, txn_id, ok.route, ok.execute_at, deps,
+                           partial_txn, ok.writes, ok.result)
+            return
+        if status >= Status.Committed and ok.execute_at is not None \
+                and ok.partial_deps is not None:
+            commands.commit(safe, txn_id, status >= Status.Stable, Ballot.MAX,
+                            ok.route, partial_txn, ok.execute_at,
+                            ok.partial_deps.slice(owned))
+            return
+        if status >= Status.PreCommitted and ok.execute_at is not None:
+            commands.precommit(safe, txn_id, ok.execute_at)
+
+    node.for_each_local(PreLoadContext.for_txn(txn_id), participants,
+                        txn_id.epoch(), txn_id.epoch(), apply_fn)

@@ -55,8 +55,14 @@ class _Persist(api.Callback):
         status = self.tracker.record_success(from_id)
         if status is RequestStatus.Success and not self.durable_recorded:
             self.durable_recorded = True
-            # a quorum of every shard has applied: the txn is majority-durable
-            # (feeds durability watermarks / truncation in a later round)
+            # a quorum of every shard has applied: the txn is majority-durable.
+            # Tell every replica so progress logs stand down and truncation
+            # watermarks can advance (ref: Persist.java InformDurable leg).
+            from ..local.status import Durability
+            from ..messages.inform import InformDurable
+            inform = InformDurable(self.txn_id, self.route, Durability.Majority)
+            for to in sorted(self.tracker.nodes()):
+                self.node.send(to, inform)
 
     def on_failure(self, from_id: int, failure: BaseException) -> None:
         self.tracker.record_failure(from_id)

@@ -300,19 +300,23 @@ def _max_accepted_or_later(oks: List[RecoverOk]) -> Optional[RecoverOk]:
 
 
 def _merge_committed_deps(oks: List[RecoverOk], max_ok: RecoverOk) -> Deps:
-    """LatestDeps.mergeCommit approximation: union of deps from replies that
-    hold decided deps (identical per range at any committed replica; union
-    covers the whole route).  With no decided deps anywhere (PreCommitted
-    only), fall back to the union of every proposal — a safe superset."""
-    decided = [ok.deps for ok in oks if ok.deps_decided]
-    if not decided:
-        return _merge_proposal_deps(oks)
-    return Deps.merge(decided)
+    """LatestDeps.mergeCommit: decided deps win for the ranges they cover;
+    ranges with no decided coverage anywhere in the quorum fall back to the
+    union of proposals (a safe superset) — never silently empty."""
+    from ..primitives.keys import Ranges
+    decided = Deps.merge([ok.decided_deps for ok in oks])
+    covering = Ranges.empty()
+    for ok in oks:
+        covering = covering.with_(ok.decided_covering)
+    proposals = Deps.merge([ok.proposed_deps for ok in oks])
+    return decided.with_(proposals.without_covered(covering))
 
 
 def _merge_proposal_deps(oks: List[RecoverOk]) -> Deps:
-    """LatestDeps.mergeProposal approximation: union of all proposals."""
-    return Deps.merge([ok.deps for ok in oks])
+    """LatestDeps.mergeProposal approximation: union of every proposal and
+    decided slice — a safe superset."""
+    return Deps.merge([ok.proposed_deps for ok in oks]
+                      + [ok.decided_deps for ok in oks])
 
 
 def _repersist(node, txn_id, txn, route, max_ok: RecoverOk, deps: Deps,
@@ -411,9 +415,12 @@ def maybe_recover(node, txn_id: TxnId, route: Route,
             token = ProgressToken(int(merged.durability),
                                   int(merged.save_status.status.phase),
                                   merged.promised, merged.accepted)
-        if merged is not None and (token > prev or merged.save_status.is_complete()):
+        if merged is not None and token > prev:
             result.set_success(("progressed", token))
             return
+        # no observable progress — including complete-but-never-durable txns,
+        # whose recovery re-persists and re-sends InformDurable so the home
+        # progress log can finally retire the entry
         Recover.recover(node, txn_id, route, txn).begin(result.settle)
 
     _check_status_quorum(node, txn_id, route.participants, txn_id.epoch(),

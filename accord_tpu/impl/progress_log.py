@@ -1,0 +1,233 @@
+"""The liveness engine: per-store progress log driving recovery and fetch.
+
+Rebuild of ref: accord-core/src/main/java/accord/impl/SimpleProgressLog.java:77-714.
+Two state machines per store:
+
+- HomeState (this node is a home-shard replica for the txn): every tracked
+  txn cycles Expected -> NoProgress -> Investigating on a periodic scan; an
+  Investigating txn runs MaybeRecover (CheckStatus probe, escalating to full
+  Recover).  Progress observed remotely resets to Expected with the new
+  ProgressToken; a terminal outcome retires the entry.
+
+- BlockedState (any store): a local txn is waiting on a dependency whose
+  Commit/Apply this node missed.  The scan runs FetchData for the blocker,
+  propagating remote knowledge into the local stores; if the blocker is
+  genuinely stuck, its own home shard recovers it.
+
+The scan timer is self-disarming: it only reschedules while entries remain,
+so a quiescent cluster schedules nothing (keeps the discrete-event sim's
+run_until_quiescent meaningful, and is how the reference behaves under
+LocalConfig.getProgressLogScheduleDelay pacing).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Optional
+
+from .. import api
+from ..primitives.timestamp import TxnId
+from ..primitives.writes import ProgressToken
+
+
+class _Progress(enum.IntEnum):
+    """(ref: SimpleProgressLog Progress)."""
+    Expected = 0
+    NoProgress = 1
+    Investigating = 2
+
+
+class _HomeEntry:
+    __slots__ = ("txn_id", "route", "progress", "token")
+
+    def __init__(self, txn_id: TxnId, route):
+        self.txn_id = txn_id
+        self.route = route
+        self.progress = _Progress.Expected
+        self.token = ProgressToken.none()
+
+
+class _BlockedEntry:
+    __slots__ = ("txn_id", "participants", "progress")
+
+    def __init__(self, txn_id: TxnId, participants):
+        self.txn_id = txn_id
+        self.participants = participants
+        self.progress = _Progress.Expected
+
+
+class SimpleProgressLog(api.ProgressLog):
+    """(ref: impl/SimpleProgressLog.java)."""
+
+    def __init__(self, store, scan_delay_micros: int = 500_000):
+        self.store = store
+        self.scan_delay_micros = scan_delay_micros
+        self.home: Dict[TxnId, _HomeEntry] = {}
+        self.blocked: Dict[TxnId, _BlockedEntry] = {}
+        self._scheduled = None
+
+    # -- scheduling ----------------------------------------------------------
+    def _arm(self) -> None:
+        if self._scheduled is None and (self.home or self.blocked):
+            node = self.store.node
+            self._scheduled = node.scheduler.once(self.scan_delay_micros,
+                                                  self._scan)
+
+    def _scan(self) -> None:
+        self._scheduled = None
+        node = self.store.node
+        for entry in list(self.home.values()):
+            if entry.progress is _Progress.Expected:
+                entry.progress = _Progress.NoProgress
+            elif entry.progress is _Progress.NoProgress:
+                entry.progress = _Progress.Investigating
+                self._investigate(entry)
+        for entry in list(self.blocked.values()):
+            if entry.progress is _Progress.Expected:
+                entry.progress = _Progress.NoProgress
+            elif entry.progress is _Progress.NoProgress:
+                entry.progress = _Progress.Investigating
+                self._fetch(entry)
+        self._arm()
+
+    # -- home-shard recovery -------------------------------------------------
+    def _investigate(self, entry: _HomeEntry) -> None:
+        from ..coordinate.recover import maybe_recover
+        node = self.store.node
+        txn_id = entry.txn_id
+
+        def on_done(value, failure):
+            current = self.home.get(txn_id)
+            if current is not entry:
+                return
+            if failure is not None:
+                # peer unreachable or preempted: try again next scan
+                entry.progress = _Progress.NoProgress
+                node.agent.on_handled_exception(failure)
+            else:
+                outcome, info = value
+                if outcome == "progressed":
+                    if info is not None and not info > entry.token:
+                        # nobody is making progress; stay aggressive
+                        entry.progress = _Progress.NoProgress
+                    else:
+                        entry.progress = _Progress.Expected
+                    if info is not None:
+                        entry.token = entry.token.merge(info)
+                else:
+                    # recovered to a terminal outcome
+                    self.home.pop(txn_id, None)
+            self._arm()
+
+        maybe_recover(node, txn_id, entry.route, entry.token).begin(on_done)
+
+    # -- blocked-dependency fetch -------------------------------------------
+    def _fetch(self, entry: _BlockedEntry) -> None:
+        from ..coordinate.fetch_data import fetch_data
+        from ..local.status import Status
+        node = self.store.node
+        txn_id = entry.txn_id
+
+        def on_done(merged, failure):
+            current = self.blocked.get(txn_id)
+            if current is not entry:
+                return
+            if failure is not None:
+                entry.progress = _Progress.NoProgress
+                node.agent.on_handled_exception(failure)
+            elif merged is not None and (
+                    merged.save_status.status >= Status.PreApplied
+                    or merged.save_status.status is Status.Invalidated):
+                # outcome propagated locally: no longer blocked
+                self.blocked.pop(txn_id, None)
+            else:
+                # known but undecided: recovery is the home shard's job —
+                # kick it (ref: InformHomeOfTxn) and keep fetching until the
+                # outcome propagates to us
+                entry.progress = _Progress.NoProgress
+                if merged is not None and merged.route is not None:
+                    self._inform_home(txn_id, merged.route)
+            self._arm()
+
+        fetch_data(node, txn_id, entry.participants, txn_id.epoch()) \
+            .begin(on_done)
+
+    def _inform_home(self, txn_id: TxnId, route) -> None:
+        """Tell the home shard's replicas to track (and so recover) the txn
+        (ref: messages/InformOfTxnId.java / InformHomeOfTxn)."""
+        from ..messages.inform import InformOfTxnId
+        from ..primitives.keys import RoutingKeys
+        node = self.store.node
+        if route.home_key is None:
+            return
+        home = RoutingKeys.of(route.home_key)
+        topologies = node.topology().for_epoch(home, txn_id.epoch())
+        request = InformOfTxnId(txn_id, route)
+        for to in sorted(topologies.nodes()):
+            node.send(to, request)
+
+    # -- helpers -------------------------------------------------------------
+    def _track_home(self, safe, txn_id: TxnId) -> None:
+        cmd = safe.get(txn_id)
+        if cmd.route is None:
+            return
+        node = self.store.node
+        if not node.is_home_shard_replica(txn_id, cmd.route):
+            return
+        if txn_id not in self.home:
+            self.home[txn_id] = _HomeEntry(txn_id, cmd.route)
+        self._arm()
+
+    def _refresh(self, txn_id: TxnId) -> None:
+        entry = self.home.get(txn_id)
+        if entry is not None and entry.progress is not _Progress.Investigating:
+            entry.progress = _Progress.Expected
+
+    # -- ProgressLog hooks ---------------------------------------------------
+    def unwitnessed(self, safe, txn_id: TxnId) -> None:
+        self._track_home(safe, txn_id)
+
+    def pre_accepted(self, safe, txn_id: TxnId) -> None:
+        self._track_home(safe, txn_id)
+
+    def accepted(self, safe, txn_id: TxnId) -> None:
+        self._track_home(safe, txn_id)
+        self._refresh(txn_id)
+
+    def precommitted(self, safe, txn_id: TxnId) -> None:
+        self._refresh(txn_id)
+
+    def stable(self, safe, txn_id: TxnId) -> None:
+        self._track_home(safe, txn_id)
+        self._refresh(txn_id)
+        self.blocked.pop(txn_id, None)
+
+    def ready_to_execute(self, safe, txn_id: TxnId) -> None:
+        self._refresh(txn_id)
+
+    def executed(self, safe, txn_id: TxnId) -> None:
+        self._refresh(txn_id)
+
+    def durable_local(self, safe, txn_id: TxnId) -> None:
+        # applied locally; remains tracked until durable at a quorum
+        self._refresh(txn_id)
+        self.blocked.pop(txn_id, None)
+
+    def durable(self, safe, txn_id: TxnId) -> None:
+        self.home.pop(txn_id, None)
+        self.blocked.pop(txn_id, None)
+
+    def waiting(self, blocked_by: TxnId, blocked_until: int, route,
+                participants) -> None:
+        if participants is None or blocked_by in self.blocked:
+            return
+        self.blocked[blocked_by] = _BlockedEntry(blocked_by, participants)
+        self._arm()
+
+    def clear(self, txn_id: TxnId) -> None:
+        self.home.pop(txn_id, None)
+        self.blocked.pop(txn_id, None)
+
+
+def simple_progress_log_factory(scan_delay_micros: int = 500_000):
+    return lambda store: SimpleProgressLog(store, scan_delay_micros)

@@ -360,22 +360,24 @@ def initialise_waiting_on(safe: SafeCommandStore, txn_id: TxnId,
 
     waiting_on = WaitingOn.all_of(dep_ids)
     for d in dep_ids:
-        waiting_on = _maybe_clear_dep(safe, txn_id, execute_at, waiting_on, d)
+        waiting_on = _maybe_clear_dep(safe, txn_id, execute_at, waiting_on, d,
+                                      partial_deps)
     return waiting_on
 
 
 def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
                      execute_at: Timestamp, waiting_on: WaitingOn,
-                     dep: TxnId) -> WaitingOn:
+                     dep: TxnId, partial_deps: PartialDeps) -> WaitingOn:
     dep_cmd = safe.if_present(dep)
     if safe.redundant_before().status(dep, _dep_participants(safe, dep)) in (
             RedundantStatus.SHARD_REDUNDANT, RedundantStatus.PRE_BOOTSTRAP_OR_STALE):
         return waiting_on.with_done(dep, True)
     if dep_cmd is None:
-        # not yet witnessed locally: register a placeholder that will notify us
+        # not yet witnessed locally: register a placeholder that will notify
+        # us, and tell the progress log to fetch the blocker's state
         placeholder = Command(dep).with_listener(txn_id)
         safe.update(placeholder, notify=False)
-        _witness_transitively(safe, dep)
+        _report_blocker(safe, dep, partial_deps)
         return waiting_on
     if dep_cmd.is_invalidated() or dep_cmd.is_truncated() or dep_cmd.save_status is SaveStatus.Applied:
         return waiting_on.with_done(dep, True)
@@ -384,11 +386,20 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
         # executes after us: not our dependency (ref: updateWaitingOn)
         return waiting_on.with_done(dep, False)
     safe.update(dep_cmd.with_listener(txn_id), notify=False)
+    if not dep_cmd.has_been(Status.Stable):
+        # locally undecided: if this replica missed the Commit, only a fetch
+        # will unblock us (ref: NotifyWaitingOn -> ProgressLog.waiting)
+        _report_blocker(safe, dep, partial_deps)
     return waiting_on
 
 
-def _witness_transitively(safe: SafeCommandStore, dep: TxnId) -> None:
-    safe.progress_log().waiting(dep, 0, None, None)
+def _report_blocker(safe: SafeCommandStore, dep: TxnId,
+                    partial_deps: PartialDeps) -> None:
+    participants = partial_deps.participants(dep)
+    if participants is None or (hasattr(participants, "is_empty")
+                                and participants.is_empty()):
+        participants = _dep_participants(safe, dep)
+    safe.progress_log().waiting(dep, 0, None, participants)
 
 
 def _dep_participants(safe: SafeCommandStore, dep: TxnId):

@@ -44,8 +44,10 @@ class Node:
         self.random = random
         self.now_micros = now_micros
         self.local_config = local_config or api.LocalConfig()
-        self.progress_log_factory = (progress_log_factory
-                                     or (lambda store: api.NoOpProgressLog()))
+        if progress_log_factory is None:
+            from ..impl.progress_log import SimpleProgressLog
+            progress_log_factory = SimpleProgressLog
+        self.progress_log_factory = progress_log_factory
         self.topology_manager = TopologyManager(node_id)
         self.command_stores = CommandStores(self, num_stores)
         self._hlc = 0

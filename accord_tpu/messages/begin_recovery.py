@@ -48,10 +48,17 @@ class RecoverNack(Reply):
 
 
 class RecoverOk(Reply):
+    """Recovery vote.  Deps are reported in two tiers so the coordinator can
+    merge per-range (ref: LatestDeps): ``decided_deps`` are committed deps
+    for the ranges in ``decided_covering``; ``proposed_deps`` are
+    preaccept/accept-stage proposals for everything else."""
+
     type = MessageType.BEGIN_RECOVER_RSP
 
     def __init__(self, txn_id: TxnId, status: Status, accepted: Ballot,
-                 execute_at: Optional[Timestamp], deps: Deps, deps_decided: bool,
+                 execute_at: Optional[Timestamp],
+                 decided_deps: Deps, decided_covering: Ranges,
+                 proposed_deps: Deps,
                  earlier_committed_witness: Deps,
                  earlier_accepted_no_witness: Deps,
                  rejects_fast_path: bool, writes, result):
@@ -59,8 +66,9 @@ class RecoverOk(Reply):
         self.status = status
         self.accepted = accepted
         self.execute_at = execute_at
-        self.deps = deps
-        self.deps_decided = deps_decided      # deps are committed, not proposed
+        self.decided_deps = decided_deps
+        self.decided_covering = decided_covering
+        self.proposed_deps = proposed_deps
         self.earlier_committed_witness = earlier_committed_witness
         self.earlier_accepted_no_witness = earlier_accepted_no_witness
         self.rejects_fast_path = rejects_fast_path
@@ -157,17 +165,23 @@ class BeginRecovery(TxnRequest):
                 return RecoverNack(None)
 
             cmd = safe.get(txn_id)
-            deps_decided = cmd.known().deps.has_decided_deps() or \
-                cmd.status in (Status.Committed, Status.Stable,
-                               Status.PreApplied, Status.Applied)
-            if deps_decided and cmd.partial_deps is not None:
-                deps = Deps(cmd.partial_deps.key_deps, cmd.partial_deps.range_deps)
+            deps_decided = (cmd.known().deps.has_decided_deps()
+                            or cmd.status in (Status.Committed, Status.Stable,
+                                              Status.PreApplied, Status.Applied)) \
+                and cmd.partial_deps is not None
+            if deps_decided:
+                decided = Deps(cmd.partial_deps.key_deps,
+                               cmd.partial_deps.range_deps)
+                covering = owned
+                proposed = Deps.none()
             else:
                 local = calculate_partial_deps(safe, txn_id, partial_txn.keys,
                                                txn_id, owned)
                 prior = cmd.partial_deps
                 merged = (local if prior is None else local.with_partial(prior))
-                deps = Deps(merged.key_deps, merged.range_deps)
+                decided = Deps.none()
+                covering = Ranges.empty()
+                proposed = Deps(merged.key_deps, merged.range_deps)
 
             if cmd.has_been(Status.PreCommitted):
                 rejects, ecw, eanw = False, Deps.none(), Deps.none()
@@ -175,7 +189,7 @@ class BeginRecovery(TxnRequest):
                 rejects, ecw, eanw = _recovery_scans(safe, txn_id,
                                                      partial_txn.keys)
             return RecoverOk(txn_id, cmd.status, cmd.accepted, cmd.execute_at,
-                             deps, deps_decided, ecw, eanw, rejects,
+                             decided, covering, proposed, ecw, eanw, rejects,
                              cmd.writes, cmd.result)
 
         def reduce_fn(a, b):
@@ -192,8 +206,6 @@ class BeginRecovery(TxnRequest):
             if recovery_rank(b.status, b.accepted) > \
                     recovery_rank(a.status, a.accepted):
                 hi, lo = (b, a)
-            deps = hi.deps.with_(lo.deps) if hi.deps_decided == lo.deps_decided \
-                else (hi.deps if hi.deps_decided else lo.deps)
             ecw = hi.earlier_committed_witness.with_(lo.earlier_committed_witness)
             eanw = hi.earlier_accepted_no_witness.with_(
                 lo.earlier_accepted_no_witness).without(ecw.contains)
@@ -201,8 +213,11 @@ class BeginRecovery(TxnRequest):
             if hi.status is Status.PreAccepted and lo.execute_at is not None \
                     and (execute_at is None or lo.execute_at > execute_at):
                 execute_at = lo.execute_at
-            return RecoverOk(txn_id, hi.status, hi.accepted, execute_at, deps,
-                             hi.deps_decided or lo.deps_decided, ecw, eanw,
+            return RecoverOk(txn_id, hi.status, hi.accepted, execute_at,
+                             hi.decided_deps.with_(lo.decided_deps),
+                             hi.decided_covering.with_(lo.decided_covering),
+                             hi.proposed_deps.with_(lo.proposed_deps),
+                             ecw, eanw,
                              hi.rejects_fast_path or lo.rejects_fast_path,
                              hi.writes or lo.writes, hi.result or lo.result)
 

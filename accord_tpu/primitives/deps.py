@@ -168,6 +168,20 @@ class KeyDeps:
         idset = set(ids)
         return self.without(lambda t: t in idset)
 
+    def without_covered(self, covering: Ranges) -> "KeyDeps":
+        """Drop entries whose key lies inside ``covering`` (the complement of
+        slice())."""
+        if self.is_empty() or covering.is_empty():
+            return self
+        b = KeyDepsBuilder()
+        for k, row in enumerate(self._ranges_per_key):
+            token = self.keys[k]
+            if covering.contains_token(token):
+                continue
+            for j in row:
+                b.add(token, self.txn_ids[j])
+        return b.build()
+
     # -- CSR export (device format) -----------------------------------------
     def to_csr(self) -> Tuple[List[int], List[int], List[int]]:
         """Returns (key_tokens, end_offsets, txn_index_list) — the reference's
@@ -333,6 +347,17 @@ class RangeDeps:
                     b.add(r, t)
         return b.build()
 
+    def without_covered(self, covering: Ranges) -> "RangeDeps":
+        """Keep only the parts of each range outside ``covering``."""
+        if self.is_empty() or covering.is_empty():
+            return self
+        b = RangeDepsBuilder()
+        for r, row in zip(self.ranges, self._per_range):
+            for rest in Ranges.of(r).without(covering):
+                for j in row:
+                    b.add(rest, self.txn_ids[j])
+        return b.build()
+
     def to_csr(self) -> Tuple[List[int], List[int], List[int], List[int]]:
         """(starts, ends, end_offsets, txn_index_list)."""
         starts = [r.start for r in self.ranges]
@@ -440,6 +465,13 @@ class Deps:
 
     def without(self, pred: Callable[[TxnId], bool]) -> "Deps":
         return Deps(self.key_deps.without(pred), self.range_deps.without(pred))
+
+    def without_covered(self, covering: Ranges) -> "Deps":
+        """Drop the parts of this dep set that lie inside ``covering`` —
+        used to fill uncovered ranges with proposals when merging recovery
+        replies (decided deps win where they exist)."""
+        return Deps(self.key_deps.without_covered(covering),
+                    self.range_deps.without_covered(covering))
 
     def participants(self, txn_id: TxnId):
         """All participants (tokens + ranges) on which txn_id is a dep."""

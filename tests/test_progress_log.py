@@ -1,0 +1,129 @@
+"""Liveness: the progress log recovers stalled txns and fetches missed state
+without any manual intervention.
+
+Modelled on ref: impl/SimpleProgressLog.java behavior under the burn test's
+message-loss scenarios.
+"""
+
+import pytest
+
+from accord_tpu.messages.apply import Apply
+from accord_tpu.messages.commit import Commit
+from accord_tpu.sim.kvstore import kv_txn
+
+from tests.test_e2e_basic import make_cluster, submit
+
+
+def test_progress_log_recovers_dead_coordinator():
+    """Coordinator's Stable round is lost and it never retries: home-shard
+    replicas must notice and recover the txn to completion on their own."""
+    cluster = make_cluster(seed=41)
+    cluster.message_filter = (lambda s, d, r: isinstance(r, Commit) and s == 1)
+    out = []
+    cluster.nodes[1].coordinate(kv_txn([10], {10: ("auto",)})).begin(
+        lambda r, f: out.append((r, f)))
+    # run past the coordinator timeout with the filter still up, then heal
+    cluster.run_for(2_000_000)
+    assert out and out[0][1] is not None, "original coordinate should time out"
+    cluster.message_filter = None
+
+    # no manual recovery: the progress log must finish the txn
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    read = submit(cluster, 2, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert read[0][1] is None
+    assert read[0][0].reads == {10: ("auto",)}, \
+        "progress log failed to recover the orphaned txn"
+
+
+def test_progress_log_unblocks_missed_apply():
+    """A replica that missed Commit+Apply of T1 must fetch T1's outcome when
+    a later txn blocks on it, instead of stalling forever."""
+    cluster = make_cluster(seed=43)
+    # node 3 misses everything post-PreAccept for T1
+    cluster.message_filter = (lambda s, d, r:
+                              isinstance(r, (Commit, Apply)) and d == 3)
+    out1 = submit(cluster, 1, kv_txn([10], {10: ("t1",)}))
+    cluster.run_until_quiescent()
+    assert out1[0][1] is None, f"T1 should commit without node 3: {out1}"
+    cluster.message_filter = None
+
+    # T2 at node 3 depends on T1, which node 3 never saw commit
+    out2 = submit(cluster, 3, kv_txn([10], {10: ("t2",)}))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert out2 and out2[0][1] is None, f"T2 stalled: {out2}"
+    assert out2[0][0].reads == {10: ("t1",)}
+
+    read = submit(cluster, 2, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert read[0][0].reads == {10: ("t1", "t2")}
+
+
+def test_progress_log_quiesces_after_durable():
+    """After a healthy txn persists, no progress entries linger and the sim
+    reaches true quiescence (self-disarming timer)."""
+    cluster = make_cluster(seed=47)
+    out = submit(cluster, 1, kv_txn([10], {10: ("x",)}))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+    for node in cluster.nodes.values():
+        for store in node.command_stores.unsafe_all_stores():
+            pl = store.progress_log
+            assert not pl.home, f"leaked home entries: {pl.home}"
+            assert not pl.blocked, f"leaked blocked entries: {pl.blocked}"
+            assert pl._scheduled is None
+
+
+def test_inform_of_txn_starts_home_tracking():
+    """InformOfTxnId makes home-shard replicas track (and so recover) a txn
+    they only know by id (ref: messages/InformOfTxnId.java)."""
+    from accord_tpu.messages.commit import Commit
+    from accord_tpu.messages.inform import InformOfTxnId
+    cluster = make_cluster(seed=59)
+    cluster.message_filter = (lambda s, d, r: isinstance(r, Commit) and s == 1)
+    out = []
+    cluster.nodes[1].coordinate(kv_txn([10], {10: ("inf",)})).begin(
+        lambda r, f: out.append((r, f)))
+    cluster.run_for(1_500_000)
+    cluster.message_filter = None
+
+    # find the stalled txn and its route, clear all home tracking, then
+    # re-kick it purely via InformOfTxnId
+    tid = route = None
+    for node in cluster.nodes.values():
+        for store in node.command_stores.unsafe_all_stores():
+            store.progress_log.home.clear()
+            for tok, cfk in store.commands_for_key.items():
+                if tok == 10 and cfk.size():
+                    tid = cfk.txn_ids()[0]
+                    cmd = store.command_if_present(tid)
+                    if cmd is not None and cmd.route is not None:
+                        route = cmd.route
+    assert tid is not None and route is not None
+
+    cluster.nodes[2].send(2, InformOfTxnId(tid, route))
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    read = submit(cluster, 3, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert read[0][0].reads == {10: ("inf",)}, \
+        "InformOfTxnId did not lead to recovery"
+
+
+def test_progress_log_determinism():
+    def run(seed):
+        cluster = make_cluster(seed=seed)
+        cluster.message_filter = (lambda s, d, r: isinstance(r, Commit) and s == 1)
+        out = []
+        cluster.nodes[1].coordinate(kv_txn([10], {10: ("a",)})).begin(
+            lambda r, f: out.append((r, f)))
+        cluster.run_for(2_000_000)
+        cluster.message_filter = None
+        cluster.run_until_quiescent()
+        read = submit(cluster, 2, kv_txn([10], {}))
+        cluster.run_until_quiescent()
+        return read[0][0].reads, dict(cluster.stats)
+
+    assert run(53) == run(53)

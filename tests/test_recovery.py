@@ -16,7 +16,15 @@ from accord_tpu.local.status import SaveStatus, Status
 from accord_tpu.sim.kvstore import KVDataStore, KVResult, kv_txn
 from accord_tpu.sim.topology_factory import build_topology
 
-from tests.test_e2e_basic import make_cluster, submit
+from accord_tpu import api
+from tests.test_e2e_basic import make_cluster as _make_cluster, submit
+
+
+def make_cluster(**kw):
+    """Manual-recovery tests: disable the progress log so nothing recovers
+    behind the test's back."""
+    kw.setdefault("progress_log_factory", lambda store: api.NoOpProgressLog())
+    return _make_cluster(**kw)
 
 
 def _drop(cluster, pred):
@@ -235,6 +243,36 @@ def test_recovery_rank_ballot_tie_break():
     pre = FakeOk(Status.PreAccepted, Ballot.ZERO)
     assert _max_accepted_or_later([acc, inval, pre]) is inval
     assert _max_accepted_or_later([pre]) is None
+
+
+def test_merge_committed_deps_fills_uncovered_ranges():
+    """Decided deps win only for the ranges they cover; proposals must
+    survive for uncovered shards (two-shard txn, Commit reached one shard)."""
+    from accord_tpu.coordinate.recover import _merge_committed_deps
+    from accord_tpu.primitives.deps import Deps, DepsBuilder
+    from accord_tpu.primitives.keys import Ranges, Range
+    from accord_tpu.primitives.timestamp import Ballot, Domain, TxnId, TxnKind
+
+    dep_a = TxnId.create(1, 50, TxnKind.Write, Domain.Key, 2)
+    dep_b = TxnId.create(1, 60, TxnKind.Write, Domain.Key, 3)
+    decided = DepsBuilder().add_key(5, dep_a).build()     # shard A: tokens 0-10
+    proposed = DepsBuilder().add_key(5, dep_a).add_key(15, dep_b).build()
+
+    class Ok:
+        def __init__(self, dd, cov, pd):
+            self.decided_deps = dd
+            self.decided_covering = cov
+            self.proposed_deps = pd
+
+    oks = [Ok(decided, Ranges.single(0, 10), Deps.none()),
+           Ok(Deps.none(), Ranges.empty(), proposed)]
+    merged = _merge_committed_deps(oks, oks[0])
+    # decided entry kept; shard-B proposal (token 15, dep_b) NOT dropped
+    assert merged.contains(dep_a)
+    assert merged.contains(dep_b), "uncovered shard's proposal was lost"
+    # but the proposal duplicate inside covered ranges doesn't resurrect
+    # anything beyond the decided set for token 5
+    assert merged.key_deps.txn_ids_for(5) == [dep_a]
 
 
 def test_recovery_determinism():
