@@ -127,6 +127,18 @@ class DataStore(abc.ABC):
               fetch_ranges: FetchRanges) -> FetchResult:
         raise NotImplementedError
 
+    def snapshot(self, ranges: Ranges) -> object:
+        """Export the store's content for ``ranges`` (bootstrap donor side).
+        The return value is opaque to the framework — it is shipped to the
+        joining replica and handed to install_snapshot."""
+        raise NotImplementedError
+
+    def install_snapshot(self, snapshot: object) -> None:
+        """Install a snapshot exported by a peer's snapshot() (bootstrap
+        recipient side).  Must be idempotent and must keep any newer local
+        writes (per-key last-writer-wins on executeAt)."""
+        raise NotImplementedError
+
 
 # ---------------------------------------------------------------------------
 # Node-level callbacks

@@ -41,7 +41,8 @@ class CoordinateTransaction(api.Callback):
 
     def _start(self) -> async_chain.AsyncChain:
         request = PreAccept(self.txn_id, self.txn, self.route,
-                            self.topologies.current_epoch())
+                            self.topologies.current_epoch(),
+                            min_epoch=self.topologies.oldest_epoch())
         for to in sorted(self.tracker.nodes()):
             self.node.send(to, request, self)
         return self.result

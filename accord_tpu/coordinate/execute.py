@@ -78,7 +78,8 @@ class _ExecuteTxn(api.Callback):
         for to in sorted(self.stable_tracker.nodes()):
             request = Commit(CommitKind.Stable, self.txn_id, self.txn,
                              self.route, self.execute_at, self.deps,
-                             read=to in self.read_nodes, ballot=self.ballot)
+                             read=to in self.read_nodes, ballot=self.ballot,
+                             min_epoch=self.all_topologies.oldest_epoch())
             self.node.send(to, request, self)
         return self.result
 

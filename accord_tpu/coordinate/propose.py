@@ -44,7 +44,8 @@ class _Propose(api.Callback):
     def _start(self) -> async_chain.AsyncChain:
         request = Accept(self.txn_id, self.txn, self.route, self.ballot,
                          self.execute_at, self.deps,
-                         self.txn_id.epoch(), self.execute_at.epoch())
+                         self.topologies.oldest_epoch(),
+                         self.execute_at.epoch())
         for to in sorted(self.tracker.nodes()):
             self.node.send(to, request, self)
         return self.result

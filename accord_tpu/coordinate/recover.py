@@ -210,13 +210,13 @@ class Recover(api.Callback):
                 self.result.set_success(("invalidated", None))
                 return
             if status in (Status.Applied, Status.PreApplied):
-                deps = _merge_committed_deps(self.oks, max_ok)
+                deps = _merge_committed_deps(self.oks)
                 node.with_epoch(max_ok.execute_at.epoch(), lambda: (
                     _repersist(node, txn_id, self.txn, self.route,
                                max_ok, deps, self.result)))
                 return
             if status in (Status.Stable, Status.Committed, Status.PreCommitted):
-                deps = _merge_committed_deps(self.oks, max_ok)
+                deps = _merge_committed_deps(self.oks)
                 node.with_epoch(max_ok.execute_at.epoch(), lambda: (
                     execute(node, txn_id, self.txn, self.route,
                             max_ok.execute_at, deps, ballot=self.ballot)
@@ -299,7 +299,7 @@ def _max_accepted_or_later(oks: List[RecoverOk]) -> Optional[RecoverOk]:
     return best
 
 
-def _merge_committed_deps(oks: List[RecoverOk], max_ok: RecoverOk) -> Deps:
+def _merge_committed_deps(oks: List[RecoverOk]) -> Deps:
     """LatestDeps.mergeCommit: decided deps win for the ranges they cover;
     ranges with no decided coverage anywhere in the quorum fall back to the
     union of proposals (a safe superset) — never silently empty."""

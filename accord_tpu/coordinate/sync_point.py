@@ -1,0 +1,43 @@
+"""Sync points: barrier pseudo-transactions over key ranges.
+
+Rebuild of ref: accord-core/src/main/java/accord/coordinate/
+CoordinateSyncPoint.java:58, Barrier.java:58.  A sync point is a
+range-domain transaction with no read/write payload; its dependency set
+captures every earlier intersecting transaction, so its apply is proof that
+all of them are decided (and, for the coordinating node's reads, applied
+locally where the read leg ran).  ExclusiveSyncPoint additionally fences:
+later PreAccepts witness it and order after it.
+
+Used by epoch reconfiguration (each node syncs its new-epoch ranges before
+acking the epoch), bootstrap (fence before snapshot fetch), and durability
+scheduling.
+"""
+
+from __future__ import annotations
+
+from ..primitives.keys import Ranges
+from ..primitives.timestamp import Domain, TxnKind
+from ..primitives.txn import Txn
+from ..primitives.writes import SyncPoint
+from ..utils import async_chain
+
+
+def coordinate_sync_point(node, ranges: Ranges,
+                          exclusive: bool = True) -> async_chain.AsyncChain:
+    """Coordinate an (Exclusive)SyncPoint over ``ranges`` through the normal
+    consensus pipeline.  Settles with a SyncPoint handle once the barrier has
+    executed (every earlier intersecting txn is decided and applied at the
+    read quorum)."""
+    kind = TxnKind.ExclusiveSyncPoint if exclusive else TxnKind.SyncPoint
+    txn = Txn(kind, ranges, read=None)
+    result = async_chain.AsyncResult()
+    txn_id = node.next_txn_id(kind, Domain.Range)
+
+    def on_done(_value, failure):
+        if failure is not None:
+            result.set_failure(failure)
+        else:
+            result.set_success(SyncPoint(txn_id, None, None))
+
+    node.coordinate(txn, txn_id=txn_id).begin(on_done)
+    return result

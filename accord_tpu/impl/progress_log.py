@@ -38,22 +38,41 @@ class _Progress(enum.IntEnum):
 
 
 class _HomeEntry:
-    __slots__ = ("txn_id", "route", "progress", "token")
+    __slots__ = ("txn_id", "route", "progress", "token", "countdown", "backoff")
 
     def __init__(self, txn_id: TxnId, route):
         self.txn_id = txn_id
         self.route = route
         self.progress = _Progress.Expected
         self.token = ProgressToken.none()
+        self.countdown = 2   # scans before investigating
+        self.backoff = 2     # doubled on each fruitless investigation
+
+    def observed_progress(self) -> None:
+        self.progress = _Progress.Expected
+        self.countdown = 2
+        self.backoff = 2
+
+    def no_progress(self) -> None:
+        self.progress = _Progress.NoProgress
+        self.backoff = min(self.backoff * 2, 16)
+        self.countdown = self.backoff
 
 
 class _BlockedEntry:
-    __slots__ = ("txn_id", "participants", "progress")
+    __slots__ = ("txn_id", "participants", "progress", "countdown", "backoff")
 
     def __init__(self, txn_id: TxnId, participants):
         self.txn_id = txn_id
         self.participants = participants
         self.progress = _Progress.Expected
+        self.countdown = 2
+        self.backoff = 2
+
+    def no_progress(self) -> None:
+        self.progress = _Progress.NoProgress
+        self.backoff = min(self.backoff * 2, 16)
+        self.countdown = self.backoff
 
 
 class SimpleProgressLog(api.ProgressLog):
@@ -77,15 +96,22 @@ class SimpleProgressLog(api.ProgressLog):
         self._scheduled = None
         node = self.store.node
         for entry in list(self.home.values()):
-            if entry.progress is _Progress.Expected:
-                entry.progress = _Progress.NoProgress
-            elif entry.progress is _Progress.NoProgress:
+            if entry.progress is _Progress.Investigating:
+                continue
+            if entry.txn_id in node._coordinating:
+                # a live local coordinator is driving this txn — don't
+                # preempt ourselves (ref: progress log skips local owner)
+                entry.observed_progress()
+                continue
+            entry.countdown -= 1
+            if entry.countdown <= 0:
                 entry.progress = _Progress.Investigating
                 self._investigate(entry)
         for entry in list(self.blocked.values()):
-            if entry.progress is _Progress.Expected:
-                entry.progress = _Progress.NoProgress
-            elif entry.progress is _Progress.NoProgress:
+            if entry.progress is _Progress.Investigating:
+                continue
+            entry.countdown -= 1
+            if entry.countdown <= 0:
                 entry.progress = _Progress.Investigating
                 self._fetch(entry)
         self._arm()
@@ -101,19 +127,17 @@ class SimpleProgressLog(api.ProgressLog):
             if current is not entry:
                 return
             if failure is not None:
-                # peer unreachable or preempted: try again next scan
-                entry.progress = _Progress.NoProgress
+                # peer unreachable or preempted: back off, try again later
+                entry.no_progress()
                 node.agent.on_handled_exception(failure)
             else:
                 outcome, info = value
                 if outcome == "progressed":
-                    if info is not None and not info > entry.token:
-                        # nobody is making progress; stay aggressive
-                        entry.progress = _Progress.NoProgress
-                    else:
-                        entry.progress = _Progress.Expected
-                    if info is not None:
+                    if info is not None and info > entry.token:
                         entry.token = entry.token.merge(info)
+                        entry.observed_progress()
+                    else:
+                        entry.no_progress()
                 else:
                     # recovered to a terminal outcome
                     self.home.pop(txn_id, None)
@@ -133,7 +157,7 @@ class SimpleProgressLog(api.ProgressLog):
             if current is not entry:
                 return
             if failure is not None:
-                entry.progress = _Progress.NoProgress
+                entry.no_progress()
                 node.agent.on_handled_exception(failure)
             elif merged is not None and (
                     merged.save_status.status >= Status.PreApplied
@@ -144,7 +168,7 @@ class SimpleProgressLog(api.ProgressLog):
                 # known but undecided: recovery is the home shard's job —
                 # kick it (ref: InformHomeOfTxn) and keep fetching until the
                 # outcome propagates to us
-                entry.progress = _Progress.NoProgress
+                entry.no_progress()
                 if merged is not None and merged.route is not None:
                     self._inform_home(txn_id, merged.route)
             self._arm()
@@ -181,7 +205,7 @@ class SimpleProgressLog(api.ProgressLog):
     def _refresh(self, txn_id: TxnId) -> None:
         entry = self.home.get(txn_id)
         if entry is not None and entry.progress is not _Progress.Investigating:
-            entry.progress = _Progress.Expected
+            entry.observed_progress()
 
     # -- ProgressLog hooks ---------------------------------------------------
     def unwitnessed(self, safe, txn_id: TxnId) -> None:

@@ -1,0 +1,122 @@
+"""Bootstrap: adoption of newly-owned ranges after reconfiguration.
+
+Rebuild of ref: accord-core/src/main/java/accord/local/Bootstrap.java:81
+(design comment :30-60).  When an epoch grants this node ranges it did not
+previously replicate:
+
+1. Mark ``bootstrapped_at`` in RedundantBefore for the ranges — transactions
+   below the watermark are pre-bootstrap: excluded from deps, and their
+   writes are NOT applied locally (the snapshot covers them).
+2. Fence with an ExclusiveSyncPoint over the ranges: every earlier txn is
+   decided, and applied wherever the sync point's read leg ran.
+3. Fetch a DataStore snapshot from a donor replica of the previous epoch
+   and install it.
+4. Mark the ranges safe to read; until then reads are Nacked so the
+   coordinator uses another replica (ref: safeToRead smearing,
+   local/CommandStore.java:159-176).
+
+Each attempt retries with the next donor on failure
+(ref: Bootstrap.Attempt + Agent.onFailedBootstrap).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import api
+from ..primitives.keys import Ranges
+from ..primitives.timestamp import Domain, TxnId, TxnKind
+
+
+class Bootstrap:
+    """One bootstrap attempt set for one store's added ranges."""
+
+    def __init__(self, store, ranges: Ranges, epoch: int):
+        self.store = store
+        self.node = store.node
+        self.ranges = ranges
+        self.epoch = epoch
+        self.done = False
+
+    def start(self) -> None:
+        node = self.node
+        # 1. watermark: earlier txns are satisfied by the snapshot
+        bootstrapped_at = TxnId.from_timestamp(
+            node.unique_now(), TxnKind.ExclusiveSyncPoint, Domain.Range)
+        self.store.redundant_before.add_bootstrapped(self.ranges, bootstrapped_at)
+        self.store.bootstrapping = self.store.bootstrapping.with_(self.ranges)
+        # 2. fence
+        from ..coordinate.sync_point import coordinate_sync_point
+        coordinate_sync_point(node, self.ranges, exclusive=True) \
+            .begin(self._on_fenced)
+
+    def _on_fenced(self, _sync_point, failure) -> None:
+        if failure is not None:
+            self.node.agent.on_failed_bootstrap("fence", self.ranges,
+                                                self._retry, failure)
+            return
+        donors = self._donors()
+        if not donors:
+            # no prior-epoch replicas exist (fresh keyspace): trivially done
+            self._complete()
+            return
+        self._fetch(donors, self.ranges)
+
+    def _donors(self) -> List[int]:
+        """Replicas of these ranges in the previous epoch, preferring nodes
+        other than ourselves."""
+        prev_epoch = self.epoch - 1
+        manager = self.node.topology()
+        if not manager.has_epoch(prev_epoch):
+            return []
+        prev = manager.get_topology_for_epoch(prev_epoch)
+        donors: List[int] = []
+        for shard in prev.for_selection(self.ranges):
+            for n in shard.nodes:
+                if n != self.node.node_id and n not in donors:
+                    donors.append(n)
+        return donors
+
+    def _fetch(self, donors: List[int], remaining: Ranges) -> None:
+        """Fetch ``remaining`` from donors in turn; each donor may cover only
+        part, so iterate until nothing remains.  Exhausting the donor list
+        with data still missing is a FAILURE and retries — never a silent
+        completion."""
+        from ..messages.fetch_snapshot import FetchSnapshot, FetchSnapshotOk
+        node = self.node
+        if remaining.is_empty():
+            self._complete()
+            return
+        if not donors:
+            self.node.agent.on_failed_bootstrap(
+                "fetch", remaining, self._retry,
+                RuntimeError(f"all donors exhausted with {remaining} missing"))
+            return
+        donor, rest = donors[0], donors[1:]
+        outer = self
+
+        class Cb(api.Callback):
+            def on_success(self, from_id: int, reply) -> None:
+                if outer.done:
+                    return
+                if isinstance(reply, FetchSnapshotOk):
+                    node.data_store.install_snapshot(reply.snapshot)
+                    outer._fetch(rest, remaining.without(reply.covered))
+                else:
+                    outer._fetch(rest, remaining)
+
+            def on_failure(self, from_id: int, failure: BaseException) -> None:
+                if outer.done:
+                    return
+                node.agent.on_handled_exception(failure)
+                outer._fetch(rest, remaining)
+
+        node.send(donor, FetchSnapshot(remaining, self.epoch - 1), Cb())
+
+    def _complete(self) -> None:
+        self.done = True
+        self.store.bootstrapping = self.store.bootstrapping.without(self.ranges)
+
+    def _retry(self) -> None:
+        if not self.done:
+            self.node.scheduler.once(500_000, self.start)

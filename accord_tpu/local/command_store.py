@@ -117,6 +117,10 @@ class CommandStore:
         self.max_conflicts = MaxConflicts()
         self.redundant_before = RedundantBefore()
         self.durable_before = DurableBefore()
+        # ranges adopted this epoch whose snapshot has not yet arrived —
+        # reads are Nacked until clear (ref: safeToRead,
+        # local/CommandStore.java:159-176)
+        self.bootstrapping: Ranges = Ranges.empty()
         self.reject_before: Optional[ReducingRangeMap] = None
         self._queue: List[Callable[[], None]] = []
         self._draining = False
@@ -420,19 +424,37 @@ class CommandStores:
 
     # -- topology -----------------------------------------------------------
     def update_topology(self, topology, epoch: Optional[int] = None) -> None:
-        """Assign this node's owned ranges across stores
-        (ref: CommandStores.updateTopology :401-482).  Ranges are split
-        evenly by token span (ShardDistributor.EvenSplit analogue)."""
+        """Assign this node's owned ranges across stores and bootstrap any
+        newly-adopted ranges (ref: CommandStores.updateTopology :401-482).
+
+        Assignment is STICKY: ranges a store already holds never migrate to
+        a sibling store (moving them would spuriously re-bootstrap data this
+        node already serves); only net-new ranges are distributed, evenly by
+        token span (ShardDistributor.EvenSplit analogue)."""
         epoch = epoch if epoch is not None else topology.epoch
         owned = topology.ranges_for_node(self.node.node_id)
-        if not self.stores:
+        first = not self.stores
+        if first:
             for _ in range(self.num_stores):
                 store = CommandStore(self._next_id, self.node)
                 self._next_id += 1
                 self.stores.append(store)
-        chunks = self._split(owned, len(self.stores))
-        for store, chunk in zip(self.stores, chunks):
-            store.ranges_for_epoch.snapshot(epoch, chunk)
+            for store, chunk in zip(self.stores,
+                                    self._split(owned, len(self.stores))):
+                store.ranges_for_epoch.snapshot(epoch, chunk)
+            return
+
+        prev_union = Ranges.empty()
+        for store in self.stores:
+            prev_union = prev_union.with_(store.ranges_for_epoch.current())
+        net_new = owned.without(prev_union)
+        new_chunks = self._split(net_new, len(self.stores))
+        for store, extra in zip(self.stores, new_chunks):
+            retained = store.ranges_for_epoch.current().intersecting(owned)
+            store.ranges_for_epoch.snapshot(epoch, retained.with_(extra))
+            if not extra.is_empty():
+                from .bootstrap import Bootstrap
+                Bootstrap(store, extra, epoch).start()
 
     @staticmethod
     def _split(ranges: Ranges, n: int) -> List[Ranges]:
@@ -449,9 +471,12 @@ class CommandStores:
                 chunks[i].append(Range(start, start + take))
                 start += take
                 budget -= take
-                if budget == 0 and i < n - 1:
-                    i += 1
-                    budget = per
+                if budget == 0:
+                    if i < n - 1:
+                        i += 1
+                        budget = per
+                    else:
+                        budget = total  # remainder all lands in the last chunk
         return [Ranges(c) for c in chunks]
 
     # -- scatter-gather -----------------------------------------------------
@@ -484,6 +509,16 @@ class CommandStores:
             return async_chain.success(None)
         chains = [s.execute(context, map_fn) for s in stores]
         return async_chain.reduce(chains, reduce_fn)
+
+    def unavailable_for_read(self, participants) -> bool:
+        """Safe-to-read gate: any intersecting store still bootstrapping its
+        snapshot cannot serve reads (ref: safeToRead,
+        local/CommandStore.java:159-176)."""
+        for s in self.stores:
+            if not s.bootstrapping.is_empty() and \
+                    participants.intersects(s.bootstrapping):
+                return True
+        return False
 
     def unsafe_all_stores(self) -> List[CommandStore]:
         return list(self.stores)

@@ -369,7 +369,11 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
                      execute_at: Timestamp, waiting_on: WaitingOn,
                      dep: TxnId, partial_deps: PartialDeps) -> WaitingOn:
     dep_cmd = safe.if_present(dep)
-    if safe.redundant_before().status(dep, _dep_participants(safe, dep)) in (
+    # the dep set itself records where the dep participates — essential for
+    # deps we never witnessed locally (pre-bootstrap: the snapshot covers
+    # them, so they must clear instantly, not trigger a fetch)
+    participants = _resolve_dep_participants(safe, dep, partial_deps)
+    if safe.redundant_before().status(dep, participants) in (
             RedundantStatus.SHARD_REDUNDANT, RedundantStatus.PRE_BOOTSTRAP_OR_STALE):
         return waiting_on.with_done(dep, True)
     if dep_cmd is None:
@@ -393,13 +397,20 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
     return waiting_on
 
 
+def _resolve_dep_participants(safe: SafeCommandStore, dep: TxnId,
+                              partial_deps: PartialDeps):
+    """Where does ``dep`` participate: from the dep set itself, else from
+    its locally-known route."""
+    participants = partial_deps.participants(dep)
+    if participants.is_empty():
+        participants = _dep_participants(safe, dep)
+    return participants
+
+
 def _report_blocker(safe: SafeCommandStore, dep: TxnId,
                     partial_deps: PartialDeps) -> None:
-    participants = partial_deps.participants(dep)
-    if participants is None or (hasattr(participants, "is_empty")
-                                and participants.is_empty()):
-        participants = _dep_participants(safe, dep)
-    safe.progress_log().waiting(dep, 0, None, participants)
+    safe.progress_log().waiting(
+        dep, 0, None, _resolve_dep_participants(safe, dep, partial_deps))
 
 
 def _dep_participants(safe: SafeCommandStore, dep: TxnId):
@@ -445,6 +456,12 @@ def maybe_execute(safe: SafeCommandStore, txn_id: TxnId,
 def _apply_writes(safe: SafeCommandStore, cmd: Command) -> None:
     store = safe.store
     owned = safe.ranges(cmd.execute_at.epoch())
+    # pre-bootstrap txns' writes are covered by the bootstrap snapshot;
+    # applying them here could go back in time vs the snapshot
+    # (ref: Commands.applyRanges / RedundantBefore preBootstrap)
+    pre_bootstrap = safe.redundant_before().pre_bootstrap_ranges(cmd.txn_id)
+    if not pre_bootstrap.is_empty():
+        owned = owned.without(pre_bootstrap)
 
     def on_done(_result, failure):
         if failure is not None:

@@ -52,6 +52,7 @@ class Node:
         self.command_stores = CommandStores(self, num_stores)
         self._hlc = 0
         self._coordinating: Dict[TxnId, object] = {}  # active coordinations
+        self._pending_topologies: Dict[int, Topology] = {}  # out-of-order epochs
 
     # -- time (ref: Node.java:341-366) --------------------------------------
     def unique_now(self) -> Timestamp:
@@ -80,11 +81,53 @@ class Node:
         return self.topology_manager
 
     def on_topology_update(self, topology: Topology) -> None:
-        """(ref: Node.java:247 ConfigurationService.Listener)."""
+        """(ref: Node.java:247 ConfigurationService.Listener).  Epochs must
+        be ingested contiguously; later epochs arriving early are buffered."""
         if self.topology_manager.has_epoch(topology.epoch):
             return
+        known = self.topology_manager.epoch()
+        if known != 0 and topology.epoch > known + 1:
+            self._pending_topologies[topology.epoch] = topology
+            self.config_service.fetch_topology_for_epoch(known + 1)
+            return
+        first = known == 0
         self.topology_manager.on_topology_update(topology)
         self.command_stores.update_topology(topology)
+        if not first:
+            self._start_epoch_sync(topology)
+        nxt = self._pending_topologies.pop(topology.epoch + 1, None)
+        if nxt is not None:
+            self.on_topology_update(nxt)
+
+    def _start_epoch_sync(self, topology: Topology) -> None:
+        """Fence the new epoch: an ExclusiveSyncPoint over our owned ranges
+        captures every in-flight earlier txn; once it executes, this node's
+        view is caught up and it acks the epoch so coordination can use the
+        new topology's fast path (ref: TopologyManager epoch sync,
+        CommandStores.updateTopology sync leg)."""
+        from ..coordinate.sync_point import coordinate_sync_point
+        epoch = topology.epoch
+        owned = topology.ranges_for_node(self.node_id)
+        if owned.is_empty():
+            self._ack_epoch(epoch)
+            return
+
+        def on_done(_sp, failure):
+            if failure is not None:
+                # jittered backoff: a preempted sync point is being finished
+                # by someone's recovery — don't stampede with a fresh one
+                self.agent.on_handled_exception(failure)
+                delay = 1_000_000 + self.random.next_int(1_000_000)
+                self.scheduler.once(delay,
+                                    lambda: self._start_epoch_sync(topology))
+            else:
+                self._ack_epoch(epoch)
+
+        coordinate_sync_point(self, owned, exclusive=True).begin(on_done)
+
+    def _ack_epoch(self, epoch: int) -> None:
+        self.topology_manager.on_epoch_sync_complete(self.node_id, epoch)
+        self.config_service.acknowledge_epoch(api.EpochReady.done(epoch))
 
     def with_epoch(self, epoch: int, fn: Callable[[], None]) -> None:
         """Run fn once the epoch's topology is known (ref: Node.java:296-329)."""

@@ -131,6 +131,16 @@ class RedundantBefore:
             return TxnId.NONE
         return max(e.redundant_before, e.bootstrapped_at)
 
+    def pre_bootstrap_ranges(self, txn_id: TxnId) -> Ranges:
+        """Ranges where txn_id predates the bootstrap watermark — its writes
+        are covered by the bootstrap snapshot and must NOT be applied locally
+        (ref: RedundantBefore preBootstrap / Commands.applyRanges)."""
+        def fold(entry, start, end, acc):
+            if txn_id < entry.bootstrapped_at:
+                acc.append(Range(start, end))
+            return acc
+        return Ranges(self._map.fold_with_bounds(fold, []))
+
 
 class DurableBefore:
     """Global durability watermarks per range: {majority, universal}

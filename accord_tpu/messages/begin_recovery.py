@@ -252,6 +252,7 @@ class WaitOnCommit(TxnRequest):
     Used by recovery to wait out earlier_accepted_no_witness txns."""
 
     type = MessageType.WAIT_ON_COMMIT_REQ
+    is_slow_read = True   # replies when the txn commits locally
 
     def __init__(self, txn_id: TxnId, participants):
         from ..primitives.keys import Route as _Route

@@ -71,6 +71,7 @@ class Commit(TxnRequest):
         self.execute_at = execute_at
         self.deps = deps                # full Deps
         self.read = read
+        self.is_slow_read = read      # fused read replies at execution time
         self.min_epoch = min_epoch if min_epoch is not None else txn_id.epoch()
         self.ballot = ballot
 
@@ -122,6 +123,9 @@ class Commit(TxnRequest):
         txn_id = self.txn_id
         stores = node.command_stores.intersecting(
             self.route.participants, self.min_epoch, self.execute_at.epoch())
+        if node.command_stores.unavailable_for_read(self.route.participants):
+            node.reply(from_id, reply_context, ReadNack("Unavailable"))
+            return
         chains = [s.execute(PreLoadContext.for_txn(txn_id),
                             lambda safe: read_on_store(safe, txn_id))
                   for s in stores]

@@ -1,0 +1,63 @@
+"""Bootstrap snapshot transfer.
+
+Rebuild of ref: accord-core/src/main/java/accord/impl/
+AbstractFetchCoordinator.java:59 (FetchRequest/FetchResponse) — the data
+plane of bootstrap: a joining replica asks a donor for its DataStore content
+over the adopted ranges.  The control-plane fence (ExclusiveSyncPoint before
+the fetch) lives in local/bootstrap.py.
+"""
+
+from __future__ import annotations
+
+from ..primitives.keys import Ranges
+from .base import MessageType, Reply, Request
+
+
+class FetchSnapshotOk(Reply):
+    type = MessageType.FETCH_DATA_RSP
+
+    def __init__(self, snapshot, covered: Ranges):
+        self.snapshot = snapshot
+        self.covered = covered   # the sub-ranges this donor actually holds
+
+    def is_ok(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"FetchSnapshotOk(covered={self.covered})"
+
+
+class FetchSnapshotNack(Reply):
+    type = MessageType.FETCH_DATA_RSP
+
+    def is_ok(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return "FetchSnapshotNack"
+
+
+class FetchSnapshot(Request):
+    """(ref: AbstractFetchCoordinator.FetchRequest)."""
+
+    type = MessageType.FETCH_DATA_REQ
+
+    def __init__(self, ranges: Ranges, epoch: int):
+        self.ranges = ranges
+        self.epoch = epoch
+        self.wait_for_epoch = epoch
+
+    def process(self, node, from_id: int, reply_context) -> None:
+        owned = node.topology().get_topology_for_epoch(self.epoch) \
+            .ranges_for_node(node.node_id)
+        covered = self.ranges.intersecting(owned)
+        if covered.is_empty():
+            node.reply(from_id, reply_context, FetchSnapshotNack())
+            return
+        # a donor may hold only part of the request: it reports exactly what
+        # it covered so the joiner fetches the remainder elsewhere
+        snapshot = node.data_store.snapshot(covered)
+        node.reply(from_id, reply_context, FetchSnapshotOk(snapshot, covered))
+
+    def __repr__(self):
+        return f"FetchSnapshot({self.ranges}@{self.epoch})"

@@ -75,14 +75,19 @@ class PreAccept(TxnRequest):
 
     type = MessageType.PRE_ACCEPT_REQ
 
-    def __init__(self, txn_id: TxnId, txn: Txn, route: Route, max_epoch: int):
+    def __init__(self, txn_id: TxnId, txn: Txn, route: Route, max_epoch: int,
+                 min_epoch: Optional[int] = None):
         super().__init__(txn_id, route, max_epoch)
         self.txn = txn
         self.max_epoch = max_epoch
+        # during reconfiguration the coordinator contacts prior-epoch
+        # replicas too (dual quorum, ref: PreAccept.java:109-114); they only
+        # intersect at their old-epoch ranges
+        self.min_epoch = min_epoch if min_epoch is not None else txn_id.epoch()
 
     def process(self, node, from_id: int, reply_context) -> None:
         txn_id, txn, route = self.txn_id, self.txn, self.route
-        min_epoch = txn_id.epoch()
+        min_epoch = self.min_epoch
 
         def map_fn(safe: SafeCommandStore):
             owned = safe.store.ranges_for_epoch.all_between(min_epoch, self.max_epoch)

@@ -130,6 +130,7 @@ class ReadTxnData(TxnRequest):
     """Standalone read verb (ref: messages/ReadTxnData.java)."""
 
     type = MessageType.READ_REQ
+    is_slow_read = True   # replies when the drain releases the txn
 
     def __init__(self, txn_id: TxnId, route: Route, execute_at_epoch: int):
         super().__init__(txn_id, route, execute_at_epoch)
@@ -141,6 +142,11 @@ class ReadTxnData(TxnRequest):
             self.route.participants, txn_id.epoch(), self.execute_at_epoch)
         if not stores:
             node.reply(from_id, reply_context, ReadNack("NotOwned"))
+            return
+        # bootstrap gate: adopted ranges are unreadable until their snapshot
+        # lands — Nack so the coordinator reads another replica
+        if node.command_stores.unavailable_for_read(self.route.participants):
+            node.reply(from_id, reply_context, ReadNack("Unavailable"))
             return
         chains = [s.execute(PreLoadContext.for_txn(txn_id),
                             lambda safe: read_on_store(safe, txn_id))

@@ -121,6 +121,11 @@ class NodeSink(api.MessageSink):
         self._callbacks[cid] = callback
         self.cluster.route_request(self.node_id, to, request, callback_id=cid)
         timeout = self.cluster.request_timeout_micros
+        # barrier reads (sync points, commit-fused reads, WaitOnCommit) reply
+        # only when the replica's drain releases them — give them room before
+        # declaring the replica dead (ref: Maelstrom sink's per-type sweeper)
+        if getattr(request, "is_slow_read", False):
+            timeout *= 10
 
         def on_timeout():
             cb = self._callbacks.pop(cid, None)
@@ -229,8 +234,15 @@ class Cluster:
         self.failures: List[BaseException] = []
         self.mean_latency_micros = mean_latency_micros
         self.request_timeout_micros = request_timeout_micros
+        self._data_store_factory = data_store_factory
+        self._progress_log_factory = progress_log_factory
+        self._num_stores = num_stores
         self.partitioned: Set[frozenset] = set()  # pairs that cannot talk
         self.drop_probability = 0.0
+        # per-directed-link FIFO floor: messages on one link never reorder
+        # (TCP-like; multi-part replies such as CommitOk-then-ReadOk rely on
+        # it).  Latency stays random ACROSS links.
+        self._link_last: Dict[tuple, int] = {}
         # test hook (ref: test NetworkFilter): return True to drop a request
         self.message_filter: Optional[Callable[[int, int, object], bool]] = None
         self.stats: Dict[str, int] = {}
@@ -268,6 +280,13 @@ class Cluster:
                 return Action.DROP
         return Action.DELIVER
 
+    def _deliver_at(self, src: int, dst: int) -> int:
+        at = self.queue.now + (self._latency() if src != dst else 0)
+        key = (src, dst)
+        at = max(at, self._link_last.get(key, 0))
+        self._link_last[key] = at
+        return at
+
     def route_request(self, src: int, dst: int, request, callback_id: int) -> None:
         self.stats[type(request).__name__] = self.stats.get(type(request).__name__, 0) + 1
         action = self._action(src, dst)
@@ -276,18 +295,53 @@ class Cluster:
         if self.message_filter is not None and self.message_filter(src, dst, request):
             return
         ctx = _ReplyContext(src, callback_id)
-        at = self.queue.now + (self._latency() if src != dst else 0)
-        self.queue.add(at, lambda: self.nodes[dst].receive(request, src, ctx))
+        self.queue.add(self._deliver_at(src, dst),
+                       lambda: self.nodes[dst].receive(request, src, ctx))
 
     def route_reply(self, src: int, dst: int, ctx: _ReplyContext, reply) -> None:
         self.stats[type(reply).__name__] = self.stats.get(type(reply).__name__, 0) + 1
         if self._action(src, dst) is Action.DROP:
             return
-        at = self.queue.now + (self._latency() if src != dst else 0)
-        self.queue.add(at, lambda: self.sinks[dst].deliver_reply(src, ctx, reply))
+        self.queue.add(self._deliver_at(src, dst),
+                       lambda: self.sinks[dst].deliver_reply(src, ctx, reply))
 
     def schedule_at_node(self, node_id: int, fn: Callable[[], None]) -> None:
         self.queue.add(self.queue.now, fn)
+
+    # -- reconfiguration ----------------------------------------------------
+    def add_topology(self, topology: Topology) -> None:
+        """Introduce a new epoch: every node learns it (simulated delivery),
+        updates its stores, bootstraps added ranges, syncs, and acks
+        (ref: Cluster topology updates + TopologyRandomizer delivery)."""
+        assert topology.epoch == self.topologies[-1].epoch + 1
+        self.topologies.append(topology)
+        for nid in topology.nodes() | set(self.nodes):
+            node = self.nodes.get(nid)
+            if node is None:
+                # a genuinely new node joins the cluster
+                node = self._add_node(nid)
+            self.queue.add(self.queue.now + self._latency(),
+                           lambda n=node: n.on_topology_update(topology))
+
+    def _add_node(self, nid: int) -> Node:
+        scheduler = SimScheduler(self.queue)
+        sink = NodeSink(nid, self)
+        self.sinks[nid] = sink
+        data_store = (self._data_store_factory(nid) if self._data_store_factory
+                      else _NullDataStore())
+        node = Node(node_id=nid, message_sink=sink,
+                    config_service=SimConfigService(self, nid),
+                    scheduler=scheduler, data_store=data_store,
+                    agent=SimAgent(self), random=self.random.fork(),
+                    now_micros=lambda: self.queue.now,
+                    progress_log_factory=self._progress_log_factory,
+                    num_stores=self._num_stores)
+        self.nodes[nid] = node
+        # the joiner must know prior epochs to pick bootstrap donors
+        for t in self.topologies:
+            self.queue.add(self.queue.now,
+                           lambda tt=t, n=node: n.on_topology_update(tt))
+        return node
 
     # -- partitions / chaos -------------------------------------------------
     def partition(self, a: int, b: int) -> None:

@@ -28,6 +28,15 @@ class KVDataStore(api.DataStore):
         entry = self.data.get(token)
         return entry[0] if entry is not None else ()
 
+    def snapshot(self, ranges: Ranges) -> Dict[int, Tuple[tuple, Timestamp]]:
+        return {t: v for t, v in self.data.items() if ranges.contains_token(t)}
+
+    def install_snapshot(self, snapshot: Dict[int, Tuple[tuple, Timestamp]]) -> None:
+        for token, (value, at) in snapshot.items():
+            mine = self.data.get(token)
+            if mine is None or mine[1] < at:
+                self.data[token] = (value, at)
+
     def apply_append(self, token: int, values: tuple,
                      execute_at: Timestamp) -> None:
         entry = self.data.get(token)

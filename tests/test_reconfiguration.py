@@ -1,0 +1,157 @@
+"""Topology reconfiguration: epoch sync, bootstrap, node replacement.
+
+Modelled on ref: accord-core/src/test/java/accord/coordinate/
+TopologyChangeTest.java + the burn test's TopologyRandomizer scenarios.
+"""
+
+import pytest
+
+from accord_tpu.sim.kvstore import kv_txn
+from accord_tpu.sim.topology_factory import build_topology
+
+from tests.test_e2e_basic import make_cluster, submit
+
+
+def test_epoch_sync_completes():
+    """A new epoch with unchanged membership syncs at every node."""
+    cluster = make_cluster(seed=61)
+    out = submit(cluster, 1, kv_txn([10], {10: ("pre",)}))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+
+    topo2 = build_topology(2, (1, 2, 3), 3, 4)
+    cluster.add_topology(topo2)
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    for node in cluster.nodes.values():
+        assert node.topology().epoch() == 2
+        assert node.topology().is_sync_complete(2), \
+            f"node {node.node_id} never synced epoch 2"
+
+    out = submit(cluster, 2, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert out[0][0].reads == {10: ("pre",)}
+
+
+def test_node_replacement_bootstraps_data():
+    """Node 4 replaces node 3: it must bootstrap all data and serve
+    consistent reads; node 3's copy is no longer consulted."""
+    cluster = make_cluster(seed=67)
+    for i in range(4):
+        out = submit(cluster, 1 + i % 3, kv_txn([i * 10], {i * 10: (f"v{i}",)}))
+        cluster.run_until_quiescent()
+        assert out[0][1] is None
+
+    topo2 = build_topology(2, (1, 2, 4), 3, 4)
+    cluster.add_topology(topo2)
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    node4 = cluster.nodes[4]
+    assert node4.topology().epoch() == 2
+    for store in node4.command_stores.unsafe_all_stores():
+        assert store.bootstrapping.is_empty(), \
+            f"store {store.store_id} still bootstrapping {store.bootstrapping}"
+
+    # reads at the new node see all pre-reconfiguration writes
+    for i in range(4):
+        out = submit(cluster, 4, kv_txn([i * 10], {}))
+        cluster.run_until_quiescent()
+        assert out[0][1] is None, f"read {i} failed: {out}"
+        assert out[0][0].reads == {i * 10: (f"v{i}",)}
+
+
+def test_writes_across_reconfiguration():
+    """Writes before, during, and after the epoch change all land exactly
+    once and in order."""
+    cluster = make_cluster(seed=71)
+    key = 50
+    n = 0
+    for _ in range(3):
+        out = submit(cluster, 1 + n % 3, kv_txn([key], {key: (f"w{n}",)}))
+        cluster.run_until_quiescent()
+        assert out[0][1] is None
+        n += 1
+
+    topo2 = build_topology(2, (1, 2, 4), 3, 4)
+    cluster.add_topology(topo2)
+    # do NOT quiesce: submit while the reconfiguration is in flight
+    mid = []
+    cluster.nodes[1].coordinate(kv_txn([key], {key: (f"w{n}",)})).begin(
+        lambda r, f: mid.append((r, f)))
+    n += 1
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    assert mid and mid[0][1] is None, f"mid-reconfig write failed: {mid}"
+
+    for _ in range(2):
+        out = submit(cluster, 4 if n % 2 else 2, kv_txn([key], {key: (f"w{n}",)}))
+        cluster.run_until_quiescent()
+        assert out[0][1] is None
+        n += 1
+
+    out = submit(cluster, 4, kv_txn([key], {}))
+    cluster.run_until_quiescent()
+    assert out[0][0].reads == {key: tuple(f"w{i}" for i in range(n))}
+
+
+def test_grow_cluster_rf_increase():
+    """rf 2->3 with a node join: new replicas bootstrap, reads stay right."""
+    cluster = make_cluster(seed=73, nodes=(1, 2), rf=2, shards=2)
+    out = submit(cluster, 1, kv_txn([10], {10: ("a",)}))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+
+    topo2 = build_topology(2, (1, 2, 3), 3, 2)
+    cluster.add_topology(topo2)
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+
+    out = submit(cluster, 3, kv_txn([10], {10: ("b",)}))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+    out = submit(cluster, 3, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert out[0][0].reads == {10: ("a", "b")}
+
+
+def test_bootstrap_from_partial_donors():
+    """With rf < cluster size, no single donor holds all adopted ranges: the
+    joiner must stitch its snapshot from several donors (per-donor covered
+    ranges), never silently completing with missing data."""
+    cluster = make_cluster(seed=83, nodes=(1, 2, 3), rf=2, shards=3)
+    # keys spread across all three shards
+    for i, key in enumerate((100, 400_000, 800_000)):
+        out = submit(cluster, 1 + i % 3, kv_txn([key], {key: (f"v{i}",)}))
+        cluster.run_until_quiescent()
+        assert out[0][1] is None
+
+    topo2 = build_topology(2, (1, 2, 3, 4), 2, 3)
+    cluster.add_topology(topo2)
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    for store in cluster.nodes[4].command_stores.unsafe_all_stores():
+        assert store.bootstrapping.is_empty()
+
+    for i, key in enumerate((100, 400_000, 800_000)):
+        if not cluster.nodes[4].topology().current() \
+                .ranges_for_node(4).contains_token(key):
+            continue
+        out = submit(cluster, 4, kv_txn([key], {}))
+        cluster.run_until_quiescent()
+        assert out[0][1] is None
+        assert out[0][0].reads == {key: (f"v{i}",)}, \
+            f"key {key} lost in partial-donor bootstrap"
+
+
+def test_reconfiguration_determinism():
+    def run(seed):
+        cluster = make_cluster(seed=seed)
+        out = submit(cluster, 1, kv_txn([10], {10: ("x",)}))
+        cluster.run_until_quiescent()
+        cluster.add_topology(build_topology(2, (1, 2, 4), 3, 4))
+        cluster.run_until_quiescent()
+        rd = submit(cluster, 4, kv_txn([10], {}))
+        cluster.run_until_quiescent()
+        return rd[0][0].reads, dict(cluster.stats)
+
+    assert run(79) == run(79)

@@ -266,7 +266,7 @@ def test_merge_committed_deps_fills_uncovered_ranges():
 
     oks = [Ok(decided, Ranges.single(0, 10), Deps.none()),
            Ok(Deps.none(), Ranges.empty(), proposed)]
-    merged = _merge_committed_deps(oks, oks[0])
+    merged = _merge_committed_deps(oks)
     # decided entry kept; shard-B proposal (token 15, dep_b) NOT dropped
     assert merged.contains(dep_a)
     assert merged.contains(dep_b), "uncovered shard's proposal was lost"
