@@ -116,6 +116,8 @@ class Bootstrap:
     def _complete(self) -> None:
         self.done = True
         self.store.bootstrapping = self.store.bootstrapping.without(self.ranges)
+        if self.store.bootstrapping.is_empty():
+            self.store.bootstrap_complete()
 
     def _retry(self) -> None:
         if not self.done:

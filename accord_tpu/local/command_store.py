@@ -119,8 +119,10 @@ class CommandStore:
         self.durable_before = DurableBefore()
         # ranges adopted this epoch whose snapshot has not yet arrived —
         # reads are Nacked until clear (ref: safeToRead,
-        # local/CommandStore.java:159-176)
+        # local/CommandStore.java:159-176), and writes landing on them are
+        # deferred so the snapshot's earlier appends install first
         self.bootstrapping: Ranges = Ranges.empty()
+        self._bootstrap_waiters: List[Callable[[], None]] = []
         self.reject_before: Optional[ReducingRangeMap] = None
         self._queue: List[Callable[[], None]] = []
         self._draining = False
@@ -128,6 +130,14 @@ class CommandStore:
         # (ref: Command.TransientListener / ReadData registration)
         self.transient_listeners: Dict[TxnId, List[Callable]] = {}
         self.progress_log = node.progress_log_factory(self)
+
+    def defer_until_bootstrap(self, fn: Callable[[], None]) -> None:
+        self._bootstrap_waiters.append(fn)
+
+    def bootstrap_complete(self) -> None:
+        waiters, self._bootstrap_waiters = self._bootstrap_waiters, []
+        for fn in waiters:   # replay in defer order == executeAt drain order
+            fn()
 
     # -- executor contract (ref: CommandStore submit/execute) ---------------
     def execute(self, context: PreLoadContext,

@@ -456,6 +456,17 @@ def maybe_execute(safe: SafeCommandStore, txn_id: TxnId,
 def _apply_writes(safe: SafeCommandStore, cmd: Command) -> None:
     store = safe.store
     owned = safe.ranges(cmd.execute_at.epoch())
+    # a post-bootstrap write landing before the snapshot installs would be
+    # clobbered by (or clobber) the snapshot's earlier appends — defer the
+    # whole apply until bootstrap completes; defer order == drain order
+    if not store.bootstrapping.is_empty() and cmd.writes is not None \
+            and not cmd.writes.is_empty() \
+            and cmd.writes.keys.intersects(store.bootstrapping):
+        txn_id = cmd.txn_id
+        store.defer_until_bootstrap(
+            lambda: store.execute(PreLoadContext.for_txn(txn_id),
+                                  lambda s: _apply_writes(s, s.get(txn_id))))
+        return
     # pre-bootstrap txns' writes are covered by the bootstrap snapshot;
     # applying them here could go back in time vs the snapshot
     # (ref: Commands.applyRanges / RedundantBefore preBootstrap)

@@ -231,6 +231,35 @@ class Node:
 
         def start():
             CoordinateTransaction.coordinate(self, txn_id, txn).begin(result.settle)
+            self.scheduler.once(15_000_000, watchdog)
+
+        def watchdog():
+            # a coordination whose every round was lost/preempted can wedge
+            # while the txn itself reaches a terminal outcome via recovery;
+            # adopt that outcome for the client (ref: the coordinator-side
+            # Recover adoption in Node.recover / CoordinationAdapter)
+            if result.is_done():
+                return
+            from ..coordinate.recover import Recover
+            route = self.compute_route(txn_id, txn.keys)
+            Recover.recover(self, txn_id, route, txn).begin(on_recovered)
+
+        def on_recovered(value, failure):
+            if result.is_done():
+                return
+            if failure is not None:
+                self.agent.on_handled_exception(failure)
+                self.scheduler.once(5_000_000, watchdog)
+                return
+            outcome, payload = value
+            if outcome == "invalidated":
+                from ..coordinate.errors import Invalidated
+                result.set_failure(Invalidated(txn_id))
+            elif outcome in ("applied", "executed"):
+                result.set_success(payload)
+            else:
+                from ..coordinate.errors import Truncated
+                result.set_failure(Truncated(txn_id))
 
         self.with_epoch(txn_id.epoch(), start)
         return result

@@ -1,0 +1,206 @@
+"""The burn test: deterministic chaos simulation.
+
+Rebuild of ref: accord-core/src/test/java/accord/burn/BurnTest.java:108 +
+impl/basic/Cluster.java:102.  One seeded RandomSource drives:
+
+- a random multi-key list-append workload from random coordinators at random
+  simulated times (zipf-ish key skew);
+- network chaos re-randomized periodically: partitions + message drops over
+  the simulated links (ref: NodeSink DELIVER/DROP, Cluster.java:518-630);
+- per-node clock drift (ref: BurnTest.java:330-340 FrequentLargeRange);
+- topology churn: periodic epochs shuffling membership/shard counts
+  (ref: topology/TopologyRandomizer.java:58-115);
+- strict-serializability verification of every client-observed result plus
+  end-of-run accounting that every op resolved
+  (ref: verify/StrictSerializabilityVerifier.java, BurnTest.java:480-499).
+
+The whole run is a pure function of (seed, parameters): same seed, same
+message counts, same results — which is itself the race detector
+(ref: burn/ReconcilingLogger same-seed diffing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from ..topology.topology import Topology
+from ..utils.random_source import RandomSource
+from .cluster import Cluster
+from .kvstore import KVDataStore, kv_txn
+from .topology_factory import build_topology
+from .verifier import StrictSerializabilityVerifier
+
+
+class BurnResult:
+    def __init__(self):
+        self.ops_ok = 0
+        self.ops_failed = 0
+        self.ops_unresolved = 0
+        self.epochs = 1
+        self.stats: Dict[str, int] = {}
+
+    def __repr__(self):
+        return (f"BurnResult(ok={self.ops_ok}, failed={self.ops_failed}, "
+                f"unresolved={self.ops_unresolved}, epochs={self.epochs})")
+
+
+def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
+             node_ids=(1, 2, 3, 4, 5), rf: int = 3, shards: int = 4,
+             workload_micros: int = 20_000_000,
+             chaos: bool = True, churn: bool = True,
+             drain_micros: int = 120_000_000) -> BurnResult:
+    rs = RandomSource(seed)
+    topology = build_topology(1, node_ids, rf, shards)
+    cluster = Cluster(topology=topology, seed=rs.next_int(1 << 30),
+                      data_store_factory=KVDataStore)
+    verifier = StrictSerializabilityVerifier()
+    result = BurnResult()
+    wl = rs.fork()           # workload randomness
+    net = rs.fork()          # chaos randomness
+    top = rs.fork()          # churn randomness
+
+    # hot-key skew: a few keys get most of the traffic
+    hot = [wl.next_int(n_keys) for _ in range(max(2, n_keys // 5))]
+
+    def pick_key() -> int:
+        if wl.decide(0.5):
+            return hot[wl.next_int(len(hot))] * 10
+        return wl.next_int(n_keys) * 10
+
+    outstanding: List[dict] = []
+
+    def submit_op(op_seed: int):
+        node_id = sorted(cluster.nodes)[wl.next_int(len(cluster.nodes))]
+        n = wl.next_int(3) + 1
+        keys = sorted({pick_key() for _ in range(n)})
+        writes = {}
+        for k in keys:
+            if wl.decide(0.6):
+                writes[k] = (f"s{op_seed}k{k}",)
+        op = {"id": verifier.begin(), "start": cluster.queue.now,
+              "done": False, "writes": writes, "keys": keys}
+        outstanding.append(op)
+
+        def on_done(res, failure):
+            op["done"] = True
+            if failure is not None:
+                result.ops_failed += 1
+                return
+            result.ops_ok += 1
+            verifier.on_result(op["id"], op["start"], cluster.queue.now,
+                               res.reads, res.appends)
+
+        cluster.nodes[node_id].coordinate(kv_txn(keys, writes)).begin(on_done)
+
+    # schedule the workload across the window
+    for i in range(n_ops):
+        at = wl.next_int(workload_micros)
+        cluster.queue.add(at, lambda i=i: submit_op(i))
+
+    # chaos: re-randomize partitions / drops every 2s of sim time
+    def shake():
+        if cluster.queue.now > workload_micros:
+            cluster.heal()
+            cluster.drop_probability = 0.0
+            return
+        cluster.heal()
+        cluster.drop_probability = 0.0
+        roll = net.next_int(10)
+        nodes = sorted(cluster.nodes)
+        if roll < 3 and len(nodes) >= 3:
+            a, b = net.pick(nodes), net.pick(nodes)
+            if a != b:
+                cluster.partition(a, b)
+        elif roll < 5:
+            cluster.drop_probability = 0.05 + 0.1 * net.next_float()
+        cluster.queue.add(cluster.queue.now + 2_000_000, shake)
+
+    if chaos:
+        cluster.queue.add(2_000_000, shake)
+
+    # topology churn: a few epochs during the workload
+    def churn_once():
+        if cluster.queue.now > workload_micros:
+            return
+        current = cluster.topologies[-1]
+        all_ids = list(node_ids)
+        n_members = max(3, top.next_int(len(all_ids)) + 1)
+        members = sorted(top.pick(all_ids) for _ in range(len(all_ids)))[:n_members]
+        members = sorted(set(members))
+        while len(members) < 3:
+            members.append(top.pick([n for n in all_ids if n not in members]))
+        members = sorted(set(members))
+        new_rf = min(3, len(members))
+        new_shards = top.next_int(4) + 2
+        cluster.add_topology(build_topology(current.epoch + 1, members,
+                                            new_rf, new_shards))
+        result.epochs += 1
+        cluster.queue.add(cluster.queue.now + 4_000_000 + top.next_int(4_000_000),
+                          churn_once)
+
+    if churn:
+        cluster.queue.add(4_000_000 + top.next_int(2_000_000), churn_once)
+
+    # run the workload window + drain until every op resolves
+    cluster.run_for(workload_micros)
+    cluster.heal()
+    cluster.drop_probability = 0.0
+    deadline = cluster.queue.now + drain_micros
+    while cluster.queue.now < deadline:
+        if all(op["done"] for op in outstanding) and cluster.queue.is_empty():
+            break
+        fn = cluster.queue.pop()
+        if fn is None:
+            break
+        fn()
+
+    result.ops_unresolved = sum(1 for op in outstanding if not op["done"])
+
+    # final reads: quorum-read every key from a live member and pin finals
+    member = sorted(cluster.topologies[-1].nodes())[0]
+    for k in range(n_keys):
+        token = k * 10
+        out: List[Tuple[object, Optional[BaseException]]] = []
+        cluster.nodes[member].coordinate(kv_txn([token], {})).begin(
+            lambda r, f: out.append((r, f)))
+        cluster.run_until_quiescent()
+        if out and out[0][1] is None:
+            verifier.set_final(token, out[0][0].reads[token])
+
+    if cluster.failures:
+        raise AssertionError(f"seed {seed}: node-level failures: "
+                             f"{cluster.failures[:3]}")
+    verifier.verify()
+    result.stats = dict(cluster.stats)
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="accord_tpu burn test")
+    p.add_argument("-s", "--seed", type=int, default=None)
+    p.add_argument("-c", "--count", type=int, default=1)
+    p.add_argument("-o", "--ops", type=int, default=100)
+    p.add_argument("--loop-seed", type=int, default=None,
+                   help="run seeds loop-seed, loop-seed+1, ... forever")
+    p.add_argument("--no-chaos", action="store_true")
+    p.add_argument("--no-churn", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.loop_seed is not None:
+        seed = args.loop_seed
+        while True:
+            r = run_burn(seed, n_ops=args.ops, chaos=not args.no_chaos,
+                         churn=not args.no_churn)
+            print(f"seed {seed}: {r}")
+            seed += 1
+    start = args.seed if args.seed is not None else 0
+    for seed in range(start, start + args.count):
+        r = run_burn(seed, n_ops=args.ops, chaos=not args.no_chaos,
+                     churn=not args.no_churn)
+        print(f"seed {seed}: {r}")
+
+
+if __name__ == "__main__":
+    main()
