@@ -14,7 +14,7 @@ from ..primitives.deps import Deps
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
 from ..utils import async_chain
-from .errors import Exhausted, Preempted, Timeout
+from .errors import Exhausted, Preempted, Rejected, Timeout
 from .execute import execute
 from .propose import propose
 from .tracking import FastPathTracker, RequestStatus
@@ -52,8 +52,14 @@ class CoordinateTransaction(api.Callback):
         if self.done:
             return
         if isinstance(reply, PreAcceptNack) or not reply.is_ok():
-            # a higher ballot owns this txn: a recovery coordinator preempted us
-            self._fail(Preempted(self.txn_id))
+            if getattr(reply, "rejected", False):
+                # fenced by an ExclusiveSyncPoint: this TxnId can never
+                # decide — the caller retries with a fresh id
+                self._fail(Rejected(self.txn_id))
+            else:
+                # a higher ballot owns this txn: a recovery coordinator
+                # preempted us
+                self._fail(Preempted(self.txn_id))
             return
         self.oks[from_id] = reply
         fast_vote = reply.witnessed_at == self.txn_id

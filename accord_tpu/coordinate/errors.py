@@ -30,6 +30,11 @@ class Truncated(CoordinationFailed):
     pass
 
 
+class Rejected(CoordinationFailed):
+    """Fenced by an ExclusiveSyncPoint (rejectBefore): this TxnId can never
+    decide; retry the transaction with a fresh, higher TxnId."""
+
+
 class Exhausted(CoordinationFailed):
     pass
 

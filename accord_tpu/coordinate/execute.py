@@ -55,7 +55,14 @@ class _ExecuteTxn(api.Callback):
         self.result: async_chain.AsyncResult = async_chain.AsyncResult()
         self.done = False
         self.stable_done = False
-        self.read_done = False
+        # A txn with no read payload (sync points, blind writes) needs no
+        # read round: replicas gate the Apply on their local drain anyway
+        # (ref: CoordinateSyncPoint applies without a read leg; ExecuteTxn
+        # only contacts the read set for txns that read).  Crucially this
+        # keeps sync points executable while replicas are bootstrapping:
+        # ReadTxnData Nacks Unavailable during bootstrap, and the bootstrap
+        # fence is itself a sync point — read legs there would deadlock.
+        self.read_done = txn.read is None
 
     def _read_nodes(self) -> Set[int]:
         """One replica per execution shard, preferring ourselves then the
@@ -72,7 +79,8 @@ class _ExecuteTxn(api.Callback):
         return chosen
 
     def _start(self) -> async_chain.AsyncChain:
-        self.read_nodes = self._read_nodes()
+        if not self.read_done:
+            self.read_nodes = self._read_nodes()
         for n in self.read_nodes:
             self.read_tracker.record_in_flight(n)
         for to in sorted(self.stable_tracker.nodes()):
@@ -107,7 +115,8 @@ class _ExecuteTxn(api.Callback):
                 request = Commit(CommitKind.Stable, self.txn_id, self.txn,
                                  self.route, self.execute_at, self.deps,
                                  read=from_id in self.read_nodes,
-                                 ballot=self.ballot)
+                                 ballot=self.ballot,
+                                 min_epoch=self.all_topologies.oldest_epoch())
                 self.node.send(from_id, request, self)
             else:
                 self._fail(Exhausted(self.txn_id))
@@ -121,6 +130,10 @@ class _ExecuteTxn(api.Callback):
         self._read_failed(from_id)
 
     def _read_failed(self, from_id: int) -> None:
+        # read-less txns (sync points, blind writes) have no read legs to
+        # repair — a replica failure only affects the stable quorum
+        if self.txn.read is None:
+            return
         status, to_contact = self.read_tracker.record_read_failure(from_id)
         if status is RequestStatus.Failed:
             self._fail(Exhausted(self.txn_id))

@@ -42,6 +42,20 @@ def fetch_data(node, txn_id: TxnId, participants, epoch: int
     return result
 
 
+def _deps_cover(partial_deps, route, owned) -> bool:
+    """Committing locally with deps that do not cover this store's owned
+    slice of the route could let the txn execute before dependencies it
+    should wait for (a single replica's CheckStatus reply need not cover our
+    ranges).  Verify coverage; otherwise fall back to precommit and let the
+    progress log fetch more."""
+    from ..primitives.keys import Ranges
+    p = route.participants
+    if isinstance(p, Ranges):
+        return partial_deps.covers(p.intersecting(owned))
+    needed = [t for t in p.tokens() if owned.contains_token(t)]
+    return all(partial_deps.covering.contains_token(t) for t in needed)
+
+
 def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
     """Apply remotely-learned knowledge to the local stores
     (ref: messages/Propagate.java).  Only ever upgrades: the underlying
@@ -54,7 +68,13 @@ def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
             return
         if ok.route is None or ok.partial_txn is None:
             return
-        owned = safe.ranges(txn_id.epoch())
+        # Sync points extend one epoch below: a dropped donor fetching a
+        # bootstrap fence's outcome must be able to apply it over its old
+        # ranges.  Data txns do NOT — processing them over lost ranges would
+        # create gap-divergent stale copies (the fan-out no longer includes
+        # this node for those ranges).
+        owned = safe.store.ranges_for_epoch.all_between(
+            _propagate_min_epoch(txn_id), txn_id.epoch())
         partial_txn = ok.partial_txn.slice(owned, True)
         if status >= Status.PreApplied and ok.writes is not None \
                 and ok.execute_at is not None:
@@ -63,7 +83,8 @@ def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
                            partial_txn, ok.writes, ok.result)
             return
         if status >= Status.Committed and ok.execute_at is not None \
-                and ok.partial_deps is not None:
+                and ok.partial_deps is not None \
+                and _deps_cover(ok.partial_deps, ok.route, owned):
             commands.commit(safe, txn_id, status >= Status.Stable, Ballot.MAX,
                             ok.route, partial_txn, ok.execute_at,
                             ok.partial_deps.slice(owned))
@@ -72,4 +93,10 @@ def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
             commands.precommit(safe, txn_id, ok.execute_at)
 
     node.for_each_local(PreLoadContext.for_txn(txn_id), participants,
-                        txn_id.epoch(), txn_id.epoch(), apply_fn)
+                        _propagate_min_epoch(txn_id), txn_id.epoch(), apply_fn)
+
+
+def _propagate_min_epoch(txn_id: TxnId) -> int:
+    if txn_id.kind().is_sync_point():
+        return max(1, txn_id.epoch() - 1)
+    return txn_id.epoch()

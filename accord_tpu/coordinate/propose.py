@@ -14,7 +14,7 @@ from ..primitives.keys import Route
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
 from ..utils import async_chain
-from .errors import Exhausted, Preempted, Timeout
+from .errors import Exhausted, Preempted, Rejected, Timeout
 from .tracking import QuorumTracker, RequestStatus
 
 
@@ -55,7 +55,10 @@ class _Propose(api.Callback):
             return
         if not reply.is_ok():
             self.done = True
-            self.result.set_failure(Preempted(self.txn_id))
+            if getattr(reply, "rejected", False):
+                self.result.set_failure(Rejected(self.txn_id))
+            else:
+                self.result.set_failure(Preempted(self.txn_id))
             return
         if reply.deps is not None:
             self.accept_deps.append(reply.deps)

@@ -258,6 +258,12 @@ class Recover(api.Callback):
     # -- continuations -------------------------------------------------------
     def _proposed(self, value, failure) -> None:
         if failure is not None:
+            from .errors import Rejected as _Rejected
+            if isinstance(failure, _Rejected):
+                # fence-rejected at the Accept round: the txn can never
+                # decide — invalidate it instead of retrying forever
+                self._invalidate()
+                return
             self.result.set_failure(failure)
             return
         execute_at, deps = value

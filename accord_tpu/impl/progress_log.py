@@ -89,8 +89,13 @@ class SimpleProgressLog(api.ProgressLog):
     def _arm(self) -> None:
         if self._scheduled is None and (self.home or self.blocked):
             node = self.store.node
-            self._scheduled = node.scheduler.once(self.scan_delay_micros,
-                                                  self._scan)
+            # stagger scans per node/store so home replicas of the same txn
+            # do not investigate (and mutually preempt) in lock-step
+            # (ref: SimpleProgressLog randomized scheduling jitter)
+            delay = (self.scan_delay_micros
+                     + 37_000 * (node.node_id % 8)
+                     + 13_000 * (self.store.store_id % 4))
+            self._scheduled = node.scheduler.once(delay, self._scan)
 
     def _scan(self) -> None:
         self._scheduled = None
@@ -224,7 +229,10 @@ class SimpleProgressLog(api.ProgressLog):
     def stable(self, safe, txn_id: TxnId) -> None:
         self._track_home(safe, txn_id)
         self._refresh(txn_id)
-        self.blocked.pop(txn_id, None)
+        # do NOT pop blocked here: a dep that reached Stable locally can
+        # still wedge dependents if its Apply was lost — keep fetching its
+        # outcome until it actually applies (durable_local) or is cleared
+        # (ref: BlockingState waits for HasOutcome, not just committed)
 
     def ready_to_execute(self, safe, txn_id: TxnId) -> None:
         self._refresh(txn_id)

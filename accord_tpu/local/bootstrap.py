@@ -8,9 +8,12 @@ previously replicate:
    below the watermark are pre-bootstrap: excluded from deps, and their
    writes are NOT applied locally (the snapshot covers them).
 2. Fence with an ExclusiveSyncPoint over the ranges: every earlier txn is
-   decided, and applied wherever the sync point's read leg ran.
+   decided, and each replica applies the fence only after they applied
+   locally.
 3. Fetch a DataStore snapshot from a donor replica of the previous epoch
-   and install it.
+   and install it.  The donor serves only after IT has locally applied the
+   fence (messages/fetch_snapshot.py), so the snapshot contains every write
+   executing below the fence.
 4. Mark the ranges safe to read; until then reads are Nacked so the
    coordinator uses another replica (ref: safeToRead smearing,
    local/CommandStore.java:159-176).
@@ -40,27 +43,49 @@ class Bootstrap:
 
     def start(self) -> None:
         node = self.node
-        # 1. watermark: earlier txns are satisfied by the snapshot
-        bootstrapped_at = TxnId.from_timestamp(
-            node.unique_now(), TxnKind.ExclusiveSyncPoint, Domain.Range)
+        # don't waste a cluster-wide consensus round on the fence if the
+        # prior epoch's topology (our donor source) is not yet known
+        prev_epoch = self.epoch - 1
+        if prev_epoch >= 1 and not node.topology().has_epoch(prev_epoch):
+            node.with_epoch(prev_epoch, self.start)
+            return
+        # 1. watermark == the fence's own TxnId (ref: Bootstrap.java creates
+        # the ExclusiveSyncPoint id first and uses IT as bootstrappedAt).
+        # This identity matters: the deps floor prunes entries below
+        # bootstrapped_at from PreAccept replies, and collectDeps adds the
+        # boundary itself as a dependency — which must therefore be a REAL
+        # coordinated txn whose deps transitively cover everything pruned.
+        bootstrapped_at = node.next_txn_id(TxnKind.ExclusiveSyncPoint,
+                                           Domain.Range)
         self.store.redundant_before.add_bootstrapped(self.ranges, bootstrapped_at)
         self.store.bootstrapping = self.store.bootstrapping.with_(self.ranges)
-        # 2. fence
+        # 2. fence, coordinated AT the watermark id
         from ..coordinate.sync_point import coordinate_sync_point
-        coordinate_sync_point(node, self.ranges, exclusive=True) \
+        coordinate_sync_point(node, self.ranges, exclusive=True,
+                              txn_id=bootstrapped_at) \
             .begin(self._on_fenced)
 
-    def _on_fenced(self, _sync_point, failure) -> None:
+    def _on_fenced(self, sync_point, failure) -> None:
         if failure is not None:
             self.node.agent.on_failed_bootstrap("fence", self.ranges,
                                                 self._retry, failure)
+            return
+        prev_epoch = self.epoch - 1
+        if prev_epoch >= 1 and not self.node.topology().has_epoch(prev_epoch):
+            # unknown prior-epoch topology is a retryable condition, NOT a
+            # trivially-complete bootstrap: completing here would mark empty
+            # ranges safe-to-read.  Wait for the epoch, then retry.
+            self.node.agent.on_failed_bootstrap(
+                "unknown-prev-epoch", self.ranges, self._retry,
+                RuntimeError(f"topology for epoch {prev_epoch} not yet known"))
             return
         donors = self._donors()
         if not donors:
             # no prior-epoch replicas exist (fresh keyspace): trivially done
             self._complete()
             return
-        self._fetch(donors, self.ranges)
+        fence = sync_point.sync_id if sync_point is not None else None
+        self._fetch(donors, self.ranges, fence)
 
     def _donors(self) -> List[int]:
         """Replicas of these ranges in the previous epoch, preferring nodes
@@ -77,11 +102,12 @@ class Bootstrap:
                     donors.append(n)
         return donors
 
-    def _fetch(self, donors: List[int], remaining: Ranges) -> None:
+    def _fetch(self, donors: List[int], remaining: Ranges, fence) -> None:
         """Fetch ``remaining`` from donors in turn; each donor may cover only
         part, so iterate until nothing remains.  Exhausting the donor list
         with data still missing is a FAILURE and retries — never a silent
-        completion."""
+        completion.  ``fence`` is the ExclusiveSyncPoint TxnId the donor must
+        have locally applied before serving (see messages/fetch_snapshot.py)."""
         from ..messages.fetch_snapshot import FetchSnapshot, FetchSnapshotOk
         node = self.node
         if remaining.is_empty():
@@ -101,17 +127,17 @@ class Bootstrap:
                     return
                 if isinstance(reply, FetchSnapshotOk):
                     node.data_store.install_snapshot(reply.snapshot)
-                    outer._fetch(rest, remaining.without(reply.covered))
+                    outer._fetch(rest, remaining.without(reply.covered), fence)
                 else:
-                    outer._fetch(rest, remaining)
+                    outer._fetch(rest, remaining, fence)
 
             def on_failure(self, from_id: int, failure: BaseException) -> None:
                 if outer.done:
                     return
                 node.agent.on_handled_exception(failure)
-                outer._fetch(rest, remaining)
+                outer._fetch(rest, remaining, fence)
 
-        node.send(donor, FetchSnapshot(remaining, self.epoch - 1), Cb())
+        node.send(donor, FetchSnapshot(remaining, self.epoch - 1, fence), Cb())
 
     def _complete(self) -> None:
         self.done = True

@@ -185,6 +185,25 @@ class CommandStore:
     def command_if_present(self, txn_id: TxnId) -> Optional[Command]:
         return self.commands.get(txn_id)
 
+    # -- exclusive sync point fencing (ref: CommandStore.rejectBefore) ------
+    def mark_reject_before(self, ranges: Ranges, txn_id: TxnId) -> None:
+        """An ExclusiveSyncPoint at txn_id fences these ranges: later
+        PreAccepts/Accepts of LOWER TxnIds are rejected, guaranteeing no txn
+        below the fence can newly decide (the bootstrap-snapshot coverage
+        invariant relies on this)."""
+        m = self.reject_before if self.reject_before is not None \
+            else ReducingRangeMap.empty()
+        self.reject_before = m.add(ranges, txn_id,
+                                   lambda a, b: a if a >= b else b)
+
+    def reject_before_floor(self, keys_or_ranges) -> Optional[TxnId]:
+        if self.reject_before is None:
+            return None
+        from .redundant import _as_ranges
+        ranges = _as_ranges(keys_or_ranges)
+        return self.reject_before.fold_over_ranges(
+            ranges, lambda v, acc: v if acc is None or v > acc else acc, None)
+
     def owned_at(self, epoch: int) -> Ranges:
         return self.ranges_for_epoch.at(epoch)
 

@@ -35,6 +35,7 @@ class AcceptOutcome(enum.IntEnum):
     RejectedBallot = 2
     Insufficient = 3
     Truncated = 4
+    Rejected = 5      # fenced by an ExclusiveSyncPoint (rejectBefore)
 
 
 class CommitOutcome(enum.IntEnum):
@@ -66,6 +67,17 @@ def preaccept(safe: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
     if safe.redundant_before().status(txn_id, partial_txn.keys) in (
             RedundantStatus.SHARD_REDUNDANT,):
         return AcceptOutcome.Truncated, None
+    if not txn_id.kind().is_sync_point():
+        # An ExclusiveSyncPoint fence rejects NEW witnessing of lower TxnIds
+        # at any ballot: they could otherwise (slow-path or via recovery
+        # resurrection) decide past the fence and straddle a bootstrap
+        # snapshot boundary (ref: Commands.preaccept rejectBefore check).
+        # The original coordinator retries with a fresh TxnId; a recovery
+        # coordinator receives this as a non-witness vote and the electorate
+        # math (superseding rejects) decides the txn's fate.
+        floor = safe.store.reject_before_floor(partial_txn.keys)
+        if floor is not None and txn_id < floor:
+            return AcceptOutcome.Rejected, None
 
     witnessed_at = _compute_witnessed_at(safe, txn_id, partial_txn, permit_fast_path)
     safe.update_max_conflicts(partial_txn.keys, witnessed_at)
@@ -79,6 +91,9 @@ def preaccept(safe: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
         execute_at=witnessed_at)
     safe.update(new_cmd)
     _register_txn(safe, txn_id, partial_txn, InternalStatus.PREACCEPTED)
+    if txn_id.kind() is TxnKind.ExclusiveSyncPoint and \
+            isinstance(partial_txn.keys, Ranges):
+        safe.store.mark_reject_before(partial_txn.keys, txn_id)
     safe.progress_log().pre_accepted(safe, txn_id)
     return AcceptOutcome.Success, witnessed_at
 
@@ -164,6 +179,18 @@ def accept(safe: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
         return AcceptOutcome.Redundant, None
     if cmd.promised > ballot:
         return AcceptOutcome.RejectedBallot, cmd.promised
+    if not txn_id.kind().is_sync_point() and ballot == Ballot.ZERO \
+            and not cmd.has_been(Status.PreAccepted):
+        # Fence check also at Accept: an original-coordinator slow-path
+        # Accept can arrive after the fence (see preaccept).  Guards:
+        # already-witnessed commands pass (the fence witnessed them — their
+        # executeAt-vs-fence ordering is handled by the executeAt-gated
+        # apply), and recovery ballots pass (a recovered txn that reached
+        # the Accept phase at a quorum must survive; invalidating it could
+        # lose a committed write).
+        floor = safe.store.reject_before_floor(keys)
+        if floor is not None and txn_id < floor:
+            return AcceptOutcome.Rejected, None
 
     new_status = (SaveStatus.AcceptedWithDefinition if cmd.is_defined()
                   else SaveStatus.Accepted)
@@ -373,9 +400,25 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
     # deps we never witnessed locally (pre-bootstrap: the snapshot covers
     # them, so they must clear instantly, not trigger a fetch)
     participants = _resolve_dep_participants(safe, dep, partial_deps)
-    if safe.redundant_before().status(dep, participants) in (
-            RedundantStatus.SHARD_REDUNDANT, RedundantStatus.PRE_BOOTSTRAP_OR_STALE):
+    dep_status = safe.redundant_before().status(dep, participants)
+    if dep_status is RedundantStatus.SHARD_REDUNDANT:
         return waiting_on.with_done(dep, True)
+    if dep_status is RedundantStatus.PRE_BOOTSTRAP_OR_STALE:
+        # Pre-bootstrap by TxnId.  Unwitnessed deps clear instantly — the
+        # snapshot covers them (fetching each would make bootstrap O(history)
+        # in fetch rounds).  A WITNESSED dep with a known post-fence
+        # executeAt must instead be waited on: it will apply here directly
+        # and per-key execution order vs the snapshot must hold.  The
+        # cross-fence window (old TxnId slow-pathing past the fence) is
+        # closed by reject_before — an ExclusiveSyncPoint rejects later
+        # PreAccepts/Accepts of lower TxnIds (ref: CommandStore.rejectBefore,
+        # Commands.preaccept) — and any residue fails loudly in the
+        # versioned data store rather than losing a write silently.
+        dep_exec = (dep_cmd.execute_at_if_known()
+                    if dep_cmd is not None else None)
+        if dep_exec is None or \
+                safe.redundant_before().bootstrap_covers(dep_exec, participants):
+            return waiting_on.with_done(dep, True)
     if dep_cmd is None:
         # not yet witnessed locally: register a placeholder that will notify
         # us, and tell the progress log to fetch the blocker's state
@@ -390,10 +433,13 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
         # executes after us: not our dependency (ref: updateWaitingOn)
         return waiting_on.with_done(dep, False)
     safe.update(dep_cmd.with_listener(txn_id), notify=False)
-    if not dep_cmd.has_been(Status.Stable):
-        # locally undecided: if this replica missed the Commit, only a fetch
-        # will unblock us (ref: NotifyWaitingOn -> ProgressLog.waiting)
-        _report_blocker(safe, dep, partial_deps)
+    # Report the blocker whether it is undecided (we may have missed its
+    # Commit) or decided-but-unapplied (we may have missed its Apply): both
+    # can only be unblocked by fetching remote state if the originator is
+    # gone (ref: NotifyWaitingOn walks to the deepest unapplied dep and
+    # registers it with ProgressLog.waiting until HasOutcome).  Entries for
+    # deps that apply promptly are retired before their first fetch.
+    _report_blocker(safe, dep, partial_deps)
     return waiting_on
 
 
@@ -455,7 +501,14 @@ def maybe_execute(safe: SafeCommandStore, txn_id: TxnId,
 
 def _apply_writes(safe: SafeCommandStore, cmd: Command) -> None:
     store = safe.store
-    owned = safe.ranges(cmd.execute_at.epoch())
+    # The write window is the ranges this store legitimately processed the
+    # txn over — the covering of its sliced definition (which the message
+    # layer computed from the coordinator's multi-epoch window, so dropped
+    # prior-epoch donors still apply over their old ranges).
+    if cmd.partial_txn is not None:
+        owned = cmd.partial_txn.covering
+    else:
+        owned = safe.ranges(cmd.execute_at.epoch())
     # a post-bootstrap write landing before the snapshot installs would be
     # clobbered by (or clobber) the snapshot's earlier appends — defer the
     # whole apply until bootstrap completes; defer order == drain order
@@ -467,12 +520,15 @@ def _apply_writes(safe: SafeCommandStore, cmd: Command) -> None:
             lambda: store.execute(PreLoadContext.for_txn(txn_id),
                                   lambda s: _apply_writes(s, s.get(txn_id))))
         return
-    # pre-bootstrap txns' writes are covered by the bootstrap snapshot;
-    # applying them here could go back in time vs the snapshot
-    # (ref: Commands.applyRanges / RedundantBefore preBootstrap)
-    pre_bootstrap = safe.redundant_before().pre_bootstrap_ranges(cmd.txn_id)
-    if not pre_bootstrap.is_empty():
-        owned = owned.without(pre_bootstrap)
+    # Writes EXECUTING below the bootstrap fence are covered by the snapshot
+    # (the donor serves it only after the fence applied locally); applying
+    # them here could go back in time vs the snapshot.  Writes executing
+    # ABOVE the fence must apply even when their TxnId predates the
+    # watermark — the snapshot will not contain them
+    # (ref: Commands.applyRanges gates the data write on executeAt).
+    covered = safe.redundant_before().snapshot_covered_ranges(cmd.execute_at)
+    if not covered.is_empty():
+        owned = owned.without(covered)
 
     def on_done(_result, failure):
         if failure is not None:

@@ -221,16 +221,34 @@ class Node:
 
     # -- coordination entry (ref: Node.java:567-596) ------------------------
     def coordinate(self, txn: Txn,
-                   txn_id: Optional[TxnId] = None) -> async_chain.AsyncResult:
+                   txn_id: Optional[TxnId] = None,
+                   _retries: int = 0) -> async_chain.AsyncResult:
         from ..coordinate.coordinate_transaction import CoordinateTransaction
+        from ..coordinate.errors import Rejected
         if txn_id is None:
             txn_id = self.next_txn_id(txn.kind, txn.domain())
         result = async_chain.AsyncResult()
         self._coordinating[txn_id] = result
         result.begin(lambda _r, _f: self._coordinating.pop(txn_id, None))
 
+        superseded = {"flag": False}
+
+        def settle(value, failure):
+            if isinstance(failure, Rejected) and _retries < 5:
+                # fenced by an ExclusiveSyncPoint: the TxnId can never
+                # decide; transparently retry with a fresh, higher id
+                # (ref: the client-layer retry on preaccept rejection).
+                # Mark this attempt superseded so its watchdog does not
+                # race the retry by recovering/invalidating the dead id
+                # and settling the client result first.
+                superseded["flag"] = True
+                self._coordinating.pop(txn_id, None)
+                self.coordinate(txn, _retries=_retries + 1).begin(result.settle)
+                return
+            result.settle(value, failure)
+
         def start():
-            CoordinateTransaction.coordinate(self, txn_id, txn).begin(result.settle)
+            CoordinateTransaction.coordinate(self, txn_id, txn).begin(settle)
             self.scheduler.once(15_000_000, watchdog)
 
         def watchdog():
@@ -238,14 +256,14 @@ class Node:
             # while the txn itself reaches a terminal outcome via recovery;
             # adopt that outcome for the client (ref: the coordinator-side
             # Recover adoption in Node.recover / CoordinationAdapter)
-            if result.is_done():
+            if result.is_done() or superseded["flag"]:
                 return
             from ..coordinate.recover import Recover
             route = self.compute_route(txn_id, txn.keys)
             Recover.recover(self, txn_id, route, txn).begin(on_recovered)
 
         def on_recovered(value, failure):
-            if result.is_done():
+            if result.is_done() or superseded["flag"]:
                 return
             if failure is not None:
                 self.agent.on_handled_exception(failure)

@@ -131,15 +131,57 @@ class RedundantBefore:
             return TxnId.NONE
         return max(e.redundant_before, e.bootstrapped_at)
 
-    def pre_bootstrap_ranges(self, txn_id: TxnId) -> Ranges:
-        """Ranges where txn_id predates the bootstrap watermark — its writes
-        are covered by the bootstrap snapshot and must NOT be applied locally
-        (ref: RedundantBefore preBootstrap / Commands.applyRanges)."""
+    def boundary_dep(self, token: int) -> Optional[TxnId]:
+        """The bootstrap-fence TxnId flooring this key's deps, if any.  A
+        PreAccept reply that pruned entries below the floor must include the
+        floor itself as a dependency (ref: RedundantBefore.collectDeps):
+        the fence is a real coordinated ExclusiveSyncPoint whose own deps
+        transitively cover everything pruned, so coordinators merging this
+        reply still order after the pruned history."""
+        e = self._map.get(token)
+        if e is None or not (e.bootstrapped_at > TxnId.NONE):
+            return None
+        return e.bootstrapped_at
+
+    def boundary_deps_in(self, ranges: Ranges):
+        """(range, fence TxnId) pairs intersecting ``ranges`` — the range
+        analogue of boundary_dep."""
         def fold(entry, start, end, acc):
-            if txn_id < entry.bootstrapped_at:
+            if entry.bootstrapped_at > TxnId.NONE:
+                r = Range(start, end)
+                for sel in ranges:
+                    x = r.intersection(sel)
+                    if x is not None:
+                        acc.append((x, entry.bootstrapped_at))
+            return acc
+        return self._map.fold_with_bounds(fold, [])
+
+    def snapshot_covered_ranges(self, execute_at: Timestamp) -> Ranges:
+        """Ranges whose bootstrap snapshot covers a write executing at
+        ``execute_at``.  The snapshot boundary is EXECUTION order, not TxnId
+        order: the donor serves its snapshot only after the bootstrap fence
+        applied locally, so it contains exactly the writes with lower
+        executeAt on the fenced ranges.  A txn with an old TxnId but a
+        post-fence executeAt applies at the donor after the snapshot — the
+        joiner must apply it directly (ref: Commands.applyRanges gates the
+        data write on executeAt vs bootstrappedAt)."""
+        def fold(entry, start, end, acc):
+            if execute_at < entry.bootstrapped_at:
                 acc.append(Range(start, end))
             return acc
         return Ranges(self._map.fold_with_bounds(fold, []))
+
+    def bootstrap_covers(self, execute_at: Timestamp, participants) -> bool:
+        """Whether a dep KNOWN to execute at ``execute_at`` is fully covered
+        by the bootstrap snapshot over ``participants``.  Callers must not
+        pass a guessed executeAt: an undecided dep can still slow-path past
+        the fence."""
+        ranges = _as_ranges(participants)
+        entries = self._map.values_intersecting(ranges)
+        if not entries:
+            return False
+        return all(execute_at < e.bootstrapped_at or
+                   e.stale_until_at_least is not None for e in entries)
 
 
 class DurableBefore:

@@ -22,18 +22,21 @@ class AcceptReply(Reply):
 
     def __init__(self, superseded_by: Optional[Ballot] = None,
                  deps: Optional[PartialDeps] = None,
-                 redundant: bool = False):
+                 redundant: bool = False, rejected: bool = False):
         self.superseded_by = superseded_by
         self.deps = deps
         self.redundant = redundant
+        self.rejected = rejected   # fenced by rejectBefore: retry w/ new id
 
     def is_ok(self) -> bool:
-        return self.superseded_by is None and not self.redundant
+        return self.superseded_by is None and not self.redundant \
+            and not self.rejected
 
     def __repr__(self):
         if self.is_ok():
             return "AcceptOk"
-        return f"AcceptNack(superseded_by={self.superseded_by}, redundant={self.redundant})"
+        return (f"AcceptNack(superseded_by={self.superseded_by}, "
+                f"redundant={self.redundant}, rejected={self.rejected})")
 
 
 class Accept(TxnRequest):
@@ -67,6 +70,8 @@ class Accept(TxnRequest):
                 return AcceptReply(superseded_by=superseded)
             if outcome is commands.AcceptOutcome.Redundant:
                 return AcceptReply(redundant=True)
+            if outcome is commands.AcceptOutcome.Rejected:
+                return AcceptReply(rejected=True)
             # return deps witnessed up to executeAt for the coordinator's
             # final merge (ref: Accept.java AcceptReply.deps)
             deps = calculate_partial_deps(safe, txn_id, partial_txn.keys,

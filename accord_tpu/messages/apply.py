@@ -45,7 +45,8 @@ class Apply(TxnRequest):
 
     def __init__(self, kind: str, txn_id: TxnId, route: Route,
                  execute_at: Timestamp, deps, writes: Optional[Writes],
-                 result, txn: Optional[Txn] = None):
+                 result, txn: Optional[Txn] = None,
+                 min_epoch: Optional[int] = None):
         super().__init__(txn_id, route, execute_at.epoch())
         self.kind = kind
         self.execute_at = execute_at
@@ -53,12 +54,21 @@ class Apply(TxnRequest):
         self.writes = writes
         self.result = result
         self.txn = txn
+        # NOTE: replicas process Apply over [txn_id.epoch, executeAt.epoch]
+        # only.  Widening to the coordinator's dual-quorum window (so
+        # dropped donors apply over lost ranges) was tried and produces
+        # divergent stale copies: a replica that lost a range applies some
+        # later txns there but is excluded from others' fan-outs once the
+        # epoch syncs, leaving gap-ordered values that can resurface.  A
+        # dropped donor that cannot witness the bootstrap fence simply
+        # times out the joiner's fetch and another donor is used.
+        self.min_epoch = txn_id.epoch()
         if kind == "maximal":
             self.type = MessageType.APPLY_MAXIMAL_REQ
 
     def process(self, node, from_id: int, reply_context) -> None:
         txn_id, route = self.txn_id, self.route
-        min_epoch, max_epoch = txn_id.epoch(), self.execute_at.epoch()
+        min_epoch, max_epoch = self.min_epoch, self.execute_at.epoch()
 
         def map_fn(safe: SafeCommandStore):
             owned = safe.store.ranges_for_epoch.all_between(min_epoch, max_epoch)

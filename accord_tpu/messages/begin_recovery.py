@@ -163,6 +163,17 @@ class BeginRecovery(TxnRequest):
                 return RecoverNack(superseded)
             if outcome is commands.AcceptOutcome.Truncated:
                 return RecoverNack(None)
+            if outcome is commands.AcceptOutcome.Rejected:
+                # Fenced (rejectBefore): this txn was never witnessed here
+                # and never can be — a plain NON-witness vote (execute_at
+                # None => no fast-path vote).  The coordinator's electorate
+                # math (superseding rejects) decides between invalidation
+                # and completing a possibly-fast-committed txn; forcing
+                # rejects_fast_path here could invalidate a transaction
+                # that fast-committed at a quorum that excludes us.
+                return RecoverOk(txn_id, Status.NotDefined, Ballot.ZERO, None,
+                                 Deps.none(), Ranges.empty(), Deps.none(),
+                                 Deps.none(), Deps.none(), False, None, None)
 
             cmd = safe.get(txn_id)
             deps_decided = (cmd.known().deps.has_decided_deps()

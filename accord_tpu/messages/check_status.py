@@ -70,7 +70,7 @@ class CheckStatusOk(Reply):
             route,
             hi.home_key if hi.home_key is not None else lo.home_key,
             _merge_partial_txn(hi.partial_txn, lo.partial_txn),
-            hi.partial_deps if hi.partial_deps is not None else lo.partial_deps,
+            _merge_partial_deps(hi, lo),
             hi.writes if hi.writes is not None else lo.writes,
             hi.result if hi.result is not None else lo.result)
 
@@ -85,6 +85,26 @@ def _merge_partial_txn(a, b):
     if b is None:
         return a
     return a.with_partial(b)
+
+
+def _merge_partial_deps(hi: "CheckStatusOk", lo: "CheckStatusOk"):
+    """Union deps coverage across replies, but only between replies whose
+    deps are DECIDED (>= Committed): each such reply holds a slice of the
+    same agreed dep set, so the union widens range coverage soundly.  An
+    undecided reply's deps are a per-replica proposal and must never be
+    unioned into decided deps (ref: CheckStatus merges via the Known
+    lattice; see also LatestDeps covering in RecoverOk)."""
+    def decided(ok):
+        return (ok.partial_deps is not None
+                and ok.save_status.status >= Status.Committed)
+    if decided(hi) and decided(lo):
+        return hi.partial_deps.with_partial(lo.partial_deps)
+    if decided(hi):
+        return hi.partial_deps
+    if decided(lo):
+        return lo.partial_deps
+    # neither decided: keep the more-advanced reply's proposal, if any
+    return hi.partial_deps if hi.partial_deps is not None else lo.partial_deps
 
 
 class CheckStatusNack(Reply):

@@ -41,6 +41,22 @@ def calculate_partial_deps(safe: SafeCommandStore, txn_id: TxnId, keys,
         return acc
 
     safe.map_reduce_active(keys, started_before, witnesses, fold, builder)
+
+    # collectDeps boundary (ref: RedundantBefore.collectDeps consumed at
+    # PreAccept.java:245-264): where the floor pruned history, depend on the
+    # floor itself — the bootstrap fence RX, a real txn whose deps cover
+    # everything pruned — so merged deps never silently lose coverage.
+    rb = safe.redundant_before()
+    if isinstance(keys, Ranges):
+        for rng, boundary in rb.boundary_deps_in(keys):
+            if boundary != txn_id and boundary < started_before:
+                builder.add_range(rng, boundary)
+    else:
+        for key in keys:
+            boundary = rb.boundary_dep(key.token())
+            if boundary is not None and boundary != txn_id \
+                    and boundary < started_before:
+                builder.add_key(key.token(), boundary)
     return builder.build_partial(covering)
 
 
@@ -63,11 +79,20 @@ class PreAcceptOk(Reply):
 class PreAcceptNack(Reply):
     type = MessageType.PRE_ACCEPT_RSP
 
+    def __init__(self, reason: str = "Preempted"):
+        self.reason = reason   # "Preempted" | "Rejected" (fence) | "Truncated"
+
+    @property
+    def rejected(self) -> bool:
+        """Fenced by rejectBefore — the uniform flag coordinators test (the
+        same attribute exists on AcceptReply) to retry with a fresh TxnId."""
+        return self.reason == "Rejected"
+
     def is_ok(self) -> bool:
         return False
 
     def __repr__(self):
-        return "PreAcceptNack"
+        return f"PreAcceptNack({self.reason})"
 
 
 class PreAccept(TxnRequest):
@@ -96,9 +121,11 @@ class PreAccept(TxnRequest):
             outcome, witnessed_at = commands.preaccept(
                 safe, txn_id, partial_txn, route, progress_key)
             if outcome is commands.AcceptOutcome.RejectedBallot:
-                return PreAcceptNack()
+                return PreAcceptNack("Preempted")
             if outcome is commands.AcceptOutcome.Truncated:
-                return PreAcceptNack()
+                return PreAcceptNack("Truncated")
+            if outcome is commands.AcceptOutcome.Rejected:
+                return PreAcceptNack("Rejected")
             if outcome is commands.AcceptOutcome.Redundant:
                 cmd = safe.get(txn_id)
                 witnessed_at = cmd.execute_at

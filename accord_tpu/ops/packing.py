@@ -19,7 +19,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-_x64_checked = False
+def enable_x64() -> None:
+    """Opt in to 64-bit JAX for the device data plane.  Call once at process
+    start, before any other JAX work (entry points, bench, and the test
+    conftest all do)."""
+    jax.config.update("jax_enable_x64", True)
 
 
 def ensure_x64() -> None:
@@ -28,14 +32,16 @@ def ensure_x64() -> None:
     are emulated with int32 pairs by XLA — acceptable here (the kernels are
     compare/reduce bound, and the one matmul runs in bf16).
 
-    Called lazily from the host packers (not at import) so importing the
-    library does not flip dtype semantics for unrelated JAX code until the
-    caller actually builds device state.
+    x64 is a PRECONDITION, not a side effect: flipping the process-global
+    flag lazily mid-run would silently change dtype-promotion semantics for
+    unrelated JAX code in the host application.  Callers must opt in via
+    enable_x64() (or jax.config / JAX_ENABLE_X64) at startup.
     """
-    global _x64_checked
-    if not _x64_checked:
-        jax.config.update("jax_enable_x64", True)
-        _x64_checked = True
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "accord_tpu device kernels require 64-bit JAX; call "
+            "accord_tpu.ops.packing.enable_x64() (or set JAX_ENABLE_X64=true) "
+            "at process start before building device state")
 
 from ..primitives.timestamp import Timestamp, TxnId, TxnKind
 

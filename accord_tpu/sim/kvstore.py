@@ -40,14 +40,18 @@ class KVDataStore(api.DataStore):
     def apply_append(self, token: int, values: tuple,
                      execute_at: Timestamp) -> None:
         entry = self.data.get(token)
-        if entry is not None:
-            if entry[1] == execute_at:
-                return  # idempotent re-apply of the same txn
-            # out-of-order apply is a protocol violation — surface it loudly
-            # rather than silently dropping the write
-            assert entry[1] < execute_at, (
-                f"out-of-order apply on key {token}: applying {execute_at} "
-                f"after {entry[1]} (node {self.node_id})")
+        if entry is not None and entry[1] >= execute_at:
+            # Stale apply: the value already reflects this-or-later
+            # executeAt.  Legitimate ONLY as a duplicate — after a bootstrap
+            # snapshot install, the snapshot may already contain writes whose
+            # Apply messages race with it (versioned, like the reference's
+            # Timestamped ListStore values).  A duplicate's values are
+            # already present; anything else is a lost-write protocol
+            # violation and must fail loudly.
+            assert all(v in entry[0] for v in values), (
+                f"out-of-order apply on key {token}: {values} @ {execute_at} "
+                f"not present in {entry[0]} @ {entry[1]} (node {self.node_id})")
+            return
         current = entry[0] if entry is not None else ()
         self.data[token] = (current + values, execute_at)
 

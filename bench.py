@@ -20,7 +20,9 @@ import numpy as np
 
 def main():
     # device selection: whatever JAX gives us (the real TPU under the driver;
-    # CPU elsewhere).  x64 is enabled by accord_tpu.ops on import.
+    # CPU elsewhere).  x64 is an explicit opt-in at process start.
+    from accord_tpu.ops.packing import enable_x64
+    enable_x64()
     from accord_tpu.ops import deps_kernel as dk
     from accord_tpu.primitives.keys import Range
     from accord_tpu.primitives.timestamp import Domain, Kinds, TxnId, TxnKind
