@@ -40,8 +40,7 @@ class _Persist(api.Callback):
 
     def _start(self) -> None:
         request = Apply("minimal", self.txn_id, self.route, self.execute_at,
-                        self.deps, self.writes, self.txn_result,
-                        min_epoch=self.topologies.oldest_epoch())
+                        self.deps, self.writes, self.txn_result)
         for to in sorted(self.tracker.nodes()):
             self.node.send(to, request, self)
 
@@ -50,8 +49,7 @@ class _Persist(api.Callback):
             # straggler is missing txn/deps: send maximal
             request = Apply("maximal", self.txn_id, self.route,
                             self.execute_at, self.deps, self.writes,
-                            self.txn_result, txn=self.txn,
-                            min_epoch=self.topologies.oldest_epoch())
+                            self.txn_result, txn=self.txn)
             self.node.send(from_id, request, self)
             return
         status = self.tracker.record_success(from_id)

@@ -91,10 +91,12 @@ class SimpleProgressLog(api.ProgressLog):
             node = self.store.node
             # stagger scans per node/store so home replicas of the same txn
             # do not investigate (and mutually preempt) in lock-step
-            # (ref: SimpleProgressLog randomized scheduling jitter)
-            delay = (self.scan_delay_micros
-                     + 37_000 * (node.node_id % 8)
-                     + 13_000 * (self.store.store_id % 4))
+            # (ref: SimpleProgressLog randomized scheduling jitter).  The
+            # offset mixes the FULL node/store ids so any pair of nodes gets
+            # distinct offsets (small moduli left ids congruent mod 8 in
+            # lock-step for clusters larger than 8 nodes).
+            mix = (node.node_id * 0x9E3779B1 ^ self.store.store_id * 0x85EBCA77)
+            delay = self.scan_delay_micros + (mix % 399_989)
             self._scheduled = node.scheduler.once(delay, self._scan)
 
     def _scan(self) -> None:

@@ -75,6 +75,19 @@ def preaccept(safe: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
         # The original coordinator retries with a fresh TxnId; a recovery
         # coordinator receives this as a non-witness vote and the electorate
         # math (superseding rejects) decides the txn's fate.
+        #
+        # DELIBERATE DELTA: the reference applies the fold to sync points
+        # too (the ESP early-return in CommandStore.java:326-336 sits after
+        # the reject check) — but there a rejected PreAccept still witnesses
+        # the txn, returning a rejected timestamp the coordinator then
+        # invalidates.  Ours refuses to witness outright and the caller
+        # re-picks a fresh id.  Applying THAT semantic to ESPs breaks the
+        # fence-id-is-bootstrap-watermark invariant: concurrent bootstraps
+        # race, the loser's pre-marked bootstrapped_at keeps pruning deps
+        # with a boundary dep that never coordinates, and coverage holes
+        # lose writes across snapshot handoffs (observed: burn seeds 3/7).
+        # An old ESP witnessed behind a newer fence is harmless here — it
+        # carries no payload and its fence marking max-merges to a no-op.
         floor = safe.store.reject_before_floor(partial_txn.keys)
         if floor is not None and txn_id < floor:
             return AcceptOutcome.Rejected, None

@@ -225,6 +225,7 @@ class Node:
                    _retries: int = 0) -> async_chain.AsyncResult:
         from ..coordinate.coordinate_transaction import CoordinateTransaction
         from ..coordinate.errors import Rejected
+        explicit_id = txn_id is not None
         if txn_id is None:
             txn_id = self.next_txn_id(txn.kind, txn.domain())
         result = async_chain.AsyncResult()
@@ -234,16 +235,24 @@ class Node:
         superseded = {"flag": False}
 
         def settle(value, failure):
-            if isinstance(failure, Rejected) and _retries < 5:
-                # fenced by an ExclusiveSyncPoint: the TxnId can never
-                # decide; transparently retry with a fresh, higher id
-                # (ref: the client-layer retry on preaccept rejection).
-                # Mark this attempt superseded so its watchdog does not
-                # race the retry by recovering/invalidating the dead id
-                # and settling the client result first.
+            # A caller-pinned TxnId (sync-point fences: the id IS the
+            # bootstrap/epoch watermark) must NOT be transparently swapped
+            # for a fresh one — propagate Rejected so the caller re-picks
+            # its fence id and re-marks its watermark.
+            if isinstance(failure, Rejected) and not explicit_id \
+                    and _retries < 5:
+                # fenced by an ExclusiveSyncPoint: the TxnId can never newly
+                # decide here — but unfenced replicas may retain (fast-path)
+                # PreAccepts of it that a later recovery could complete.
+                # Invalidate the old id FIRST, and only then retry with a
+                # fresh id (ref: CoordinateTransaction.java:87-94
+                # proposeAndCommitInvalidate before any client retry);
+                # retrying immediately risks the payload applying under both
+                # ids.  Mark this attempt superseded so its watchdog does
+                # not race the invalidation.
                 superseded["flag"] = True
                 self._coordinating.pop(txn_id, None)
-                self.coordinate(txn, _retries=_retries + 1).begin(result.settle)
+                self._invalidate_then_retry(txn, txn_id, _retries, result)
                 return
             result.settle(value, failure)
 
@@ -281,6 +290,55 @@ class Node:
 
         self.with_epoch(txn_id.epoch(), start)
         return result
+
+    def _invalidate_then_retry(self, txn: Txn, old_id: TxnId, retries: int,
+                               result: async_chain.AsyncResult,
+                               attempt: int = 0) -> None:
+        """Invalidate a fence-Rejected TxnId before the client retry
+        (ref: coordinate/Invalidate.java proposeAndCommitInvalidate via
+        CoordinateTransaction.java:87-94).  If invalidation reports the old
+        id redundant — it actually decided somewhere — adopt its outcome
+        instead of issuing a duplicate transaction."""
+        from ..coordinate.recover import (Recover, _next_ballot_bits,
+                                          _propose_invalidate)
+        from ..primitives.timestamp import Ballot
+        route = self.compute_route(old_id, txn.keys)
+        ballot = Ballot(*_next_ballot_bits(self))
+        topologies = self.topology().for_epoch(route.participants,
+                                               old_id.epoch())
+
+        def retry():
+            self.coordinate(txn, _retries=retries + 1).begin(result.settle)
+
+        def adopt():
+            # the old id reached a decision after all: finish it and hand
+            # its outcome to the client rather than re-running the payload
+            Recover.recover(self, old_id, route, txn).begin(adopted)
+
+        def adopted(value, failure):
+            if failure is not None:
+                result.set_failure(failure)
+                return
+            outcome, payload = value
+            if outcome == "invalidated":
+                retry()
+            elif outcome in ("applied", "executed"):
+                result.set_success(payload)
+            else:
+                from ..coordinate.errors import Truncated
+                result.set_failure(Truncated(old_id))
+
+        def failed(failure):
+            if attempt < 3:
+                delay = 500_000 + self.random.next_int(500_000)
+                self.scheduler.once(delay, lambda: self._invalidate_then_retry(
+                    txn, old_id, retries, result, attempt + 1))
+            else:
+                result.set_failure(failure)
+
+        _propose_invalidate(self, old_id, route, ballot, topologies,
+                            on_invalidated=retry, on_redundant=adopt,
+                            on_failed=failed)
 
     def recover(self, txn_id: TxnId, route: Route) -> async_chain.AsyncResult:
         """(ref: Node.java:685-713)."""

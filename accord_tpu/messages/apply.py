@@ -45,8 +45,7 @@ class Apply(TxnRequest):
 
     def __init__(self, kind: str, txn_id: TxnId, route: Route,
                  execute_at: Timestamp, deps, writes: Optional[Writes],
-                 result, txn: Optional[Txn] = None,
-                 min_epoch: Optional[int] = None):
+                 result, txn: Optional[Txn] = None):
         super().__init__(txn_id, route, execute_at.epoch())
         self.kind = kind
         self.execute_at = execute_at

@@ -134,11 +134,12 @@ class FetchSnapshot(Request):
             snapshot_and_reply()
             return
         # Note: a donor dropped from the fence's epoch still converges — the
-        # dual-quorum window extends Apply/propagate one epoch below the
-        # txn's (see messages/apply.py), so the fence lands on its old-range
-        # stores and await_applied's progress-log fetch pulls it if the
-        # direct Apply was lost.  The joiner's callback timeout bounds the
-        # wait either way; it moves to the next donor on timeout.
+        # sync-point propagate window in coordinate/fetch_data.py extends one
+        # epoch below the fence's, and await_applied's progress-log fetch
+        # pulls the fence if the direct Apply was lost (Apply itself is NOT
+        # widened; see the window note in messages/apply.py).  The joiner's
+        # callback timeout bounds the wait either way; it moves to the next
+        # donor on timeout.
         chains = [s.execute(PreLoadContext.for_txn(fence),
                             lambda safe: await_applied(safe, fence, covered))
                   for s in stores]

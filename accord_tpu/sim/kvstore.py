@@ -18,42 +18,46 @@ from ..utils import async_chain
 
 
 class KVDataStore(api.DataStore):
-    """Versioned store: token -> (list value, last-applied executeAt)."""
+    """Versioned store: token -> (list value, last-applied executeAt,
+    applied TxnIds).  The applied-id set makes duplicate detection exact:
+    two distinct txns appending equal values are still distinguishable, so
+    a genuine lost-write/duplicate fails the assert instead of passing on
+    value membership."""
 
     def __init__(self, node_id: int):
         self.node_id = node_id
-        self.data: Dict[int, Tuple[tuple, Timestamp]] = {}
+        self.data: Dict[int, Tuple[tuple, Timestamp, frozenset]] = {}
 
     def get(self, token: int) -> tuple:
         entry = self.data.get(token)
         return entry[0] if entry is not None else ()
 
-    def snapshot(self, ranges: Ranges) -> Dict[int, Tuple[tuple, Timestamp]]:
+    def snapshot(self, ranges: Ranges) -> Dict[int, Tuple[tuple, Timestamp, frozenset]]:
         return {t: v for t, v in self.data.items() if ranges.contains_token(t)}
 
-    def install_snapshot(self, snapshot: Dict[int, Tuple[tuple, Timestamp]]) -> None:
-        for token, (value, at) in snapshot.items():
+    def install_snapshot(self, snapshot: Dict[int, Tuple[tuple, Timestamp, frozenset]]) -> None:
+        for token, (value, at, ids) in snapshot.items():
             mine = self.data.get(token)
             if mine is None or mine[1] < at:
-                self.data[token] = (value, at)
+                self.data[token] = (value, at, ids)
 
-    def apply_append(self, token: int, values: tuple,
-                     execute_at: Timestamp) -> None:
+    def apply_append(self, token: int, values: tuple, execute_at: Timestamp,
+                     txn_id: TxnId) -> None:
         entry = self.data.get(token)
         if entry is not None and entry[1] >= execute_at:
             # Stale apply: the value already reflects this-or-later
-            # executeAt.  Legitimate ONLY as a duplicate — after a bootstrap
-            # snapshot install, the snapshot may already contain writes whose
-            # Apply messages race with it (versioned, like the reference's
-            # Timestamped ListStore values).  A duplicate's values are
-            # already present; anything else is a lost-write protocol
-            # violation and must fail loudly.
-            assert all(v in entry[0] for v in values), (
-                f"out-of-order apply on key {token}: {values} @ {execute_at} "
-                f"not present in {entry[0]} @ {entry[1]} (node {self.node_id})")
+            # executeAt.  Legitimate ONLY as a re-apply of the same txn —
+            # after a bootstrap snapshot install, the snapshot may already
+            # contain writes whose Apply messages race with it (versioned,
+            # like the reference's Timestamped ListStore values).  Anything
+            # else is a lost-write protocol violation and must fail loudly.
+            assert txn_id in entry[2], (
+                f"out-of-order apply on key {token}: {txn_id} {values} @ "
+                f"{execute_at} not in applied set @ {entry[1]} "
+                f"(node {self.node_id})")
             return
-        current = entry[0] if entry is not None else ()
-        self.data[token] = (current + values, execute_at)
+        current, ids = (entry[0], entry[2]) if entry is not None else ((), frozenset())
+        self.data[token] = (current + values, execute_at, ids | {txn_id})
 
 
 class KVData(api.Data):
@@ -97,7 +101,7 @@ class KVWrite(api.Write):
     def apply(self, key, txn_id: TxnId, execute_at, store: KVDataStore):
         vals = self.appends.get(key.token())
         if vals:
-            store.apply_append(key.token(), vals, execute_at)
+            store.apply_append(key.token(), vals, execute_at, txn_id)
         return async_chain.success(None)
 
 
