@@ -130,6 +130,13 @@ class CommandStore:
         # (ref: Command.TransientListener / ReadData registration)
         self.transient_listeners: Dict[TxnId, List[Callable]] = {}
         self.progress_log = node.progress_log_factory(self)
+        # device-backed conflict index + drain graph (the TPU protocol path);
+        # None = pure host mode (listener-driven drain, CFK fold scans)
+        if getattr(node, "device_mode", False):
+            from .device_index import DeviceState
+            self.device: Optional["DeviceState"] = DeviceState(self)
+        else:
+            self.device = None
 
     def defer_until_bootstrap(self, fn: Callable[[], None]) -> None:
         self._bootstrap_waiters.append(fn)

@@ -142,6 +142,10 @@ def _register_txn(safe: SafeCommandStore, txn_id: TxnId,
     else:
         for key in keys:
             safe.cfk(key.token()).update(txn_id, status, execute_at)
+    if safe.store.device is not None:
+        safe.store.device.register(txn_id, int(status), keys)
+        if execute_at is not None and status.has_execute_at():
+            safe.store.device.update_status(txn_id, int(status), execute_at)
 
 
 def _update_cfk_status(safe: SafeCommandStore, cmd: Command,
@@ -149,6 +153,8 @@ def _update_cfk_status(safe: SafeCommandStore, cmd: Command,
                        execute_at: Optional[Timestamp] = None) -> None:
     if not cmd.txn_id.kind().is_globally_visible():
         return
+    if safe.store.device is not None:
+        safe.store.device.update_status(cmd.txn_id, int(status), execute_at)
     if cmd.partial_txn is None:
         return
     keys = cmd.partial_txn.keys
@@ -299,7 +305,10 @@ def stable(safe: SafeCommandStore, txn_id: TxnId) -> CommitOutcome:
     safe.update(new_cmd)
     _update_cfk_status(safe, new_cmd, InternalStatus.STABLE, new_cmd.execute_at)
     safe.progress_log().stable(safe, txn_id)
-    maybe_execute(safe, txn_id)
+    if not maybe_execute(safe, txn_id) and safe.store.device is not None:
+        # device drain mode: the remaining waiting set becomes an adjacency
+        # row; ready_frontier ticks drive it instead of per-dep listeners
+        safe.store.device.arm(safe, txn_id)
     return CommitOutcome.Success
 
 
@@ -364,7 +373,8 @@ def apply(safe: SafeCommandStore, txn_id: TxnId, route: Route,
                           waiting_on=waiting_on, writes=writes, result=result)
     safe.update(new_cmd)
     safe.progress_log().executed(safe, txn_id)
-    maybe_execute(safe, txn_id)
+    if not maybe_execute(safe, txn_id) and safe.store.device is not None:
+        safe.store.device.arm(safe, txn_id)
     return ApplyOutcome.Success
 
 
@@ -432,10 +442,14 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
         if dep_exec is None or \
                 safe.redundant_before().bootstrap_covers(dep_exec, participants):
             return waiting_on.with_done(dep, True)
+    device = safe.store.device is not None
     if dep_cmd is None:
         # not yet witnessed locally: register a placeholder that will notify
-        # us, and tell the progress log to fetch the blocker's state
-        placeholder = Command(dep).with_listener(txn_id)
+        # us, and tell the progress log to fetch the blocker's state.  In
+        # device mode the drain graph (not a listener) tracks the edge.
+        placeholder = Command(dep)
+        if not device:
+            placeholder = placeholder.with_listener(txn_id)
         safe.update(placeholder, notify=False)
         _report_blocker(safe, dep, partial_deps)
         return waiting_on
@@ -445,7 +459,8 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
     if dep_execute_at is not None and dep_execute_at > execute_at:
         # executes after us: not our dependency (ref: updateWaitingOn)
         return waiting_on.with_done(dep, False)
-    safe.update(dep_cmd.with_listener(txn_id), notify=False)
+    if not device:
+        safe.update(dep_cmd.with_listener(txn_id), notify=False)
     # Report the blocker whether it is undecided (we may have missed its
     # Commit) or decided-but-unapplied (we may have missed its Apply): both
     # can only be unblocked by fetching remote state if the originator is
@@ -492,6 +507,9 @@ def maybe_execute(safe: SafeCommandStore, txn_id: TxnId,
         if always_notify:
             safe.notify_listeners(cmd)
         return False
+
+    if safe.store.device is not None:
+        safe.store.device.on_driven(txn_id)
 
     if cmd.save_status is SaveStatus.Stable:
         new_cmd = cmd.updated(save_status=SaveStatus.ReadyToExecute)
@@ -586,6 +604,20 @@ def listener_update(safe: SafeCommandStore, listener_id: TxnId,
     update_dependency_and_maybe_execute(safe, listener, dep)
 
 
+def _dep_clearance(dep: Command, listener_execute_at) -> Optional[bool]:
+    """The one clearing rule both drain mechanisms share
+    (ref: Commands.updateWaitingOn): None = still gating; True = dep is
+    applied/invalidated/truncated; False = dep executes after us."""
+    if dep.save_status is SaveStatus.Applied or dep.is_invalidated() \
+            or dep.is_truncated():
+        return True
+    dep_execute_at = dep.execute_at_if_known()
+    if (dep_execute_at is not None and listener_execute_at is not None
+            and dep_execute_at > listener_execute_at):
+        return False
+    return None
+
+
 def update_dependency_and_maybe_execute(safe: SafeCommandStore,
                                         listener: Command,
                                         dep: Command) -> None:
@@ -594,15 +626,10 @@ def update_dependency_and_maybe_execute(safe: SafeCommandStore,
         return
     new_waiting = listener.waiting_on
     remove_listener = False
-    if dep.save_status is SaveStatus.Applied or dep.is_invalidated() or dep.is_truncated():
-        new_waiting = new_waiting.with_done(dep.txn_id, True)
+    cleared = _dep_clearance(dep, listener.execute_at)
+    if cleared is not None:
+        new_waiting = new_waiting.with_done(dep.txn_id, cleared)
         remove_listener = True
-    else:
-        dep_execute_at = dep.execute_at_if_known()
-        if (dep_execute_at is not None and listener.execute_at is not None
-                and dep_execute_at > listener.execute_at):
-            new_waiting = new_waiting.with_done(dep.txn_id, False)
-            remove_listener = True
     if new_waiting is listener.waiting_on:
         return
     updated = listener.updated(waiting_on=new_waiting)
@@ -610,6 +637,32 @@ def update_dependency_and_maybe_execute(safe: SafeCommandStore,
     if remove_listener:
         safe.update(dep.without_listener(listener.txn_id), notify=False)
     maybe_execute(safe, listener.txn_id)
+
+
+def refresh_waiting_and_maybe_execute(safe: SafeCommandStore,
+                                      txn_id: TxnId) -> bool:
+    """Device-drain execution step: the kernel's ready_frontier proposed this
+    txn as executable; re-validate every remaining WaitingOn bit against the
+    authoritative host command records (same clearing rules as
+    update_dependency_and_maybe_execute), then try to execute.  A mirror
+    divergence degrades to a no-op — the bits stay set and the txn is
+    re-proposed on a later tick."""
+    cmd = safe.if_present(txn_id)
+    if cmd is None or cmd.waiting_on is None:
+        return False
+    if cmd.save_status not in (SaveStatus.Stable, SaveStatus.PreApplied):
+        return False
+    w = cmd.waiting_on
+    for dep in w.waiting_ids():
+        dep_cmd = safe.if_present(dep)
+        if dep_cmd is None:
+            continue
+        cleared = _dep_clearance(dep_cmd, cmd.execute_at)
+        if cleared is not None:
+            w = w.with_done(dep, cleared)
+    if w is not cmd.waiting_on:
+        safe.update(cmd.updated(waiting_on=w), notify=False)
+    return maybe_execute(safe, txn_id)
 
 
 # ---------------------------------------------------------------------------

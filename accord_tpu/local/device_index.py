@@ -1,0 +1,484 @@
+"""Device-backed conflict index + execution drain for a CommandStore.
+
+This is the live protocol wiring of the two TPU kernels (SURVEY.md §7
+stages 3-4): every globally-visible transaction a store witnesses is
+registered in a struct-of-arrays DepsTable slot kept incrementally in sync
+with the host command state, PreAccept/Accept/BeginRecovery dependency scans
+run through ops.deps_kernel.calculate_deps, and the executeAt-gated
+execution drain is driven by ops.drain_kernel.ready_frontier over a live
+adjacency graph instead of per-dependency listener fan-out.
+
+Ref semantics preserved:
+ - deps scan: accord-core/src/main/java/accord/local/CommandsForKey.java:614-650
+   (mapReduceActive) + InMemoryCommandStore.java:863-877 (range scan) +
+   messages/PreAccept.java:245-265 (calculatePartialDeps)
+ - drain: local/Commands.java:656-857 (maybeExecute /
+   updateDependencyAndMaybeExecute / NotifyWaitingOn)
+
+Host numpy mirrors are the source of truth (the sim mutates them in place,
+deterministically, under the store's single-threaded task queue); device
+buffers are refreshed by scatter-updating only dirty rows, so on TPU the
+table stays HBM-resident between queries and only deltas cross the PCIe/ICI
+boundary.  The host command records remain authoritative for execution: the
+kernel proposes the ready frontier, and each candidate is re-validated
+against its WaitingOn bitset before executing — any mirror divergence
+degrades to a no-op, never a wrong execution.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import deps_kernel as dk
+from ..ops import drain_kernel as drk
+from ..ops.packing import to_i64, unpack_txn_id
+from ..primitives.keys import Range, Ranges
+from ..primitives.timestamp import Domain, Kinds, Timestamp, TxnId
+from ..utils import invariants
+
+_MIN_CAPACITY = 64
+_MIN_INTERVALS = 4
+_QUERY_BUCKETS = (1, 8, 64, 512, 4096)
+
+
+def _bucket(n: int, buckets: Sequence[int] = _QUERY_BUCKETS) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds the largest bucket {buckets[-1]}")
+
+
+def _grow(arr: np.ndarray, new_len: int, fill) -> np.ndarray:
+    out = np.full((new_len,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+class _DepsMirror:
+    """Host mirror of one store's DepsTable, with dirty-row tracking."""
+
+    def __init__(self, capacity: int = _MIN_CAPACITY,
+                 max_intervals: int = _MIN_INTERVALS):
+        self.capacity = capacity
+        self.max_intervals = max_intervals
+        self.msb = np.zeros(capacity, np.int64)
+        self.lsb = np.zeros(capacity, np.int64)
+        self.node = np.zeros(capacity, np.int32)
+        self.kind = np.zeros(capacity, np.int32)
+        self.status = np.full(capacity, dk.SLOT_FREE, np.int32)
+        self.lo = np.full((capacity, max_intervals), dk.PAD_LO, np.int64)
+        self.hi = np.full((capacity, max_intervals), dk.PAD_HI, np.int64)
+        self.slot_of: Dict[TxnId, int] = {}
+        self.free_slots: List[int] = list(range(capacity - 1, -1, -1))
+        self._dirty: Set[int] = set()
+        self._device: Optional[dk.DepsTable] = None
+
+    # -- slot management ----------------------------------------------------
+    def alloc(self, txn_id: TxnId) -> int:
+        slot = self.slot_of.get(txn_id)
+        if slot is not None:
+            return slot
+        if not self.free_slots:
+            self._grow_capacity()
+        slot = self.free_slots.pop()
+        self.slot_of[txn_id] = slot
+        self.msb[slot] = to_i64(txn_id.msb)
+        self.lsb[slot] = to_i64(txn_id.lsb)
+        self.node[slot] = txn_id.node
+        self.kind[slot] = int(txn_id.kind())
+        self.status[slot] = dk.SLOT_TRANSITIVE
+        self.lo[slot] = dk.PAD_LO
+        self.hi[slot] = dk.PAD_HI
+        self._dirty.add(slot)
+        return slot
+
+    def free(self, txn_id: TxnId) -> None:
+        slot = self.slot_of.pop(txn_id, None)
+        if slot is None:
+            return
+        self.status[slot] = dk.SLOT_FREE
+        self.lo[slot] = dk.PAD_LO
+        self.hi[slot] = dk.PAD_HI
+        self.free_slots.append(slot)
+        self._dirty.add(slot)
+
+    def _grow_capacity(self) -> None:
+        old = self.capacity
+        new = old * 2
+        self.msb = _grow(self.msb, new, 0)
+        self.lsb = _grow(self.lsb, new, 0)
+        self.node = _grow(self.node, new, 0)
+        self.kind = _grow(self.kind, new, 0)
+        self.status = _grow(self.status, new, dk.SLOT_FREE)
+        self.lo = _grow(self.lo, new, dk.PAD_LO)
+        self.hi = _grow(self.hi, new, dk.PAD_HI)
+        self.free_slots.extend(range(new - 1, old - 1, -1))
+        self.capacity = new
+        self._device = None  # shape changed: full re-upload
+
+    def _grow_intervals(self) -> None:
+        new_m = self.max_intervals * 2
+        lo = np.full((self.capacity, new_m), dk.PAD_LO, np.int64)
+        hi = np.full((self.capacity, new_m), dk.PAD_HI, np.int64)
+        lo[:, : self.max_intervals] = self.lo
+        hi[:, : self.max_intervals] = self.hi
+        self.lo, self.hi = lo, hi
+        self.max_intervals = new_m
+        self._device = None
+
+    def add_intervals(self, slot: int, tokens: Sequence[int],
+                      ranges: Sequence[Range]) -> None:
+        """Union new intervals into the slot's footprint (idempotent)."""
+        row_lo, row_hi = self.lo[slot], self.hi[slot]
+        used = int(np.sum(row_lo <= row_hi))
+        new: List[Tuple[int, int]] = []
+        for t in tokens:
+            new.append((t, t))
+        for r in ranges:
+            new.append((r.start, r.end - 1))
+        for lo_v, hi_v in new:
+            present = False
+            for m in range(used):
+                if row_lo[m] <= lo_v and hi_v <= row_hi[m]:
+                    present = True
+                    break
+            if present:
+                continue
+            while used >= self.max_intervals:
+                self._grow_intervals()
+                row_lo, row_hi = self.lo[slot], self.hi[slot]
+            row_lo[used] = lo_v
+            row_hi[used] = hi_v
+            used += 1
+            self._dirty.add(slot)
+
+    def set_status(self, slot: int, status: int) -> None:
+        if self.status[slot] != status:
+            self.status[slot] = status
+            self._dirty.add(slot)
+
+    # -- device sync --------------------------------------------------------
+    def device_table(self) -> dk.DepsTable:
+        if self._device is None:
+            self._device = dk.DepsTable(
+                jnp.asarray(self.msb), jnp.asarray(self.lsb),
+                jnp.asarray(self.node), jnp.asarray(self.kind),
+                jnp.asarray(self.status), jnp.asarray(self.lo),
+                jnp.asarray(self.hi))
+            self._dirty.clear()
+        elif self._dirty:
+            idx = jnp.asarray(sorted(self._dirty), jnp.int32)
+            t = self._device
+            rows = np.array(sorted(self._dirty))
+            self._device = dk.DepsTable(
+                t.msb.at[idx].set(self.msb[rows]),
+                t.lsb.at[idx].set(self.lsb[rows]),
+                t.node.at[idx].set(self.node[rows]),
+                t.kind.at[idx].set(self.kind[rows]),
+                t.status.at[idx].set(self.status[rows]),
+                t.lo.at[idx].set(self.lo[rows]),
+                t.hi.at[idx].set(self.hi[rows]))
+            self._dirty.clear()
+        return self._device
+
+
+class _DrainMirror:
+    """Host mirror of the execution drain graph: adjacency over the store's
+    in-flight (stable-but-unapplied) txns and their direct dependencies."""
+
+    def __init__(self, capacity: int = _MIN_CAPACITY):
+        self.capacity = capacity
+        self.adj = np.zeros((capacity, capacity), bool)
+        self.status = np.full(capacity, dk.SLOT_FREE, np.int32)
+        self.exec_msb = np.zeros(capacity, np.int64)
+        self.exec_lsb = np.zeros(capacity, np.int64)
+        self.exec_node = np.zeros(capacity, np.int32)
+        self.active = np.zeros(capacity, bool)   # rows being driven to execution
+        self.slot_of: Dict[TxnId, int] = {}
+        self.id_of: Dict[int, TxnId] = {}
+        self.free_slots: List[int] = list(range(capacity - 1, -1, -1))
+
+    def alloc(self, txn_id: TxnId) -> int:
+        slot = self.slot_of.get(txn_id)
+        if slot is not None:
+            return slot
+        if not self.free_slots:
+            self._grow_capacity()
+        slot = self.free_slots.pop()
+        self.slot_of[txn_id] = slot
+        self.id_of[slot] = txn_id
+        self.status[slot] = dk.SLOT_TRANSITIVE
+        self.exec_msb[slot] = 0
+        self.exec_lsb[slot] = 0
+        self.exec_node[slot] = 0
+        self.adj[slot, :] = False
+        self.adj[:, slot] = False
+        self.active[slot] = False
+        return slot
+
+    def free(self, slot: int) -> None:
+        txn_id = self.id_of.pop(slot, None)
+        if txn_id is not None:
+            del self.slot_of[txn_id]
+        self.status[slot] = dk.SLOT_FREE
+        self.adj[slot, :] = False
+        self.adj[:, slot] = False
+        self.active[slot] = False
+        self.free_slots.append(slot)
+
+    def _grow_capacity(self) -> None:
+        old = self.capacity
+        new = old * 2
+        adj = np.zeros((new, new), bool)
+        adj[:old, :old] = self.adj
+        self.adj = adj
+        self.status = _grow(self.status, new, dk.SLOT_FREE)
+        self.exec_msb = _grow(self.exec_msb, new, 0)
+        self.exec_lsb = _grow(self.exec_lsb, new, 0)
+        self.exec_node = _grow(self.exec_node, new, 0)
+        self.active = _grow(self.active, new, False)
+        self.free_slots.extend(range(new - 1, old - 1, -1))
+        self.capacity = new
+
+    def set_status(self, slot: int, status: int,
+                   execute_at: Optional[Timestamp]) -> None:
+        self.status[slot] = status
+        if execute_at is not None:
+            self.exec_msb[slot] = to_i64(execute_at.msb)
+            self.exec_lsb[slot] = to_i64(execute_at.lsb)
+            self.exec_node[slot] = execute_at.node
+
+    def state(self) -> drk.DrainState:
+        return drk.DrainState(
+            jnp.asarray(self.adj), jnp.asarray(self.status),
+            jnp.asarray(self.exec_msb), jnp.asarray(self.exec_lsb),
+            jnp.asarray(self.exec_node))
+
+    def sweep_free(self) -> None:
+        """Release slots that can no longer gate anything: terminal status,
+        not being driven, and no waiter edge pointing at them."""
+        terminal = (self.status == dk.SLOT_APPLIED) | \
+                   (self.status == dk.SLOT_INVALIDATED)
+        referenced = self.adj.any(axis=0)
+        for slot in np.nonzero(terminal & ~self.active & ~referenced)[0]:
+            if self.id_of.get(int(slot)) is not None:
+                self.free(int(slot))
+
+
+class DeviceState:
+    """Per-CommandStore device wiring: the deps index + drain graph, kept in
+    sync by the Commands transition functions."""
+
+    def __init__(self, store):
+        self.store = store
+        self.deps = _DepsMirror()
+        self.drain = _DrainMirror()
+        self._tick_scheduled = False
+        # counters surfaced through sim stats / bench
+        self.n_queries = 0
+        self.n_ticks = 0
+        self.n_kernel_deps = 0
+
+    # ------------------------------------------------------------------
+    # registration hooks (called from local.commands transitions)
+    # ------------------------------------------------------------------
+    def register(self, txn_id: TxnId, status: int, keys) -> None:
+        """Witness/advance a txn in the deps index.  ``keys`` is the txn's
+        sliced participation (Keys or Ranges) — its conflict footprint."""
+        slot = self.deps.alloc(txn_id)
+        if keys is not None:
+            if isinstance(keys, Ranges):
+                self.deps.add_intervals(slot, (), list(keys))
+            else:
+                self.deps.add_intervals(slot, [k.token() for k in keys], ())
+        self._advance_status(txn_id, slot, status, None)
+
+    def update_status(self, txn_id: TxnId, status: int,
+                      execute_at: Optional[Timestamp] = None) -> None:
+        slot = self.deps.slot_of.get(txn_id)
+        if slot is None:
+            slot = self.deps.alloc(txn_id)
+        self._advance_status(txn_id, slot, status, execute_at)
+
+    def _advance_status(self, txn_id: TxnId, slot: int, status: int,
+                        execute_at: Optional[Timestamp]) -> None:
+        cur = int(self.deps.status[slot])
+        if status == dk.SLOT_INVALIDATED:
+            new = dk.SLOT_INVALIDATED
+        else:
+            new = max(cur, status)
+        self.deps.set_status(slot, new)
+        dslot = self.drain.slot_of.get(txn_id)
+        if dslot is not None:
+            self.drain.set_status(dslot, new, execute_at)
+        # a dependency becoming decided (executeAt known) or terminal can
+        # unblock waiters: re-evaluate the frontier
+        if new >= dk.SLOT_COMMITTED and self.drain.active.any():
+            self.schedule_tick()
+
+    def free(self, txn_id: TxnId) -> None:
+        """Truncation/erasure: drop the txn from the deps index (its effect
+        is covered by the RedundantBefore watermark from now on)."""
+        self.deps.free(txn_id)
+
+    def index_size(self) -> int:
+        return len(self.deps.slot_of)
+
+    # ------------------------------------------------------------------
+    # the deps query (device replacement of map_reduce_active fold)
+    # ------------------------------------------------------------------
+    def deps_query(self, safe, txn_id: TxnId, keys, started_before: Timestamp,
+                   witnesses: Kinds, builder) -> None:
+        """Run the PreAccept/Accept/Recover dependency scan on device and
+        fold the result into ``builder`` with the same per-key semantics as
+        the host CommandsForKey path."""
+        owned = safe.ranges(started_before.epoch())
+        if isinstance(keys, Ranges):
+            q_toks: List[int] = []
+            q_rngs = list(keys.slice(owned))
+        else:
+            q_toks = [k.token() for k in keys if owned.contains_token(k.token())]
+            q_rngs = []
+        if not q_toks and not q_rngs:
+            return
+        while len(q_toks) + len(q_rngs) > self.deps.max_intervals:
+            self.deps._grow_intervals()
+
+        self.n_queries += 1
+        table = self.deps.device_table()
+        query = dk.build_query(
+            [(started_before, witnesses, q_toks, q_rngs, txn_id)],
+            self.deps.max_intervals)
+        dep_mask, _ = dk.calculate_deps(table, query)
+        dep_slots = np.nonzero(np.asarray(dep_mask)[0])[0]
+        self.n_kernel_deps += len(dep_slots)
+        if len(dep_slots) == 0:
+            return
+
+        rb = safe.redundant_before()
+        m = self.deps
+        # attribute each dep to the query keys/ranges its footprint overlaps
+        # (the kernel answers "who", the mirror answers "where")
+        for j in dep_slots:
+            dep_id = unpack_txn_id(m.msb[j], m.lsb[j], m.node[j])
+            slo, shi = m.lo[j], m.hi[j]
+            used = slo <= shi
+            if dep_id.domain() is Domain.Key:
+                for t in q_toks:
+                    if np.any(used & (slo <= t) & (t <= shi)) and \
+                            dep_id >= rb.deps_floor(t):
+                        builder.add_key(t, dep_id)
+                for r in q_rngs:
+                    sel = used & (slo <= r.end - 1) & (r.start <= shi)
+                    for mm in np.nonzero(sel)[0]:
+                        t = int(slo[mm])   # key-domain footprints are points
+                        if dep_id >= rb.deps_floor(t):
+                            builder.add_key(t, dep_id)
+            else:
+                for t in q_toks:
+                    if np.any(used & (slo <= t) & (t <= shi)):
+                        builder.add_range(Range(t, t + 1), dep_id)
+                for r in q_rngs:
+                    sel = used & (slo <= r.end - 1) & (r.start <= shi)
+                    for mm in np.nonzero(sel)[0]:
+                        ilo = max(int(slo[mm]), r.start)
+                        ihi = min(int(shi[mm]), r.end - 1)
+                        builder.add_range(Range(ilo, ihi + 1), dep_id)
+
+    # ------------------------------------------------------------------
+    # the drain (device replacement of listener fan-out)
+    # ------------------------------------------------------------------
+    def arm(self, safe, txn_id: TxnId) -> None:
+        """Register a Stable/PreApplied txn's remaining waiting set as a
+        drain row; the next tick will re-evaluate it."""
+        cmd = safe.if_present(txn_id)
+        if cmd is None or cmd.waiting_on is None:
+            return
+        slot = self.drain.alloc(txn_id)
+        self.drain.set_status(slot, dk.SLOT_STABLE, cmd.execute_at)
+        self.drain.adj[slot, :] = False
+        for dep in cmd.waiting_on.waiting_ids():
+            dslot = self._dep_drain_slot(safe, dep)
+            self.drain.adj[slot, dslot] = True
+        self.drain.active[slot] = True
+        self.schedule_tick()
+
+    def _dep_drain_slot(self, safe, dep: TxnId) -> int:
+        slot = self.drain.slot_of.get(dep)
+        if slot is not None:
+            return slot
+        slot = self.drain.alloc(dep)
+        cmd = safe.if_present(dep)
+        status, exec_at = _drain_status_of(cmd)
+        self.drain.set_status(slot, status, exec_at)
+        return slot
+
+    def on_driven(self, txn_id: TxnId) -> None:
+        """The txn reached ReadyToExecute/Applying — stop driving it (its
+        slot lives on as a dependency of others until terminal + unreferenced)."""
+        slot = self.drain.slot_of.get(txn_id)
+        if slot is not None:
+            self.drain.active[slot] = False
+            self.drain.adj[slot, :] = False
+
+    def schedule_tick(self) -> None:
+        if self._tick_scheduled:
+            return
+        self._tick_scheduled = True
+        from .command_store import PreLoadContext
+        self.store.execute(PreLoadContext.empty(), self._tick)
+
+    def _tick(self, safe) -> None:
+        from . import commands
+        self._tick_scheduled = False
+        self.n_ticks += 1
+        if not self.drain.active.any():
+            self.drain.sweep_free()
+            return
+        ready = np.asarray(drk.ready_frontier(self.drain.state()))
+        cand_slots = np.nonzero(ready & self.drain.active)[0]
+        if len(cand_slots) == 0:
+            self.drain.sweep_free()
+            return
+        cands = sorted(
+            (self.drain.id_of[int(s)] for s in cand_slots
+             if int(s) in self.drain.id_of),
+            key=_exec_order_key(safe))
+        for txn_id in cands:
+            commands.refresh_waiting_and_maybe_execute(safe, txn_id)
+        self.drain.sweep_free()
+
+
+def _exec_order_key(safe):
+    def key(txn_id: TxnId):
+        cmd = safe.if_present(txn_id)
+        exec_at = cmd.execute_at if cmd is not None and cmd.execute_at \
+            is not None else txn_id
+        return (exec_at, txn_id)
+    return key
+
+
+def _drain_status_of(cmd) -> Tuple[int, Optional[Timestamp]]:
+    from .status import Status
+    if cmd is None:
+        return dk.SLOT_TRANSITIVE, None
+    if cmd.is_invalidated():
+        return dk.SLOT_INVALIDATED, None
+    if cmd.is_truncated():
+        # truncated == locally done; never gates execution
+        return dk.SLOT_INVALIDATED, None
+    exec_at = cmd.execute_at_if_known()
+    if cmd.has_been(Status.Applied):
+        return dk.SLOT_APPLIED, exec_at
+    if cmd.has_been(Status.Stable):
+        return dk.SLOT_STABLE, exec_at
+    if cmd.has_been(Status.Committed):
+        return dk.SLOT_COMMITTED, exec_at
+    if cmd.has_been(Status.Accepted):
+        return dk.SLOT_ACCEPTED, exec_at
+    return dk.SLOT_PREACCEPTED, None

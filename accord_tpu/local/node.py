@@ -21,6 +21,20 @@ from ..utils import async_chain, invariants
 from .command_store import CommandStores, PreLoadContext
 
 
+def _resolve_device_mode(device_mode: Optional[bool]) -> bool:
+    """Device (TPU kernel) protocol path: explicit arg > ACCORD_TPU_DEVICE
+    env > on iff 64-bit JAX is enabled (the kernels' precondition — the test
+    conftest, bench, burn CLI and graft entries all enable it at startup)."""
+    if device_mode is not None:
+        return device_mode
+    import os
+    env = os.environ.get("ACCORD_TPU_DEVICE")
+    if env is not None:
+        return env.lower() not in ("0", "false", "off", "")
+    import jax
+    return bool(jax.config.jax_enable_x64)
+
+
 class Node:
     """(ref: local/Node.java)."""
 
@@ -34,7 +48,8 @@ class Node:
                  now_micros: Callable[[], int],
                  progress_log_factory: Optional[Callable] = None,
                  num_stores: int = 2,
-                 local_config: Optional[api.LocalConfig] = None):
+                 local_config: Optional[api.LocalConfig] = None,
+                 device_mode: Optional[bool] = None):
         self.node_id = node_id
         self.message_sink = message_sink
         self.config_service = config_service
@@ -44,6 +59,7 @@ class Node:
         self.random = random
         self.now_micros = now_micros
         self.local_config = local_config or api.LocalConfig()
+        self.device_mode = _resolve_device_mode(device_mode)
         if progress_log_factory is None:
             from ..impl.progress_log import SimpleProgressLog
             progress_log_factory = SimpleProgressLog

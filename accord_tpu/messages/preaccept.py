@@ -29,18 +29,25 @@ def calculate_partial_deps(safe: SafeCommandStore, txn_id: TxnId, keys,
     builder = DepsBuilder()
     witnesses = txn_id.kind().witnesses()
 
-    def fold(key_or_ranges, dep_id: TxnId, acc):
-        if dep_id == txn_id:
+    if safe.store.device is not None:
+        # device path: one batched interval-overlap kernel answers the
+        # KeyDeps scan and the RangeDeps stabbing query together
+        # (accord_tpu.local.device_index + ops.deps_kernel)
+        safe.store.device.deps_query(safe, txn_id, keys, started_before,
+                                     witnesses, builder)
+    else:
+        def fold(key_or_ranges, dep_id: TxnId, acc):
+            if dep_id == txn_id:
+                return acc
+            if isinstance(key_or_ranges, int):
+                if dep_id >= safe.redundant_before().deps_floor(key_or_ranges):
+                    acc.add_key(key_or_ranges, dep_id)
+            else:
+                for rng in key_or_ranges:
+                    acc.add_range(rng, dep_id)
             return acc
-        if isinstance(key_or_ranges, int):
-            if dep_id >= safe.redundant_before().deps_floor(key_or_ranges):
-                acc.add_key(key_or_ranges, dep_id)
-        else:
-            for rng in key_or_ranges:
-                acc.add_range(rng, dep_id)
-        return acc
 
-    safe.map_reduce_active(keys, started_before, witnesses, fold, builder)
+        safe.map_reduce_active(keys, started_before, witnesses, fold, builder)
 
     # collectDeps boundary (ref: RedundantBefore.collectDeps consumed at
     # PreAccept.java:245-264): where the floor pruned history, depend on the

@@ -224,8 +224,10 @@ class Cluster:
                  data_store_factory: Optional[Callable[[int], api.DataStore]] = None,
                  progress_log_factory=None,
                  mean_latency_micros: int = 1_000,
-                 request_timeout_micros: int = 1_000_000):
+                 request_timeout_micros: int = 1_000_000,
+                 device_mode: Optional[bool] = None):
         node_ids = list(node_ids if node_ids is not None else topology.nodes())
+        self._device_mode = device_mode
         self.random = RandomSource(seed)
         self.queue = PendingQueue()
         self.topologies: List[Topology] = [topology] if topology else []
@@ -260,7 +262,7 @@ class Cluster:
                 agent=SimAgent(self), random=self.random.fork(),
                 now_micros=lambda: self.queue.now,
                 progress_log_factory=progress_log_factory,
-                num_stores=num_stores)
+                num_stores=num_stores, device_mode=device_mode)
             self.nodes[nid] = node
         if topology is not None:
             for node in self.nodes.values():
@@ -335,7 +337,8 @@ class Cluster:
                     agent=SimAgent(self), random=self.random.fork(),
                     now_micros=lambda: self.queue.now,
                     progress_log_factory=self._progress_log_factory,
-                    num_stores=self._num_stores)
+                    num_stores=self._num_stores,
+                    device_mode=self._device_mode)
         self.nodes[nid] = node
         # the joiner must know prior epochs to pick bootstrap donors
         for t in self.topologies:
