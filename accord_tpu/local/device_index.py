@@ -16,10 +16,12 @@ Ref semantics preserved:
    updateDependencyAndMaybeExecute / NotifyWaitingOn)
 
 Host numpy mirrors are the source of truth (the sim mutates them in place,
-deterministically, under the store's single-threaded task queue); device
-buffers are refreshed by scatter-updating only dirty rows, so on TPU the
-table stays HBM-resident between queries and only deltas cross the PCIe/ICI
-boundary.  The host command records remain authoritative for execution: the
+deterministically, under the store's single-threaded task queue).  The deps
+table's device buffers are refreshed by scatter-updating only dirty rows, so
+on TPU the table stays HBM-resident between queries and only deltas cross
+the PCIe/ICI boundary; the drain graph is uploaded whole per tick — it is
+bounded by the in-flight (stable-but-unapplied) set, which sweep_free keeps
+small.  The host command records remain authoritative for execution: the
 kernel proposes the ready frontier, and each candidate is re-validated
 against its WaitingOn bitset before executing — any mirror divergence
 degrades to a no-op, never a wrong execution.
@@ -39,18 +41,16 @@ from ..ops import drain_kernel as drk
 from ..ops.packing import to_i64, unpack_txn_id
 from ..primitives.keys import Range, Ranges
 from ..primitives.timestamp import Domain, Kinds, Timestamp, TxnId
-from ..utils import invariants
 
 _MIN_CAPACITY = 64
 _MIN_INTERVALS = 4
-_QUERY_BUCKETS = (1, 8, 64, 512, 4096)
 
 
-def _bucket(n: int, buckets: Sequence[int] = _QUERY_BUCKETS) -> int:
-    for b in buckets:
-        if n <= b:
-            return b
-    raise ValueError(f"batch of {n} exceeds the largest bucket {buckets[-1]}")
+def _pow2_at_least(n: int, floor: int = _MIN_INTERVALS) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
 
 
 def _grow(arr: np.ndarray, new_len: int, fill) -> np.ndarray:
@@ -346,14 +346,15 @@ class DeviceState:
             q_rngs = []
         if not q_toks and not q_rngs:
             return
-        while len(q_toks) + len(q_rngs) > self.deps.max_intervals:
-            self.deps._grow_intervals()
 
         self.n_queries += 1
         table = self.deps.device_table()
+        # query interval width is independent of the table's (the kernel
+        # broadcasts [B,1,Mq,1] x [1,N,1,Mt]); pad to a power of two so jit
+        # caches one compilation per width bucket
+        q_m = _pow2_at_least(len(q_toks) + len(q_rngs))
         query = dk.build_query(
-            [(started_before, witnesses, q_toks, q_rngs, txn_id)],
-            self.deps.max_intervals)
+            [(started_before, witnesses, q_toks, q_rngs, txn_id)], q_m)
         dep_mask, _ = dk.calculate_deps(table, query)
         dep_slots = np.nonzero(np.asarray(dep_mask)[0])[0]
         self.n_kernel_deps += len(dep_slots)

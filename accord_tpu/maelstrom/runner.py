@@ -1,0 +1,173 @@
+"""In-process Maelstrom simulation: real MaelstromProcess nodes exchanging
+JSON-serialised packets over a seeded random-delay queue, driven by a
+generated list-append client workload and checked for strict
+serializability.
+
+Rebuild of ref: accord-maelstrom/src/test/java/accord/maelstrom/Runner.java
+:40-190 + Cluster.java:70-330 — the same node logic that speaks to the real
+Maelstrom harness, exercised deterministically in one process.  Packets are
+serialised to JSON strings and parsed on delivery, so the full wire codec is
+on the hot path (serde divergence fails the run, not just a unit test).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+from ..sim.cluster import PendingQueue, SimScheduler
+from ..sim.verifier import StrictSerializabilityVerifier
+from ..utils.random_source import RandomSource
+from .node import MaelstromProcess, token_of
+
+
+class RunResult:
+    def __init__(self):
+        self.ops_ok = 0
+        self.ops_failed = 0
+        self.ops_unresolved = 0
+        self.packets = 0
+
+    def __repr__(self):
+        return (f"RunResult(ok={self.ops_ok}, failed={self.ops_failed}, "
+                f"unresolved={self.ops_unresolved}, packets={self.packets})")
+
+
+class MaelstromRunner:
+    """(ref: maelstrom test Runner/Cluster)."""
+
+    def __init__(self, n_nodes: int = 3, seed: int = 0, shards: int = 8,
+                 mean_latency_micros: int = 1_000,
+                 device_mode: Optional[bool] = None):
+        self.queue = PendingQueue()
+        self.rs = RandomSource(seed)
+        self.net = self.rs.fork()
+        self.names = [f"n{i}" for i in range(1, n_nodes + 1)]
+        self.processes: Dict[str, MaelstromProcess] = {}
+        self.result = RunResult()
+        self.mean_latency = mean_latency_micros
+        scheduler = SimScheduler(self.queue)
+        # client replies (dest "c...") land here
+        self.client_handlers: Dict[int, Callable[[dict], None]] = {}
+        for name in self.names:
+            proc = MaelstromProcess(
+                emit=self._make_emit(name), scheduler=scheduler,
+                now_micros=lambda: self.queue.now,
+                shards=shards, device_mode=device_mode)
+            self.processes[name] = proc
+        # init handshake (ref: Runner sends init to every node first)
+        for i, name in enumerate(self.names):
+            self._deliver(name, {"src": "c0", "dest": name,
+                                 "body": {"type": "init", "msg_id": i + 1,
+                                          "node_id": name,
+                                          "node_ids": list(self.names)}})
+        self.queue_drain()
+
+    # -- network ------------------------------------------------------------
+    def _make_emit(self, src: str) -> Callable[[str, dict], None]:
+        def emit(dest, body: dict) -> None:
+            packet = {"src": src, "dest": dest, "body": body}
+            line = json.dumps(packet)      # full serde on the hot path
+            self.result.packets += 1
+            if isinstance(dest, str) and dest.startswith("c"):
+                handler = self.client_handlers.get(body.get("in_reply_to"))
+                if handler is not None:
+                    self.queue.add(self.queue.now,
+                                   lambda: handler(json.loads(line)["body"]))
+                return
+            delay = self.mean_latency // 2 + self.net.next_int(self.mean_latency + 1)
+            self.queue.add(self.queue.now + delay,
+                           lambda: self._deliver(dest, json.loads(line)))
+        return emit
+
+    def _deliver(self, dest: str, packet: dict) -> None:
+        proc = self.processes.get(dest)
+        if proc is not None:
+            proc.handle(packet)
+
+    def queue_drain(self, max_micros: int = 60_000_000) -> None:
+        """Run until the queue empties or the simulated-time budget is spent
+        (recurring tasks — sweeper, progress-log scans — never exhaust, so
+        the bound is time, as in sim.cluster.run_until_quiescent)."""
+        deadline = self.queue.now + max_micros
+        while self.queue.now <= deadline:
+            fn = self.queue.pop()
+            if fn is None:
+                return
+            fn()
+
+    # -- workload (ref: Runner.java:123-190 generated txn bodies) -----------
+    def run_workload(self, n_ops: int = 50, n_keys: int = 10,
+                     verify: bool = True) -> RunResult:
+        wl = self.rs.fork()
+        verifier = StrictSerializabilityVerifier()
+        next_val = [0]
+        pending = {}
+
+        def submit(i: int):
+            node = self.names[wl.next_int(len(self.names))]
+            n = wl.next_int(3) + 1
+            keys = sorted({wl.next_int(n_keys) for _ in range(n)})
+            ops = []
+            writes = {}
+            reads = []
+            for k in keys:
+                if wl.decide(0.6):
+                    next_val[0] += 1
+                    v = next_val[0]
+                    ops.append(["append", k, v])
+                    writes[token_of(k)] = writes.get(token_of(k), ()) + (v,)
+                else:
+                    ops.append(["r", k, None])
+                    reads.append(token_of(k))
+            op_id = verifier.begin()
+            start = self.queue.now
+            pending[i] = True
+            msg_id = 10_000 + i
+
+            def on_reply(body: dict):
+                pending.pop(i, None)
+                if body.get("type") != "txn_ok":
+                    self.result.ops_failed += 1
+                    return
+                self.result.ops_ok += 1
+                observed = {}
+                for op in body["txn"]:
+                    if op[0] == "r":
+                        t = token_of(op[1])
+                        vals = tuple(op[2])
+                        # strip intra-txn own-appends suffix: the verifier
+                        # models reads as pre-state
+                        own = writes.get(t, ())
+                        if own and vals[-len(own):] == own:
+                            vals = vals[: len(vals) - len(own)]
+                        observed[t] = vals
+                verifier.on_result(op_id, start, self.queue.now,
+                                   observed, writes)
+
+            self.client_handlers[msg_id] = on_reply
+            self._deliver(node, {"src": f"c{i + 1}", "dest": node,
+                                 "body": {"type": "txn", "msg_id": msg_id,
+                                          "txn": ops}})
+
+        for i in range(n_ops):
+            submit(i)
+            if wl.decide(0.3):
+                self.queue_drain()
+        self.queue_drain()
+        self.result.ops_unresolved = len(pending)
+        if verify:
+            # finals: after quiescence every owning replica has the full
+            # list; take the longest copy per token across data stores
+            finals = {}
+            for proc in self.processes.values():
+                for token, (value, _at, _ids) in proc.node.data_store.data.items():
+                    if len(value) > len(finals.get(token, ())):
+                        finals[token] = value
+            for token, value in finals.items():
+                verifier.set_final(token, value)
+            verifier.verify()
+            for proc in self.processes.values():
+                if proc.failures:
+                    raise proc.failures[0]
+        return self.result
