@@ -75,6 +75,11 @@ class CoordinateTransaction(api.Callback):
         status = self.tracker.record_failure(from_id)
         if status is RequestStatus.Failed:
             self._fail(Timeout(self.txn_id))
+        elif status is RequestStatus.Success:
+            # the failure settled the fast-path decision (elector lost ->
+            # fast path impossible, slow quorum already in hand): proceed
+            # (ref: AbstractCoordinatePreAccept.onFailure -> onPreAccepted)
+            self._on_preaccepted()
 
     # -- decision (ref: CoordinateTransaction.java:71-101) ------------------
     def _on_preaccepted(self) -> None:
@@ -92,6 +97,22 @@ class CoordinateTransaction(api.Callback):
             for ok in oks:
                 if ok.witnessed_at > execute_at:
                     execute_at = ok.witnessed_at
+            if execute_at.epoch() > self.txn_id.epoch() and \
+                    not self.txn_id.kind().is_sync_point():
+                # NOTE: done=True was already set above, so _fail() would
+                # no-op — settle the result directly so the caller's
+                # fence-Rejected invalidate-then-retry path triggers
+                # rejectExecuteAt (ref: PreAccept.java:283-335 +
+                # CoordinateTransaction.java:71-101): the slow-path executeAt
+                # crossed into a later epoch — abort and retry with a fresh
+                # TxnId allocated there.  Beyond matching the reference,
+                # this breaks the bootstrap deadlock cycle: an epoch's fence
+                # awaits every LOWER TxnId, and a txn reading from
+                # still-bootstrapping new-epoch replicas can otherwise gate
+                # the very bootstrap it waits on; the fresh id sits ABOVE
+                # the fence, decoupling them.
+                self.result.set_failure(Rejected(self.txn_id))
+                return
             deps = Deps.merge([ok.deps for ok in oks])
             self.node.agent.events_listener().on_slow_path_taken(self.txn_id, deps)
             propose(self.node, Ballot.ZERO, self.txn_id, self.txn, self.route,

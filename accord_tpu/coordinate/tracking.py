@@ -293,6 +293,34 @@ class InvalidationTracker(AbstractTracker):
         return self._record(node, fn)
 
 
+class AllShardTracker(ShardTracker):
+    """Success only when EVERY replica of the shard has responded."""
+
+    def has_all(self) -> bool:
+        return len(self.successes) >= len(self.shard.nodes)
+
+
+class AllTracker(AbstractTracker):
+    """Waits for every replica of every shard — any failure is terminal
+    (ref: the reference AppliedTracker used by CoordinateShardDurable, which
+    requires ALL replicas applied before declaring the shard durable)."""
+
+    shard_tracker_cls = AllShardTracker
+
+    def record_success(self, node: int) -> RequestStatus:
+        def fn(t: AllShardTracker, n: int) -> RequestStatus:
+            t.successes.add(n)
+            return (RequestStatus.Success if t.has_all()
+                    else RequestStatus.NoChange)
+        return self._record(node, fn)
+
+    def record_failure(self, node: int) -> RequestStatus:
+        def fn(t: ShardTracker, n: int) -> RequestStatus:
+            t.failures.add(n)
+            return RequestStatus.Failed
+        return self._record(node, fn)
+
+
 class AppliedTracker(QuorumTracker):
     """Tracks Apply acknowledgements reaching a quorum per shard
     (ref: tracking/AppliedTracker.java)."""

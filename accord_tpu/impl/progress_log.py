@@ -60,7 +60,8 @@ class _HomeEntry:
 
 
 class _BlockedEntry:
-    __slots__ = ("txn_id", "participants", "progress", "countdown", "backoff")
+    __slots__ = ("txn_id", "participants", "progress", "countdown", "backoff",
+                 "empty_fetches")
 
     def __init__(self, txn_id: TxnId, participants):
         self.txn_id = txn_id
@@ -68,6 +69,7 @@ class _BlockedEntry:
         self.progress = _Progress.Expected
         self.countdown = 2
         self.backoff = 2
+        self.empty_fetches = 0   # consecutive fetches that learned nothing
 
     def no_progress(self) -> None:
         self.progress = _Progress.NoProgress
@@ -177,7 +179,18 @@ class SimpleProgressLog(api.ProgressLog):
                 # outcome propagates to us
                 entry.no_progress()
                 if merged is not None and merged.route is not None:
+                    entry.empty_fetches = 0
                     self._inform_home(txn_id, merged.route)
+                else:
+                    # NOTHING known anywhere (no route, no definition): the
+                    # blocker is an abandoned coordination — no home shard
+                    # will ever recover it.  Escalate to invalidation so
+                    # waiters can drop it (ref: the Invalidate leg of
+                    # FetchData/Infer for unwitnessed blockers).
+                    entry.empty_fetches += 1
+                    if entry.empty_fetches >= 2:
+                        entry.empty_fetches = 0
+                        node.invalidate_abandoned(txn_id, entry.participants)
             self._arm()
 
         fetch_data(node, txn_id, entry.participants, txn_id.epoch()) \

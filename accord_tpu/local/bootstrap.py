@@ -57,6 +57,7 @@ class Bootstrap:
         # coordinated txn whose deps transitively cover everything pruned.
         bootstrapped_at = node.next_txn_id(TxnKind.ExclusiveSyncPoint,
                                            Domain.Range)
+        self._current_fence = bootstrapped_at
         self.store.redundant_before.add_bootstrapped(self.ranges, bootstrapped_at)
         self.store.bootstrapping = self.store.bootstrapping.with_(self.ranges)
         # 2. fence, coordinated AT the watermark id
@@ -67,6 +68,10 @@ class Bootstrap:
 
     def _on_fenced(self, sync_point, failure) -> None:
         if failure is not None:
+            # invalidate the abandoned fence id before retrying with a fresh
+            # one: replicas that witnessed it hold an undecided zombie dep
+            # otherwise (see Node.invalidate_abandoned)
+            self.node.invalidate_abandoned(self._current_fence, self.ranges)
             self.node.agent.on_failed_bootstrap("fence", self.ranges,
                                                 self._retry, failure)
             return
@@ -102,18 +107,27 @@ class Bootstrap:
                     donors.append(n)
         return donors
 
-    def _fetch(self, donors: List[int], remaining: Ranges, fence) -> None:
+    def _fetch(self, donors: List[int], remaining: Ranges, fence,
+               cycle: int = 0) -> None:
         """Fetch ``remaining`` from donors in turn; each donor may cover only
         part, so iterate until nothing remains.  Exhausting the donor list
-        with data still missing is a FAILURE and retries — never a silent
-        completion.  ``fence`` is the ExclusiveSyncPoint TxnId the donor must
-        have locally applied before serving (see messages/fetch_snapshot.py)."""
+        with data still missing re-polls the SAME fence after a short
+        backoff (donors defer while the fence is unapplied locally; a fresh
+        consensus round for a new fence is only needed if the fence itself
+        died — the full-restart fallback after several dry cycles).
+        ``fence`` is the ExclusiveSyncPoint TxnId the donor must have
+        locally applied before serving (see messages/fetch_snapshot.py)."""
         from ..messages.fetch_snapshot import FetchSnapshot, FetchSnapshotOk
         node = self.node
         if remaining.is_empty():
             self._complete()
             return
         if not donors:
+            if cycle < 6:
+                delay = 700_000 + node.random.next_int(600_000)
+                node.scheduler.once(delay, lambda: self._fetch(
+                    self._donors(), remaining, fence, cycle + 1))
+                return
             self.node.agent.on_failed_bootstrap(
                 "fetch", remaining, self._retry,
                 RuntimeError(f"all donors exhausted with {remaining} missing"))
@@ -127,15 +141,16 @@ class Bootstrap:
                     return
                 if isinstance(reply, FetchSnapshotOk):
                     node.data_store.install_snapshot(reply.snapshot)
-                    outer._fetch(rest, remaining.without(reply.covered), fence)
+                    outer._fetch(rest, remaining.without(reply.covered),
+                                 fence, cycle)
                 else:
-                    outer._fetch(rest, remaining, fence)
+                    outer._fetch(rest, remaining, fence, cycle)
 
             def on_failure(self, from_id: int, failure: BaseException) -> None:
                 if outer.done:
                     return
                 node.agent.on_handled_exception(failure)
-                outer._fetch(rest, remaining, fence)
+                outer._fetch(rest, remaining, fence, cycle)
 
         node.send(donor, FetchSnapshot(remaining, self.epoch - 1, fence), Cb())
 

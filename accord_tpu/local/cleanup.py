@@ -1,0 +1,173 @@
+"""Cleanup: watermark-driven truncation and erasure of command state.
+
+Rebuild of ref: accord-core/src/main/java/accord/local/Cleanup.java (the
+NO/TRUNCATE/ERASE decision), CommandStore.java:516-532
+(markExclusiveSyncPointLocallyApplied / markShardDurable), and the
+truncation entry points Commands.java:879-975.
+
+The lifecycle that makes state bounded:
+
+ 1. An ExclusiveSyncPoint S applies locally.  Because its kind
+    awaits_only_deps, every TxnId < S on its ranges has locally applied or
+    been invalidated -> advance RedundantBefore.locally_applied_before.
+ 2. CoordinateShardDurable observed S applied at EVERY replica of the shard
+    and broadcasts SetShardDurable(S) -> mark_shard_durable: advance
+    RedundantBefore.redundant_before (the shard watermark), DurableBefore
+    majority+universal, prune CommandsForKey below S, free device deps-index
+    slots, and truncate/erase eligible commands.
+ 3. CoordinateGloballyDurable gossips merged DurableBefore maps so replicas
+    that missed a SetShardDurable catch up.
+
+After step 2 the deps floor (RedundantBefore.deps_floor) has risen, so
+PreAccept dep sets stay O(live txns) and the conflict indexes stay bounded.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING
+
+from ..primitives.keys import Ranges
+from ..primitives.timestamp import TxnId
+from .status import SaveStatus, Status
+
+if TYPE_CHECKING:
+    from .command_store import SafeCommandStore
+
+
+class Cleanup(enum.IntEnum):
+    """(ref: local/Cleanup.java)."""
+    NO = 0
+    TRUNCATE = 1   # drop txn/deps/writes, keep the Applied marker
+    ERASE = 2      # drop the record entirely
+
+
+def mark_exclusive_sync_point_locally_applied(safe: "SafeCommandStore",
+                                              sync_id: TxnId,
+                                              ranges: Ranges) -> None:
+    """(ref: CommandStore.markExclusiveSyncPointLocallyApplied :516)."""
+    owned = safe.store.ranges_for_epoch.all().intersecting(ranges)
+    if owned.is_empty():
+        return
+    safe.redundant_before().add_locally_applied(owned, sync_id)
+
+
+def mark_shard_durable(safe: "SafeCommandStore", sync_id: TxnId,
+                       ranges: Ranges) -> None:
+    """(ref: CommandStore.markShardDurable :524-532).  ``sync_id`` is an
+    ExclusiveSyncPoint applied at EVERY replica of these ranges."""
+    store = safe.store
+    owned = store.ranges_for_epoch.all().intersecting(ranges)
+    if owned.is_empty():
+        return
+    safe.redundant_before().add_redundant(owned, sync_id)
+    # applied at every replica => majority AND universal within the shard
+    store.durable_before.add_majority(owned, sync_id)
+    store.durable_before.add_universal(owned, sync_id)
+    # the deps floor rose: prune per-key conflict indexes below it
+    for token, cfk in store.commands_for_key.items():
+        if owned.contains_token(token):
+            cfk.set_prune_before(sync_id)
+    cleanup_store(safe)
+
+
+def on_durable_before_advance(safe: "SafeCommandStore") -> None:
+    """A gossiped DurableBefore advance (SetGloballyDurable) may newly
+    qualify commands for erasure."""
+    cleanup_store(safe)
+
+
+def decide(safe: "SafeCommandStore", cmd) -> Cleanup:
+    """The Cleanup decision for one command (ref: local/Cleanup.java).
+    Conservative: requires the shard watermark (everything below it applied
+    at every replica) plus the matching durability tier."""
+    txn_id = cmd.txn_id
+    if cmd.save_status is SaveStatus.Uninitialised:
+        return Cleanup.NO
+    participants = _participants_of(cmd)
+    from .redundant import RedundantStatus
+    if participants is None or participants.is_empty():
+        # placeholder record (dep never witnessed with a definition): erase
+        # once the watermarks over everything we own have passed it
+        owned = safe.store.ranges_for_epoch.all()
+        if not owned.is_empty() \
+                and txn_id < safe.store.durable_before.min_universal_before(owned) \
+                and safe.redundant_before().status(txn_id, owned) is \
+                RedundantStatus.SHARD_REDUNDANT:
+            return Cleanup.ERASE
+        return Cleanup.NO
+    if safe.redundant_before().status(txn_id, participants) is not \
+            RedundantStatus.SHARD_REDUNDANT:
+        return Cleanup.NO
+    # never truncate an undrained local record: a committed-but-unapplied
+    # command still owes its writes here (witnessed via a dual-quorum window
+    # but applying elsewhere); erasing it is how writes get lost
+    if not (cmd.has_been(Status.Applied) or cmd.is_invalidated()
+            or cmd.save_status is SaveStatus.Uninitialised
+            or not cmd.has_been(Status.Committed)):
+        return Cleanup.NO
+    db = safe.store.durable_before
+    from .redundant import _as_ranges
+    ranges = _as_ranges(participants)
+    if txn_id < db.min_universal_before(ranges):
+        return Cleanup.ERASE
+    if txn_id < db.min_majority_before(ranges):
+        return Cleanup.TRUNCATE
+    return Cleanup.NO
+
+
+def cleanup_store(safe: "SafeCommandStore") -> int:
+    """Sweep every command against the watermarks; truncate/erase the
+    eligible ones and release their index state.  Returns #commands
+    released (ref: the Cleanup hook in SafeCommandStore.get + the journal
+    purger; ours sweeps eagerly at watermark advances)."""
+    from . import commands as commands_mod
+    store = safe.store
+    released = 0
+    for txn_id in list(store.commands.keys()):
+        cmd = store.commands.get(txn_id)
+        if cmd is None:
+            continue
+        decision = decide(safe, cmd)
+        if decision is Cleanup.NO:
+            continue
+        _release_indexes(store, cmd)
+        if decision is Cleanup.ERASE:
+            # drop the record entirely; RedundantBefore answers for it now
+            commands_mod.set_erased(safe, txn_id)
+            del store.commands[txn_id]
+            store.transient_listeners.pop(txn_id, None)
+        else:
+            commands_mod.set_truncated_apply(safe, txn_id)
+        released += 1
+    _prune_cfks(store)
+    return released
+
+
+def _release_indexes(store, cmd) -> None:
+    txn_id = cmd.txn_id
+    store.range_commands.pop(txn_id, None)
+    if store.device is not None:
+        store.device.free(txn_id)
+    if cmd.partial_txn is not None and not isinstance(cmd.partial_txn.keys,
+                                                     Ranges):
+        for key in cmd.partial_txn.keys:
+            cfk = store.commands_for_key.get(key.token())
+            if cfk is not None:
+                cfk.remove(txn_id)
+
+
+def _prune_cfks(store) -> None:
+    """Physically drop per-key entries below each CFK's prune watermark —
+    everything below it has applied (or been invalidated) at every replica
+    of the shard, so no dep set or recovery query needs it again."""
+    for cfk in store.commands_for_key.values():
+        cfk.prune()
+
+
+def _participants_of(cmd):
+    if cmd.partial_txn is not None:
+        return cmd.partial_txn.keys
+    if cmd.route is not None:
+        return cmd.route.participants
+    return None

@@ -550,11 +550,50 @@ class CommandStores:
         """Safe-to-read gate: any intersecting store still bootstrapping its
         snapshot cannot serve reads (ref: safeToRead,
         local/CommandStore.java:159-176)."""
-        for s in self.stores:
-            if not s.bootstrapping.is_empty() and \
-                    participants.intersects(s.bootstrapping):
-                return True
-        return False
+        return bool(self._read_blockers(participants))
+
+    def _read_blockers(self, participants) -> List[CommandStore]:
+        return [s for s in self.stores
+                if not s.bootstrapping.is_empty()
+                and participants.intersects(s.bootstrapping)]
+
+    def when_readable(self, participants, fn: Callable[[], None],
+                      on_unavailable: Optional[Callable[[], None]] = None,
+                      deadline_micros: int = 500_000) -> None:
+        """Run ``fn`` once no intersecting store is mid-bootstrap — reads
+        DEFER behind the safe-to-read gate rather than refusing (the
+        reference's ReadData waits on safeToRead; refusing turns every
+        bootstrap window into read unavailability for the whole shard).
+
+        The deferral carries a deadline: a bootstrap can itself be gated on
+        transactions whose Apply needs this read (the fence awaits every
+        lower TxnId), so waiting forever deadlocks the cycle.  Past the
+        deadline, ``on_unavailable`` fires and the coordinator falls back to
+        another replica / recovery, which breaks the cycle."""
+        blockers = self._read_blockers(participants)
+        if not blockers:
+            fn()
+            return
+        state = {"n": len(blockers), "fired": False}
+
+        def one_done():
+            state["n"] -= 1
+            if state["n"] == 0 and not state["fired"]:
+                state["fired"] = True
+                # re-check: another bootstrap may have begun meanwhile
+                self.when_readable(participants, fn, on_unavailable,
+                                   deadline_micros)
+
+        def expire():
+            if not state["fired"]:
+                state["fired"] = True
+                if on_unavailable is not None:
+                    on_unavailable()
+
+        for s in blockers:
+            s.defer_until_bootstrap(one_done)
+        if on_unavailable is not None:
+            self.node.scheduler.once(deadline_micros, expire)
 
     def unsafe_all_stores(self) -> List[CommandStore]:
         return list(self.stores)

@@ -392,6 +392,16 @@ def initialise_waiting_on(safe: SafeCommandStore, txn_id: TxnId,
     if partial_deps is None:
         return WaitingOn.none()
     owned = safe.ranges(execute_at.epoch()).with_(safe.ranges(txn_id.epoch()))
+    # The deps' covering records the window this store processed the commit
+    # over — for a dual-quorum ESP (bootstrap/durability fence) that window
+    # reaches BELOW txn_id.epoch to the store's prior-epoch ranges.  A donor
+    # that lost the range in the new epoch must still wait on its old-range
+    # deps before the fence applies locally (the snapshot-coverage gate), so
+    # the waiting set is built over the union (ref: Commands.initialiseWaitingOn
+    # uses safeStore.ranges().allBetween(minEpoch, executeAt.epoch())).
+    covering = getattr(partial_deps, "covering", None)
+    if covering is not None:
+        owned = owned.with_(covering)
     dep_ids: List[TxnId] = []
     seen = set()
     for token in partial_deps.key_deps.keys:
@@ -456,9 +466,16 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
     if dep_cmd.is_invalidated() or dep_cmd.is_truncated() or dep_cmd.save_status is SaveStatus.Applied:
         return waiting_on.with_done(dep, True)
     dep_execute_at = dep_cmd.execute_at_if_known()
-    if dep_execute_at is not None and dep_execute_at > execute_at:
-        # executes after us: not our dependency (ref: updateWaitingOn)
-        return waiting_on.with_done(dep, False)
+    if dep_execute_at is not None and _never_applies_here(safe, dep_cmd,
+                                                          dep_execute_at):
+        # will never apply on this store (exec-epoch ownership moved):
+        # see _dep_clearance
+        return waiting_on.with_done(dep, True)
+    if not txn_id.kind().awaits_only_deps():
+        if dep_execute_at is not None and dep_execute_at > execute_at:
+            # executes after us: not our dependency (ref: updateWaitingOn;
+            # skipped for awaits-only-deps kinds, ref: Commands.java:804)
+            return waiting_on.with_done(dep, False)
     if not device:
         safe.update(dep_cmd.with_listener(txn_id), notify=False)
     # Report the blocker whether it is undecided (we may have missed its
@@ -540,9 +557,14 @@ def _apply_writes(safe: SafeCommandStore, cmd: Command) -> None:
         owned = cmd.partial_txn.covering
     else:
         owned = safe.ranges(cmd.execute_at.epoch())
-    # a post-bootstrap write landing before the snapshot installs would be
-    # clobbered by (or clobber) the snapshot's earlier appends — defer the
-    # whole apply until bootstrap completes; defer order == drain order
+    # a write landing mid-bootstrap is deferred until the snapshot installs
+    # (defer order == drain order); thereafter applying DIRECTLY is always
+    # safe — the versioned data store inserts at the executeAt-sorted
+    # position and dedups by TxnId, so snapshot and direct apply form a
+    # monotone union whichever subset each delivered (the old
+    # "snapshot-covered" skip assumed snapshot completeness and lost writes
+    # whenever a donor legitimately served before a new-epoch-executing
+    # txn applied at it)
     if not store.bootstrapping.is_empty() and cmd.writes is not None \
             and not cmd.writes.is_empty() \
             and cmd.writes.keys.intersects(store.bootstrapping):
@@ -551,15 +573,6 @@ def _apply_writes(safe: SafeCommandStore, cmd: Command) -> None:
             lambda: store.execute(PreLoadContext.for_txn(txn_id),
                                   lambda s: _apply_writes(s, s.get(txn_id))))
         return
-    # Writes EXECUTING below the bootstrap fence are covered by the snapshot
-    # (the donor serves it only after the fence applied locally); applying
-    # them here could go back in time vs the snapshot.  Writes executing
-    # ABOVE the fence must apply even when their TxnId predates the
-    # watermark — the snapshot will not contain them
-    # (ref: Commands.applyRanges gates the data write on executeAt).
-    covered = safe.redundant_before().snapshot_covered_ranges(cmd.execute_at)
-    if not covered.is_empty():
-        owned = owned.without(covered)
 
     def on_done(_result, failure):
         if failure is not None:
@@ -585,6 +598,15 @@ def post_apply(safe: SafeCommandStore, txn_id: TxnId) -> None:
     safe.notify_listeners(new_cmd)
     safe.notify_transient(new_cmd)
     safe.progress_log().durable_local(safe, txn_id)
+    if txn_id.kind() is TxnKind.ExclusiveSyncPoint and \
+            new_cmd.partial_txn is not None and \
+            isinstance(new_cmd.partial_txn.keys, Ranges):
+        # an applied ESP awaited ALL lower TxnIds (awaits_only_deps): advance
+        # the local redundancy watermark
+        # (ref: Commands.java:721-725 -> markExclusiveSyncPointLocallyApplied)
+        from .cleanup import mark_exclusive_sync_point_locally_applied
+        mark_exclusive_sync_point_locally_applied(
+            safe, txn_id, new_cmd.partial_txn.keys)
 
 
 # ---------------------------------------------------------------------------
@@ -604,18 +626,50 @@ def listener_update(safe: SafeCommandStore, listener_id: TxnId,
     update_dependency_and_maybe_execute(safe, listener, dep)
 
 
-def _dep_clearance(dep: Command, listener_execute_at) -> Optional[bool]:
+def _dep_clearance(safe: SafeCommandStore, dep: Command,
+                   listener_txn_id: TxnId,
+                   listener_execute_at) -> Optional[bool]:
     """The one clearing rule both drain mechanisms share
     (ref: Commands.updateWaitingOn): None = still gating; True = dep is
-    applied/invalidated/truncated; False = dep executes after us."""
+    applied/invalidated/truncated (or will never apply on this store);
+    False = dep executes after us.  Waiters whose kind awaits_only_deps
+    (ExclusiveSyncPoint/EphemeralRead) never drop executes-after deps
+    (ref: Commands.java:804) — their local apply must prove every lower
+    TxnId applied."""
     if dep.save_status is SaveStatus.Applied or dep.is_invalidated() \
             or dep.is_truncated():
         return True
     dep_execute_at = dep.execute_at_if_known()
+    if dep_execute_at is not None and _never_applies_here(safe, dep,
+                                                         dep_execute_at):
+        # The dep executes in an epoch where this store owns none of its
+        # participation: its Apply fan-out will never arrive here, so
+        # waiting would deadlock the epoch handoff (e.g. a donor's fence
+        # awaiting a new-epoch txn).  The joiner receives it directly and
+        # the versioned data store's txn-id-keyed union keeps reads exact.
+        return True
+    if listener_txn_id.kind().awaits_only_deps():
+        return None
     if (dep_execute_at is not None and listener_execute_at is not None
             and dep_execute_at > listener_execute_at):
         return False
     return None
+
+
+def _never_applies_here(safe: SafeCommandStore, dep: Command,
+                        dep_execute_at: Timestamp) -> bool:
+    participants = None
+    if dep.partial_txn is not None:
+        participants = dep.partial_txn.keys
+    elif dep.route is not None:
+        participants = dep.route.participants
+    if participants is None:
+        return False   # unknown participation: stay conservative
+    window = safe.ranges(dep_execute_at.epoch()).with_(
+        safe.ranges(dep.txn_id.epoch()))
+    if isinstance(participants, Ranges):
+        return not window.intersects(participants)
+    return not participants.intersects(window)
 
 
 def update_dependency_and_maybe_execute(safe: SafeCommandStore,
@@ -626,7 +680,7 @@ def update_dependency_and_maybe_execute(safe: SafeCommandStore,
         return
     new_waiting = listener.waiting_on
     remove_listener = False
-    cleared = _dep_clearance(dep, listener.execute_at)
+    cleared = _dep_clearance(safe, dep, listener.txn_id, listener.execute_at)
     if cleared is not None:
         new_waiting = new_waiting.with_done(dep.txn_id, cleared)
         remove_listener = True
@@ -657,7 +711,7 @@ def refresh_waiting_and_maybe_execute(safe: SafeCommandStore,
         dep_cmd = safe.if_present(dep)
         if dep_cmd is None:
             continue
-        cleared = _dep_clearance(dep_cmd, cmd.execute_at)
+        cleared = _dep_clearance(safe, dep_cmd, txn_id, cmd.execute_at)
         if cleared is not None:
             w = w.with_done(dep, cleared)
     if w is not cmd.waiting_on:
@@ -681,12 +735,16 @@ def set_durability(safe: SafeCommandStore, txn_id: TxnId,
 
 
 def set_truncated_apply(safe: SafeCommandStore, txn_id: TxnId) -> None:
+    """Truncate a majority-durable applied command: drop txn/deps/waiting but
+    KEEP the outcome (writes/result) — a recovery adopting this txn's result
+    for a wedged client coordinator still needs it (ref: SaveStatus
+    TruncatedApplyWithOutcome; outcome drops only at ERASE)."""
     cmd = safe.get(txn_id)
     if cmd.is_truncated():
         return
     new_cmd = cmd.updated(save_status=SaveStatus.TruncatedApply,
                           partial_txn=None, partial_deps=None,
-                          waiting_on=None, writes=None, result=None)
+                          waiting_on=None)
     safe.update(new_cmd)
     safe.notify_listeners(new_cmd)
 

@@ -106,6 +106,22 @@ class CommandsForKey:
         if self.prune_before is None or txn_id > self.prune_before:
             self.prune_before = txn_id
 
+    def prune(self) -> int:
+        """Physically drop entries below the prune watermark — the shard
+        watermark guarantees everything below it has applied (or been
+        invalidated) at every replica, so no dep set or recovery query needs
+        them (ref: CommandsForKey.java prune vs RedundantBefore).  Returns
+        #entries dropped."""
+        if self.prune_before is None:
+            return 0
+        cut = bisect.bisect_left(self._ids, self.prune_before)
+        if cut == 0:
+            return 0
+        for tid in self._ids[:cut]:
+            del self._infos[tid]
+        del self._ids[:cut]
+        return cut
+
     # -- scan API -----------------------------------------------------------
     def map_reduce_active(self, started_before: Timestamp, witnesses: Kinds,
                           fn: Callable[[TxnId, "object"], "object"], acc):

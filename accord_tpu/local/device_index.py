@@ -53,6 +53,22 @@ def _pow2_at_least(n: int, floor: int = _MIN_INTERVALS) -> int:
     return out
 
 
+@jax.jit
+def _scatter_rows(table: dk.DepsTable, idx, msb, lsb, node, kind, status,
+                  lo, hi) -> dk.DepsTable:
+    """One fused dirty-row update for all seven table arrays (a single jit
+    dispatch instead of seven eager scatters — the update-in-place path that
+    keeps the table device-resident between queries)."""
+    return dk.DepsTable(
+        table.msb.at[idx].set(msb),
+        table.lsb.at[idx].set(lsb),
+        table.node.at[idx].set(node),
+        table.kind.at[idx].set(kind),
+        table.status.at[idx].set(status),
+        table.lo.at[idx].set(lo),
+        table.hi.at[idx].set(hi))
+
+
 def _grow(arr: np.ndarray, new_len: int, fill) -> np.ndarray:
     out = np.full((new_len,) + arr.shape[1:], fill, dtype=arr.dtype)
     out[: arr.shape[0]] = arr
@@ -172,17 +188,22 @@ class _DepsMirror:
                 jnp.asarray(self.hi))
             self._dirty.clear()
         elif self._dirty:
-            idx = jnp.asarray(sorted(self._dirty), jnp.int32)
-            t = self._device
-            rows = np.array(sorted(self._dirty))
-            self._device = dk.DepsTable(
-                t.msb.at[idx].set(self.msb[rows]),
-                t.lsb.at[idx].set(self.lsb[rows]),
-                t.node.at[idx].set(self.node[rows]),
-                t.kind.at[idx].set(self.kind[rows]),
-                t.status.at[idx].set(self.status[rows]),
-                t.lo.at[idx].set(self.lo[rows]),
-                t.hi.at[idx].set(self.hi[rows]))
+            rows = np.array(sorted(self._dirty), np.int32)
+            if len(rows) * 2 >= self.capacity:
+                # mostly dirty: a full upload is cheaper than a scatter
+                self._device = None
+                return self.device_table()
+            # pad to a power-of-two bucket (repeating the last row: scatter
+            # of identical values is idempotent) so jit caches one
+            # compilation per bucket instead of one per dirty-count
+            padded = _pow2_at_least(len(rows), 8)
+            rows = np.concatenate([rows, np.full(padded - len(rows),
+                                                 rows[-1], np.int32)])
+            self._device = _scatter_rows(
+                self._device, jnp.asarray(rows),
+                self.msb[rows], self.lsb[rows], self.node[rows],
+                self.kind[rows], self.status[rows],
+                self.lo[rows], self.hi[rows])
             self._dirty.clear()
         return self._device
 
@@ -198,6 +219,7 @@ class _DrainMirror:
         self.exec_msb = np.zeros(capacity, np.int64)
         self.exec_lsb = np.zeros(capacity, np.int64)
         self.exec_node = np.zeros(capacity, np.int32)
+        self.awaits_all = np.zeros(capacity, bool)
         self.active = np.zeros(capacity, bool)   # rows being driven to execution
         self.slot_of: Dict[TxnId, int] = {}
         self.id_of: Dict[int, TxnId] = {}
@@ -216,6 +238,7 @@ class _DrainMirror:
         self.exec_msb[slot] = 0
         self.exec_lsb[slot] = 0
         self.exec_node[slot] = 0
+        self.awaits_all[slot] = txn_id.kind().awaits_only_deps()
         self.adj[slot, :] = False
         self.adj[:, slot] = False
         self.active[slot] = False
@@ -241,6 +264,7 @@ class _DrainMirror:
         self.exec_msb = _grow(self.exec_msb, new, 0)
         self.exec_lsb = _grow(self.exec_lsb, new, 0)
         self.exec_node = _grow(self.exec_node, new, 0)
+        self.awaits_all = _grow(self.awaits_all, new, False)
         self.active = _grow(self.active, new, False)
         self.free_slots.extend(range(new - 1, old - 1, -1))
         self.capacity = new
@@ -253,11 +277,29 @@ class _DrainMirror:
             self.exec_lsb[slot] = to_i64(execute_at.lsb)
             self.exec_node[slot] = execute_at.node
 
-    def state(self) -> drk.DrainState:
-        return drk.DrainState(
-            jnp.asarray(self.adj), jnp.asarray(self.status),
-            jnp.asarray(self.exec_msb), jnp.asarray(self.exec_lsb),
-            jnp.asarray(self.exec_node))
+    def state(self) -> Tuple[drk.DrainState, np.ndarray]:
+        """Compacted drain state over LIVE slots only (padded to a power-of-
+        two bucket so jit caches per bucket): the kernel cost scales with the
+        in-flight set, not the high-water capacity.  Returns (state,
+        live_slot_index) for mapping frontier rows back to slots."""
+        live = np.nonzero(self.status != dk.SLOT_FREE)[0]
+        n = _pow2_at_least(len(live), 16)
+        adj = np.zeros((n, n), bool)
+        adj[: len(live), : len(live)] = self.adj[np.ix_(live, live)]
+        status = np.full(n, dk.SLOT_FREE, np.int32)
+        status[: len(live)] = self.status[live]
+        ts0 = np.zeros(n, np.int64)
+        em, el = ts0.copy(), ts0.copy()
+        en = np.zeros(n, np.int32)
+        aw = np.zeros(n, bool)
+        em[: len(live)] = self.exec_msb[live]
+        el[: len(live)] = self.exec_lsb[live]
+        en[: len(live)] = self.exec_node[live]
+        aw[: len(live)] = self.awaits_all[live]
+        state = drk.DrainState(jnp.asarray(adj), jnp.asarray(status),
+                               jnp.asarray(em), jnp.asarray(el),
+                               jnp.asarray(en), jnp.asarray(aw))
+        return state, live
 
     def sweep_free(self) -> None:
         """Release slots that can no longer gate anything: terminal status,
@@ -427,32 +469,43 @@ class DeviceState:
             self.drain.active[slot] = False
             self.drain.adj[slot, :] = False
 
+    # Coalescing quantum for drain ticks (simulated/real micros): many dep
+    # transitions land per tick, so the per-tick adjacency upload + kernel
+    # sweep amortizes across a whole antichain instead of firing per event.
+    TICK_DELAY_MICROS = 2_000
+
     def schedule_tick(self) -> None:
         if self._tick_scheduled:
             return
         self._tick_scheduled = True
         from .command_store import PreLoadContext
-        self.store.execute(PreLoadContext.empty(), self._tick)
+
+        def run():
+            self.store.execute(PreLoadContext.empty(), self._tick)
+
+        self.store.node.scheduler.once(self.TICK_DELAY_MICROS, run)
 
     def _tick(self, safe) -> None:
         from . import commands
         self._tick_scheduled = False
         self.n_ticks += 1
+        sweep_due = self.n_ticks % 8 == 0
         if not self.drain.active.any():
-            self.drain.sweep_free()
+            if sweep_due:
+                self.drain.sweep_free()
             return
-        ready = np.asarray(drk.ready_frontier(self.drain.state()))
-        cand_slots = np.nonzero(ready & self.drain.active)[0]
-        if len(cand_slots) == 0:
+        state, live = self.drain.state()
+        ready = np.asarray(drk.ready_frontier(state))[: len(live)]
+        cand_slots = live[ready & self.drain.active[live]]
+        if len(cand_slots) != 0:
+            cands = sorted(
+                (self.drain.id_of[int(s)] for s in cand_slots
+                 if int(s) in self.drain.id_of),
+                key=_exec_order_key(safe))
+            for txn_id in cands:
+                commands.refresh_waiting_and_maybe_execute(safe, txn_id)
+        if sweep_due:
             self.drain.sweep_free()
-            return
-        cands = sorted(
-            (self.drain.id_of[int(s)] for s in cand_slots
-             if int(s) in self.drain.id_of),
-            key=_exec_order_key(safe))
-        for txn_id in cands:
-            commands.refresh_waiting_and_maybe_execute(safe, txn_id)
-        self.drain.sweep_free()
 
 
 def _exec_order_key(safe):

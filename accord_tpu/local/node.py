@@ -127,19 +127,47 @@ class Node:
         if owned.is_empty():
             self._ack_epoch(epoch)
             return
+        sync_id = self.next_txn_id(TxnKind.ExclusiveSyncPoint, Domain.Range)
 
         def on_done(_sp, failure):
             if failure is not None:
-                # jittered backoff: a preempted sync point is being finished
-                # by someone's recovery — don't stampede with a fresh one
+                # Invalidate the abandoned fence id FIRST: replicas that
+                # witnessed it hold it in later txns' dep sets, and an
+                # undecided zombie dep stalls their execution until a slow
+                # recovery cycle invalidates it.  Then retry with a fresh id
+                # after a jittered backoff (don't stampede a recovery that
+                # may be finishing the old one — invalidation is
+                # best-effort and loses cleanly to a live ballot).
                 self.agent.on_handled_exception(failure)
+                self.invalidate_abandoned(sync_id, owned)
                 delay = 1_000_000 + self.random.next_int(1_000_000)
                 self.scheduler.once(delay,
                                     lambda: self._start_epoch_sync(topology))
             else:
                 self._ack_epoch(epoch)
 
-        coordinate_sync_point(self, owned, exclusive=True).begin(on_done)
+        coordinate_sync_point(self, owned, exclusive=True,
+                              txn_id=sync_id).begin(on_done)
+
+    def invalidate_abandoned(self, txn_id: TxnId, participants) -> None:
+        """Best-effort invalidation of a coordination this node is
+        abandoning (a fence id it will not retry).  If the txn actually
+        decided somewhere, the invalidation ballot loses and recovery
+        completes it — either terminal state unblocks waiters."""
+        from ..coordinate.recover import _next_ballot_bits, _propose_invalidate
+        from ..primitives.keys import Route as _Route
+        from ..primitives.timestamp import Ballot
+        route = _Route(None, participants, is_full=False)
+        ballot = Ballot(*_next_ballot_bits(self))
+        try:
+            topologies = self.topology().for_epoch(participants,
+                                                   txn_id.epoch())
+        except Exception:
+            return
+        _propose_invalidate(self, txn_id, route, ballot, topologies,
+                            on_invalidated=lambda: None,
+                            on_redundant=lambda: None,
+                            on_failed=lambda _f: None)
 
     def _ack_epoch(self, epoch: int) -> None:
         self.topology_manager.on_epoch_sync_complete(self.node_id, epoch)
@@ -291,6 +319,15 @@ class Node:
             if result.is_done() or superseded["flag"]:
                 return
             if failure is not None:
+                from ..coordinate.errors import Invalidated, Truncated
+                if isinstance(failure, (Truncated, Invalidated)):
+                    # terminal: the txn's window is below the redundancy
+                    # watermark with no decided state reachable — the op is
+                    # indeterminate for the client; retrying the recovery
+                    # can never learn more (ref: Infer's truncated-outcome
+                    # mapping in coordinate/Infer.java)
+                    result.set_failure(failure)
+                    return
                 self.agent.on_handled_exception(failure)
                 self.scheduler.once(5_000_000, watchdog)
                 return
@@ -298,9 +335,11 @@ class Node:
             if outcome == "invalidated":
                 from ..coordinate.errors import Invalidated
                 result.set_failure(Invalidated(txn_id))
-            elif outcome in ("applied", "executed"):
+            elif outcome in ("applied", "executed") and payload is not None:
                 result.set_success(payload)
             else:
+                # applied but the outcome was already erased everywhere we
+                # asked: the txn took effect but the client result is gone
                 from ..coordinate.errors import Truncated
                 result.set_failure(Truncated(txn_id))
 
@@ -338,7 +377,7 @@ class Node:
             outcome, payload = value
             if outcome == "invalidated":
                 retry()
-            elif outcome in ("applied", "executed"):
+            elif outcome in ("applied", "executed") and payload is not None:
                 result.set_success(payload)
             else:
                 from ..coordinate.errors import Truncated

@@ -46,16 +46,23 @@ class RedundantStatus(enum.IntEnum):
 
 
 class RedundantEntry:
-    """(ref: RedundantBefore.Entry)."""
+    """(ref: RedundantBefore.Entry).  ``redundant_before`` is the SHARD
+    watermark (shardAppliedOrInvalidatedBefore: applied at every healthy
+    replica — set by SetShardDurable); ``locally_applied_before`` is the
+    LOCAL watermark (locallyAppliedOrInvalidatedBefore: set when an
+    ExclusiveSyncPoint applies locally, ref: CommandStore.java:516,721-725)."""
 
-    __slots__ = ("redundant_before", "bootstrapped_at", "stale_until_at_least")
+    __slots__ = ("redundant_before", "bootstrapped_at", "stale_until_at_least",
+                 "locally_applied_before")
 
     def __init__(self, redundant_before: TxnId = TxnId.NONE,
                  bootstrapped_at: TxnId = TxnId.NONE,
-                 stale_until_at_least: Optional[Timestamp] = None):
+                 stale_until_at_least: Optional[Timestamp] = None,
+                 locally_applied_before: TxnId = TxnId.NONE):
         self.redundant_before = redundant_before
         self.bootstrapped_at = bootstrapped_at
         self.stale_until_at_least = stale_until_at_least
+        self.locally_applied_before = locally_applied_before
 
     def merge(self, other: "RedundantEntry") -> "RedundantEntry":
         stale = self.stale_until_at_least
@@ -64,7 +71,8 @@ class RedundantEntry:
         return RedundantEntry(
             max(self.redundant_before, other.redundant_before),
             max(self.bootstrapped_at, other.bootstrapped_at),
-            stale)
+            stale,
+            max(self.locally_applied_before, other.locally_applied_before))
 
     def status_of(self, txn_id: TxnId) -> RedundantStatus:
         if self.stale_until_at_least is not None or txn_id < self.bootstrapped_at:
@@ -77,7 +85,8 @@ class RedundantEntry:
         return (isinstance(o, RedundantEntry)
                 and self.redundant_before == o.redundant_before
                 and self.bootstrapped_at == o.bootstrapped_at
-                and self.stale_until_at_least == o.stale_until_at_least)
+                and self.stale_until_at_least == o.stale_until_at_least
+                and self.locally_applied_before == o.locally_applied_before)
 
 
 class RedundantBefore:
@@ -89,7 +98,16 @@ class RedundantBefore:
         self._map: ReducingRangeMap = ReducingRangeMap.empty()
 
     def add_redundant(self, ranges: Ranges, redundant_before: TxnId) -> None:
+        """Advance the SHARD-applied watermark (ref: markShardDurable)."""
         self._merge(ranges, RedundantEntry(redundant_before=redundant_before))
+
+    def add_locally_applied(self, ranges: Ranges, before: TxnId) -> None:
+        """Advance the LOCAL-applied watermark: an ExclusiveSyncPoint with
+        TxnId ``before`` applied locally, so every lower TxnId on these
+        ranges has locally applied or been invalidated
+        (ref: markExclusiveSyncPointLocallyApplied, CommandStore.java:516)."""
+        self._merge(ranges, RedundantEntry(locally_applied_before=before))
+
 
     def add_bootstrapped(self, ranges: Ranges, bootstrapped_at: TxnId) -> None:
         self._merge(ranges, RedundantEntry(bootstrapped_at=bootstrapped_at))
@@ -156,21 +174,6 @@ class RedundantBefore:
             return acc
         return self._map.fold_with_bounds(fold, [])
 
-    def snapshot_covered_ranges(self, execute_at: Timestamp) -> Ranges:
-        """Ranges whose bootstrap snapshot covers a write executing at
-        ``execute_at``.  The snapshot boundary is EXECUTION order, not TxnId
-        order: the donor serves its snapshot only after the bootstrap fence
-        applied locally, so it contains exactly the writes with lower
-        executeAt on the fenced ranges.  A txn with an old TxnId but a
-        post-fence executeAt applies at the donor after the snapshot — the
-        joiner must apply it directly (ref: Commands.applyRanges gates the
-        data write on executeAt vs bootstrappedAt)."""
-        def fold(entry, start, end, acc):
-            if execute_at < entry.bootstrapped_at:
-                acc.append(Range(start, end))
-            return acc
-        return Ranges(self._map.fold_with_bounds(fold, []))
-
     def bootstrap_covers(self, execute_at: Timestamp, participants) -> bool:
         """Whether a dep KNOWN to execute at ``execute_at`` is fully covered
         by the bootstrap snapshot over ``participants``.  Callers must not
@@ -228,10 +231,35 @@ class DurableBefore:
         return e is not None and txn_id < e.universal_before
 
     def min_majority_before(self, ranges: Ranges) -> TxnId:
-        entries = self._map.values_intersecting(ranges)
-        if not entries:
-            return TxnId.NONE
-        return min(e.majority_before for e in entries)
+        """Gap-aware min: an uncovered sub-range counts as NONE."""
+        return self._map.fold_over_ranges_with_gaps(
+            ranges,
+            lambda e, acc: min(acc, e.majority_before if e is not None
+                               else TxnId.NONE),
+            TxnId.MAX)
+
+    def min_universal_before(self, ranges: Ranges) -> TxnId:
+        return self._map.fold_over_ranges_with_gaps(
+            ranges,
+            lambda e, acc: min(acc, e.universal_before if e is not None
+                               else TxnId.NONE),
+            TxnId.MAX)
+
+    def entries(self):
+        """(start, end, majority_before, universal_before) segments — the
+        wire form for QueryDurableBefore/SetGloballyDurable gossip."""
+        def fold(e, start, end, acc):
+            acc.append((start, end, e.majority_before, e.universal_before))
+            return acc
+        return self._map.fold_with_bounds(fold, [])
+
+    def merge_entries(self, entries) -> None:
+        """Max-merge gossiped segments (facts only spread forward)."""
+        for start, end, majority, universal in entries:
+            rs = Ranges.of(Range(start, end))
+            self._map = self._map.add(
+                rs, DurableBefore.Entry(majority, universal),
+                lambda a, b: a.merge(b))
 
 
 def _as_ranges(keys_or_ranges) -> Ranges:

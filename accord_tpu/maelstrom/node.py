@@ -187,13 +187,15 @@ class MaelstromProcess:
                  now_micros: Callable[[], int],
                  num_stores: int = 2,
                  shards: int = 16,
-                 device_mode: Optional[bool] = None):
+                 device_mode: Optional[bool] = None,
+                 durability: bool = True):
         self._emit_raw = emit
         self.scheduler = scheduler
         self.now_micros = now_micros
         self.num_stores = num_stores
         self.shards = shards
         self.device_mode = device_mode
+        self.enable_durability = durability
         self.name: Optional[str] = None
         self.node: Optional[Node] = None
         self.sink: Optional[MaelstromSink] = None
@@ -270,6 +272,14 @@ class MaelstromProcess:
         self.node.on_topology_update(topology)
         self._sweeper = self.scheduler.recurring(SWEEP_INTERVAL_MICROS,
                                                  self.sink.sweep)
+        # background durability rounds -> watermarks -> truncation
+        # (ref: Main.java wires CoordinateDurabilityScheduling)
+        if self.enable_durability:
+            from ..impl.durability_scheduling import DurabilityScheduling
+            self.durability = DurabilityScheduling(
+                self.node, shard_cycle_micros=5_000_000,
+                global_cycle_micros=15_000_000)
+            self.durability.start()
         self._reply_client(src, body["msg_id"], {"type": "init_ok"})
 
     # -- the list-append "txn" workload --------------------------------------

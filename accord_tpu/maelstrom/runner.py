@@ -38,7 +38,11 @@ class MaelstromRunner:
 
     def __init__(self, n_nodes: int = 3, seed: int = 0, shards: int = 8,
                  mean_latency_micros: int = 1_000,
-                 device_mode: Optional[bool] = None):
+                 device_mode: Optional[bool] = None,
+                 durability: bool = False):
+        # durability defaults OFF in the runner: background rounds keep the
+        # simulated queue busy through every time-bounded drain; the
+        # durability subsystem has its own deterministic-tick tests
         self.queue = PendingQueue()
         self.rs = RandomSource(seed)
         self.net = self.rs.fork()
@@ -53,7 +57,8 @@ class MaelstromRunner:
             proc = MaelstromProcess(
                 emit=self._make_emit(name), scheduler=scheduler,
                 now_micros=lambda: self.queue.now,
-                shards=shards, device_mode=device_mode)
+                shards=shards, device_mode=device_mode,
+                durability=durability)
             self.processes[name] = proc
         # init handshake (ref: Runner sends init to every node first)
         for i, name in enumerate(self.names):
@@ -161,7 +166,9 @@ class MaelstromRunner:
             # list; take the longest copy per token across data stores
             finals = {}
             for proc in self.processes.values():
-                for token, (value, _at, _ids) in proc.node.data_store.data.items():
+                store = proc.node.data_store
+                for token in store.tokens():
+                    value = store.get(token)
                     if len(value) > len(finals.get(token, ())):
                         finals[token] = value
             for token, value in finals.items():

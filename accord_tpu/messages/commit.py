@@ -121,20 +121,27 @@ class Commit(TxnRequest):
 
     def _begin_read(self, node, from_id: int, reply_context) -> None:
         txn_id = self.txn_id
-        stores = node.command_stores.intersecting(
-            self.route.participants, self.min_epoch, self.execute_at.epoch())
-        if node.command_stores.unavailable_for_read(self.route.participants):
-            node.reply(from_id, reply_context, ReadNack("Unavailable"))
-            return
-        chains = [s.execute(PreLoadContext.for_txn(txn_id),
-                            lambda safe: read_on_store(safe, txn_id))
-                  for s in stores]
-        async_chain.all_of(chains).flat_map(async_chain.all_of).map(merge_datas).begin(
-            lambda data, fail:
-            node.reply(from_id, reply_context,
-                       ReadNack("Redundant" if isinstance(fail, ReadRedundant)
-                                else "Failed") if fail is not None
-                       else ReadOk(data)))
+
+        def start():
+            stores = node.command_stores.intersecting(
+                self.route.participants, self.min_epoch,
+                self.execute_at.epoch())
+            chains = [s.execute(PreLoadContext.for_txn(txn_id),
+                                lambda safe: read_on_store(safe, txn_id))
+                      for s in stores]
+            async_chain.all_of(chains).flat_map(async_chain.all_of).map(merge_datas).begin(
+                lambda data, fail:
+                node.reply(from_id, reply_context,
+                           ReadNack("Redundant" if isinstance(fail, ReadRedundant)
+                                    else "Failed") if fail is not None
+                           else ReadOk(data)))
+
+        # bootstrap gate: defer until adopted ranges become readable; past
+        # the deadline nack so the coordinator reads another replica
+        node.command_stores.when_readable(
+            self.route.participants, start,
+            on_unavailable=lambda: node.reply(from_id, reply_context,
+                                              ReadNack("Unavailable")))
 
 
 class CommitInvalidate(TxnRequest):

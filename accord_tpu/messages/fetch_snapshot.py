@@ -89,7 +89,12 @@ class FetchSnapshot(Request):
     """(ref: AbstractFetchCoordinator.FetchRequest)."""
 
     type = MessageType.FETCH_DATA_REQ
-    is_slow_read = True   # replies once the fence has applied locally
+    # deliberately NOT a slow read: the donor defers its reply until the
+    # fence applies locally, which can be arbitrarily late — the joiner
+    # polls on a short timeout instead of hanging a whole slow-read window
+    # on one donor (Bootstrap._fetch re-asks; a late donor reply to a dead
+    # callback is harmless)
+    is_slow_read = False
 
     def __init__(self, ranges: Ranges, epoch: int,
                  fence_txn_id: Optional[TxnId] = None):

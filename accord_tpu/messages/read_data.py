@@ -57,8 +57,9 @@ def merge_datas(datas) -> object:
 
 
 class ReadRedundant(RuntimeError):
-    """The command already applied locally — its pre-state is gone; the
-    coordinator must use a different replica or the persisted outcome."""
+    """The command was invalidated or truncated locally — nothing left to
+    read; the coordinator must use a different replica or the persisted
+    outcome."""
 
 
 def read_on_store(safe: SafeCommandStore, txn_id: TxnId
@@ -68,10 +69,10 @@ def read_on_store(safe: SafeCommandStore, txn_id: TxnId
     or None (ref: ReadData waitUntil + beginRead :264).
 
     The read gate: deps with lower executeAt must have applied
-    (ReadyToExecute, or PreApplied with an empty frontier), and our own
-    writes must NOT have applied yet.  maybe_execute notifies transient
-    listeners synchronously before applying writes, so a listener firing at
-    Applying still sees the pre-apply store state."""
+    (ReadyToExecute, or PreApplied with an empty frontier).  The data store
+    is versioned by executeAt, so a read arriving after the txn (or later
+    txns) applied locally still serves the exact pre-state at its
+    executeAt (ref: the Timestamped values in the reference's ListStore)."""
     out: async_chain.AsyncResult = async_chain.AsyncResult()
 
     def try_read(s: SafeCommandStore, cmd, via_listener: bool) -> bool:
@@ -79,19 +80,10 @@ def read_on_store(safe: SafeCommandStore, txn_id: TxnId
             out.set_failure(ReadRedundant(f"read of invalidated/truncated {txn_id}"))
             return True
         st = cmd.save_status
-        if st is SaveStatus.ReadyToExecute or (
+        if st is SaveStatus.ReadyToExecute or st is SaveStatus.Applying \
+                or st is SaveStatus.Applied or (
                 st is SaveStatus.PreApplied and not cmd.is_waiting()):
             _begin_read(s, cmd, out)
-            return True
-        if st is SaveStatus.Applying:
-            if via_listener:
-                # synchronous pre-apply notification: state still clean
-                _begin_read(s, cmd, out)
-            else:
-                out.set_failure(ReadRedundant(f"{txn_id} already applying"))
-            return True
-        if st is SaveStatus.Applied:
-            out.set_failure(ReadRedundant(f"{txn_id} already applied"))
             return True
         return False
 
@@ -143,18 +135,21 @@ class ReadTxnData(TxnRequest):
         if not stores:
             node.reply(from_id, reply_context, ReadNack("NotOwned"))
             return
-        # bootstrap gate: adopted ranges are unreadable until their snapshot
-        # lands — Nack so the coordinator reads another replica
-        if node.command_stores.unavailable_for_read(self.route.participants):
-            node.reply(from_id, reply_context, ReadNack("Unavailable"))
-            return
-        chains = [s.execute(PreLoadContext.for_txn(txn_id),
-                            lambda safe: read_on_store(safe, txn_id))
-                  for s in stores]
-        # each store task returns a chain; flatten then merge data
-        async_chain.all_of(chains).flat_map(async_chain.all_of).map(merge_datas).begin(
-            lambda data, fail:
-            node.reply(from_id, reply_context,
-                       ReadNack("Redundant" if isinstance(fail, ReadRedundant)
-                                else "Failed") if fail is not None
-                       else ReadOk(data)))
+
+        def start():
+            # bootstrap gate passed: adopted ranges are readable now
+            chains = [s.execute(PreLoadContext.for_txn(txn_id),
+                                lambda safe: read_on_store(safe, txn_id))
+                      for s in stores]
+            # each store task returns a chain; flatten then merge data
+            async_chain.all_of(chains).flat_map(async_chain.all_of).map(merge_datas).begin(
+                lambda data, fail:
+                node.reply(from_id, reply_context,
+                           ReadNack("Redundant" if isinstance(fail, ReadRedundant)
+                                    else "Failed") if fail is not None
+                           else ReadOk(data)))
+
+        node.command_stores.when_readable(
+            self.route.participants, start,
+            on_unavailable=lambda: node.reply(from_id, reply_context,
+                                              ReadNack("Unavailable")))

@@ -42,11 +42,14 @@ from .packing import ts_lt
 
 
 class DrainState(NamedTuple):
-    adj: jnp.ndarray        # bool[N, N]  i depends on j
-    status: jnp.ndarray     # int32[N]    SLOT_*
-    exec_msb: jnp.ndarray   # int64[N]    executeAt (valid when status >= COMMITTED)
-    exec_lsb: jnp.ndarray   # int64[N]
-    exec_node: jnp.ndarray  # int32[N]
+    adj: jnp.ndarray         # bool[N, N]  i depends on j
+    status: jnp.ndarray      # int32[N]    SLOT_*
+    exec_msb: jnp.ndarray    # int64[N]    executeAt (valid when status >= COMMITTED)
+    exec_lsb: jnp.ndarray    # int64[N]
+    exec_node: jnp.ndarray   # int32[N]
+    awaits_all: jnp.ndarray  # bool[N]     row i awaits ALL deps regardless of
+    #                          executeAt order (ExclusiveSyncPoint /
+    #                          EphemeralRead, ref: Txn.Kind.awaitsOnlyDeps)
 
 
 def blocking_matrix(state: DrainState) -> jnp.ndarray:
@@ -58,7 +61,7 @@ def blocking_matrix(state: DrainState) -> jnp.ndarray:
                         state.exec_node[None, :],
                         state.exec_msb[:, None], state.exec_lsb[:, None],
                         state.exec_node[:, None])       # [i, j]: exec(j) < exec(i)
-    gate = undecided[None, :] | exec_before
+    gate = undecided[None, :] | exec_before | state.awaits_all[:, None]
     return state.adj & gate & ~(invalidated | free)[None, :]
 
 

@@ -99,7 +99,8 @@ def sharded_drain(mesh: Mesh):
     """Row-sharded fixpoint drain: fn(state) -> (applied[N], newly[N]),
     both replicated on exit."""
     state_specs = DrainState(P(STORE_AXIS, None), P(STORE_AXIS),
-                             P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS))
+                             P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                             P(STORE_AXIS))
 
     def local(state: DrainState):
         # exec timestamps of potential deps (columns) must be visible to every
@@ -114,7 +115,8 @@ def sharded_drain(mesh: Mesh):
         exec_before = ts_lt(full_em[None, :], full_el[None, :], full_en[None, :],
                             state.exec_msb[:, None], state.exec_lsb[:, None],
                             state.exec_node[:, None])
-        blocking = state.adj & (undecided[None, :] | exec_before) & ~dead[None, :]
+        blocking = state.adj & (undecided[None, :] | exec_before |
+                                state.awaits_all[:, None]) & ~dead[None, :]
         blk = blocking.astype(jnp.bfloat16)
 
         stable_local = state.status == SLOT_STABLE

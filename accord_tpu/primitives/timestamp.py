@@ -97,6 +97,14 @@ class TxnKind(enum.IntEnum):
     def is_globally_visible(self) -> bool:
         return self not in (TxnKind.EphemeralRead, TxnKind.LocalOnly)
 
+    def awaits_only_deps(self) -> bool:
+        """ExclusiveSyncPoint and EphemeralRead execute only after ALL their
+        deps — including deps with a later executeAt — and have no logical
+        executeAt of their own (ref: Txn.java:208-214).  This is what makes
+        an applied ESP a redundancy watermark: everything below its TxnId has
+        locally applied."""
+        return self in (TxnKind.ExclusiveSyncPoint, TxnKind.EphemeralRead)
+
     def is_durable(self) -> bool:
         """Durable txns participate in recovery; EphemeralRead does not."""
         return self not in (TxnKind.EphemeralRead, TxnKind.LocalOnly)

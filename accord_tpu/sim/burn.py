@@ -122,18 +122,29 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
 
     # topology churn: a few epochs during the workload
     def churn_once():
+        """INCREMENTAL topology mutation (ref: topology/TopologyRandomizer
+        .java:58-115 — one SPLIT/MERGE/MEMBERSHIP change per epoch).  The
+        reference's randomizer never hands the whole ring over at once: a
+        wholesale swap leaves every new owner bootstrapping simultaneously,
+        which no real reconfiguration produces and which starves reads of
+        any serving replica."""
         if cluster.queue.now > workload_micros:
             return
         current = cluster.topologies[-1]
         all_ids = list(node_ids)
-        n_members = max(3, top.next_int(len(all_ids)) + 1)
-        members = sorted(top.pick(all_ids) for _ in range(len(all_ids)))[:n_members]
-        members = sorted(set(members))
-        while len(members) < 3:
-            members.append(top.pick([n for n in all_ids if n not in members]))
-        members = sorted(set(members))
+        members = sorted(current.nodes())
+        roll = top.next_int(3)
+        if roll == 0 and len(members) < len(all_ids):
+            # membership: add one node
+            members = sorted(members + [top.pick(
+                [n for n in all_ids if n not in members])])
+        elif roll == 1 and len(members) > 3:
+            # membership: drop one node
+            members = [n for n in members if n != top.pick(members)]
+        # else: keep members, reshard only
         new_rf = min(3, len(members))
-        new_shards = top.next_int(4) + 2
+        prev_shards = len(current.shards)
+        new_shards = max(2, min(5, prev_shards + top.next_int(3) - 1))
         cluster.add_topology(build_topology(current.epoch + 1, members,
                                             new_rf, new_shards))
         result.epochs += 1
@@ -142,6 +153,27 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
 
     if churn:
         cluster.queue.add(4_000_000 + top.next_int(2_000_000), churn_once)
+
+    # background durability rounds at randomized rates (ref: burn wires
+    # CoordinateDurabilityScheduling with randomized frequencies,
+    # Cluster.java:302-372): these advance the watermarks that drive
+    # truncation, keeping per-store state bounded
+    dur = rs.fork()
+
+    def durability_round():
+        if cluster.queue.now > workload_micros + drain_micros // 2:
+            return
+        nid = sorted(cluster.nodes)[dur.next_int(len(cluster.nodes))]
+        sched = cluster.durability.get(nid)
+        if sched is not None:
+            if dur.decide(0.8):
+                sched.shard_tick()
+            else:
+                sched.global_tick()
+        cluster.queue.add(cluster.queue.now + 500_000 +
+                          dur.next_int(1_500_000), durability_round)
+
+    cluster.queue.add(1_000_000 + dur.next_int(1_000_000), durability_round)
 
     # run the workload window + drain until every op resolves
     cluster.run_for(workload_micros)

@@ -248,6 +248,9 @@ class Cluster:
         # test hook (ref: test NetworkFilter): return True to drop a request
         self.message_filter: Optional[Callable[[int, int, object], bool]] = None
         self.stats: Dict[str, int] = {}
+        # per-node durability scheduling, driven by explicit ticks (sim) —
+        # (ref: CoordinateDurabilityScheduling wired in test Cluster.java)
+        self.durability: Dict[int, "object"] = {}
 
         scheduler = SimScheduler(self.queue)
         for nid in node_ids:
@@ -264,6 +267,8 @@ class Cluster:
                 progress_log_factory=progress_log_factory,
                 num_stores=num_stores, device_mode=device_mode)
             self.nodes[nid] = node
+            from ..impl.durability_scheduling import DurabilityScheduling
+            self.durability[nid] = DurabilityScheduling(node)
         if topology is not None:
             for node in self.nodes.values():
                 node.on_topology_update(topology)
@@ -340,6 +345,8 @@ class Cluster:
                     num_stores=self._num_stores,
                     device_mode=self._device_mode)
         self.nodes[nid] = node
+        from ..impl.durability_scheduling import DurabilityScheduling
+        self.durability[nid] = DurabilityScheduling(node)
         # the joiner must know prior epochs to pick bootstrap donors
         for t in self.topologies:
             self.queue.add(self.queue.now,

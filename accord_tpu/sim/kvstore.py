@@ -18,46 +18,58 @@ from ..utils import async_chain
 
 
 class KVDataStore(api.DataStore):
-    """Versioned store: token -> (list value, last-applied executeAt,
-    applied TxnIds).  The applied-id set makes duplicate detection exact:
-    two distinct txns appending equal values are still distinguishable, so
-    a genuine lost-write/duplicate fails the assert instead of passing on
-    value membership."""
+    """Versioned list-append store: token -> ordered append log of
+    (values, executeAt, TxnId) — the reference's Timestamped ListStore
+    (accord-core test impl/list/ListStore.java).  Versioning lets a read
+    that arrives AFTER its txn (or later txns) applied locally still serve
+    the exact pre-state at its executeAt, and makes duplicate detection
+    exact (dedup by TxnId, not value membership)."""
 
     def __init__(self, node_id: int):
         self.node_id = node_id
-        self.data: Dict[int, Tuple[tuple, Timestamp, frozenset]] = {}
+        # per key: append log sorted by executeAt
+        self.log: Dict[int, List[Tuple[tuple, Timestamp, TxnId]]] = {}
+
+    def tokens(self):
+        return self.log.keys()
 
     def get(self, token: int) -> tuple:
-        entry = self.data.get(token)
-        return entry[0] if entry is not None else ()
+        entries = self.log.get(token, ())
+        return tuple(v for vals, _at, _tid in entries for v in vals)
 
-    def snapshot(self, ranges: Ranges) -> Dict[int, Tuple[tuple, Timestamp, frozenset]]:
-        return {t: v for t, v in self.data.items() if ranges.contains_token(t)}
+    def read_at(self, token: int, execute_at: Timestamp) -> tuple:
+        """The key's value just below ``execute_at`` — what a txn executing
+        there must observe."""
+        return tuple(v for vals, at, _tid in self.log.get(token, ())
+                     if at < execute_at for v in vals)
 
-    def install_snapshot(self, snapshot: Dict[int, Tuple[tuple, Timestamp, frozenset]]) -> None:
-        for token, (value, at, ids) in snapshot.items():
-            mine = self.data.get(token)
-            if mine is None or mine[1] < at:
-                self.data[token] = (value, at, ids)
+    def snapshot(self, ranges: Ranges) -> Dict[int, list]:
+        return {t: list(entries) for t, entries in self.log.items()
+                if ranges.contains_token(t)}
+
+    def install_snapshot(self, snapshot: Dict[int, list]) -> None:
+        for token, entries in snapshot.items():
+            mine = self.log.setdefault(token, [])
+            have = {tid for _v, _at, tid in mine}
+            merged = mine + [e for e in entries if e[2] not in have]
+            merged.sort(key=lambda e: e[1])
+            self.log[token] = merged
 
     def apply_append(self, token: int, values: tuple, execute_at: Timestamp,
                      txn_id: TxnId) -> None:
-        entry = self.data.get(token)
-        if entry is not None and entry[1] >= execute_at:
-            # Stale apply: the value already reflects this-or-later
-            # executeAt.  Legitimate ONLY as a re-apply of the same txn —
-            # after a bootstrap snapshot install, the snapshot may already
-            # contain writes whose Apply messages race with it (versioned,
-            # like the reference's Timestamped ListStore values).  Anything
-            # else is a lost-write protocol violation and must fail loudly.
-            assert txn_id in entry[2], (
-                f"out-of-order apply on key {token}: {txn_id} {values} @ "
-                f"{execute_at} not in applied set @ {entry[1]} "
-                f"(node {self.node_id})")
-            return
-        current, ids = (entry[0], entry[2]) if entry is not None else ((), frozenset())
-        self.data[token] = (current + values, execute_at, ids | {txn_id})
+        """Insert at the executeAt-sorted position, deduplicating by TxnId.
+        The log is a monotone union: a bootstrap snapshot and the direct
+        Apply fan-out can each deliver any subset, in any order, and the
+        union converges.  An entry landing below the high-water mark is
+        legitimate exactly when a snapshot raced ahead of a deferred apply;
+        serving a WRONG read remains impossible because reads gate on their
+        deps having applied locally first (read_on_store)."""
+        entries = self.log.setdefault(token, [])
+        if any(tid == txn_id for _v, _at, tid in entries):
+            return   # re-apply of the same txn: idempotent
+        import bisect
+        i = bisect.bisect_left([e[1] for e in entries], execute_at)
+        entries.insert(i, (values, execute_at, txn_id))
 
 
 class KVData(api.Data):
@@ -83,7 +95,8 @@ class KVRead(api.Read):
         return self._keys
 
     def read(self, key, safe_store, execute_at, store: KVDataStore):
-        return async_chain.success(KVData({key.token(): store.get(key.token())}))
+        return async_chain.success(
+            KVData({key.token(): store.read_at(key.token(), execute_at)}))
 
     def slice(self, ranges: Ranges) -> "KVRead":
         return KVRead(self._keys.slice(ranges))

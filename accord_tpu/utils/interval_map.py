@@ -87,6 +87,17 @@ class ReducingRangeMap(Generic[V]):
                 acc = fn(v, bounds[i], bounds[i + 1], acc)
         return acc
 
+    def fold_over_ranges_with_gaps(self, ranges, fn, initial):
+        """Like fold_over_ranges, but uncovered segments are passed as None
+        — for folds where a coverage gap must not be silently skipped
+        (e.g. min-watermark queries)."""
+        acc = initial
+        for r in ranges:
+            lo, hi = self._index_of(r.start), self._index_of(r.end - 1)
+            for i in range(lo, hi + 1):
+                acc = fn(self.values[i], acc)
+        return acc
+
     def values_intersecting(self, ranges) -> List[V]:
         out: List[V] = []
         self.fold_over_ranges(ranges, lambda v, acc: (out.append(v), acc)[1], None)

@@ -257,6 +257,15 @@ def _register_messages() -> None:
     register_fields(inform.InformDurable, ["txn_id", "route", "durability"])
     register_fields(inform.InformOfTxnId, ["txn_id", "route"])
 
+    from .messages import durability as dur
+    register_fields(dur.WaitUntilApplied, [("txn_id", "txn_id"),
+                                           "participants"])
+    register_fields(dur.WaitUntilAppliedOk, [])
+    register_fields(dur.SetShardDurable, [("txn_id", "sync_id"), "ranges"])
+    register_fields(dur.QueryDurableBefore, ["epoch"])
+    register_fields(dur.DurableBeforeReply, ["entries"])
+    register_fields(dur.SetGloballyDurable, ["epoch", "entries"])
+
     register_fields(fetch_snapshot.FetchSnapshot,
                     ["ranges", "epoch", "fence_txn_id"])
     register_fields(fetch_snapshot.FetchSnapshotOk, ["snapshot", "covered"])

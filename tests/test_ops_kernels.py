@@ -198,7 +198,8 @@ def test_drain_matches_host(seed):
     state = drk.DrainState(adj=jnp.asarray(np.array(adj)),
                            status=jnp.asarray(np.array(status, np.int32)),
                            exec_msb=jnp.asarray(em), exec_lsb=jnp.asarray(el),
-                           exec_node=jnp.asarray(en))
+                           exec_node=jnp.asarray(en),
+                           awaits_all=jnp.zeros(n, bool))
     applied, newly = drk.drain(state)
     want = _host_drain(n, adj, status, exec_at)
     assert list(np.asarray(applied)) == want
@@ -217,7 +218,8 @@ def test_drain_chain_depth():
                for i in range(n)]
     em, el, en = pack_timestamps(exec_at)
     state = drk.DrainState(jnp.asarray(adj), jnp.asarray(status),
-                           jnp.asarray(em), jnp.asarray(el), jnp.asarray(en))
+                           jnp.asarray(em), jnp.asarray(el), jnp.asarray(en),
+                           jnp.zeros(n, bool))
     applied, newly = drk.drain(state)
     assert bool(jnp.all(applied))
     assert bool(jnp.all(newly))

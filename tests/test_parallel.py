@@ -80,7 +80,8 @@ def test_sharded_drain_matches_unsharded(mesh):
                     for i in range(n)])
     em, el, en = pack_timestamps(exec_at)
     state = drk.DrainState(jnp.asarray(adj), jnp.asarray(status),
-                           jnp.asarray(em), jnp.asarray(el), jnp.asarray(en))
+                           jnp.asarray(em), jnp.asarray(el), jnp.asarray(en),
+                           jnp.zeros(n, bool))
 
     want_applied, want_newly = drk.drain(state)
 
