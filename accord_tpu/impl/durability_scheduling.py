@@ -33,6 +33,7 @@ class DurabilityScheduling:
         self._scheduled = None
         self._global_scheduled = None
         self._inflight = False
+        self._stopped = False
         # counters for tests/observability
         self.shard_rounds_ok = 0
         self.shard_rounds_failed = 0
@@ -46,6 +47,8 @@ class DurabilityScheduling:
         offset = 1 + ((self.node.node_id * 2654435761) % step)
 
         def arm():
+            if self._stopped:
+                return   # stop() raced the stagger timer
             self._scheduled = self.node.scheduler.recurring(
                 step, self._shard_tick)
             self._global_scheduled = self.node.scheduler.recurring(
@@ -53,6 +56,7 @@ class DurabilityScheduling:
         self.node.scheduler.once(offset, arm)
 
     def stop(self) -> None:
+        self._stopped = True
         if self._scheduled is not None:
             self._scheduled.cancel()
         if self._global_scheduled is not None:

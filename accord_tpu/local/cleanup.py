@@ -7,18 +7,17 @@ truncation entry points Commands.java:879-975.
 
 The lifecycle that makes state bounded:
 
- 1. An ExclusiveSyncPoint S applies locally.  Because its kind
-    awaits_only_deps, every TxnId < S on its ranges has locally applied or
-    been invalidated -> advance RedundantBefore.locally_applied_before.
- 2. CoordinateShardDurable observed S applied at EVERY replica of the shard
-    and broadcasts SetShardDurable(S) -> mark_shard_durable: advance
+ 1. An ExclusiveSyncPoint S applies at EVERY replica of a shard (its kind
+    awaits_only_deps, so S applied somewhere proves every TxnId < S applied
+    there); CoordinateShardDurable observes this and broadcasts
+    SetShardDurable(S) -> mark_shard_durable: advance
     RedundantBefore.redundant_before (the shard watermark), DurableBefore
     majority+universal, prune CommandsForKey below S, free device deps-index
     slots, and truncate/erase eligible commands.
- 3. CoordinateGloballyDurable gossips merged DurableBefore maps so replicas
+ 2. CoordinateGloballyDurable gossips merged DurableBefore maps so replicas
     that missed a SetShardDurable catch up.
 
-After step 2 the deps floor (RedundantBefore.deps_floor) has risen, so
+After step 1 the deps floor (RedundantBefore.deps_floor) has risen, so
 PreAccept dep sets stay O(live txns) and the conflict indexes stay bounded.
 """
 
@@ -40,16 +39,6 @@ class Cleanup(enum.IntEnum):
     NO = 0
     TRUNCATE = 1   # drop txn/deps/writes, keep the Applied marker
     ERASE = 2      # drop the record entirely
-
-
-def mark_exclusive_sync_point_locally_applied(safe: "SafeCommandStore",
-                                              sync_id: TxnId,
-                                              ranges: Ranges) -> None:
-    """(ref: CommandStore.markExclusiveSyncPointLocallyApplied :516)."""
-    owned = safe.store.ranges_for_epoch.all().intersecting(ranges)
-    if owned.is_empty():
-        return
-    safe.redundant_before().add_locally_applied(owned, sync_id)
 
 
 def mark_shard_durable(safe: "SafeCommandStore", sync_id: TxnId,
@@ -84,7 +73,7 @@ def decide(safe: "SafeCommandStore", cmd) -> Cleanup:
     txn_id = cmd.txn_id
     if cmd.save_status is SaveStatus.Uninitialised:
         return Cleanup.NO
-    participants = _participants_of(cmd)
+    participants = cmd.participants()
     from .redundant import RedundantStatus
     if participants is None or participants.is_empty():
         # placeholder record (dep never witnessed with a definition): erase
@@ -102,9 +91,8 @@ def decide(safe: "SafeCommandStore", cmd) -> Cleanup:
     # never truncate an undrained local record: a committed-but-unapplied
     # command still owes its writes here (witnessed via a dual-quorum window
     # but applying elsewhere); erasing it is how writes get lost
-    if not (cmd.has_been(Status.Applied) or cmd.is_invalidated()
-            or cmd.save_status is SaveStatus.Uninitialised
-            or not cmd.has_been(Status.Committed)):
+    if cmd.has_been(Status.Committed) and not cmd.has_been(Status.Applied) \
+            and not cmd.is_invalidated():
         return Cleanup.NO
     db = safe.store.durable_before
     from .redundant import _as_ranges
@@ -165,9 +153,3 @@ def _prune_cfks(store) -> None:
         cfk.prune()
 
 
-def _participants_of(cmd):
-    if cmd.partial_txn is not None:
-        return cmd.partial_txn.keys
-    if cmd.route is not None:
-        return cmd.route.participants
-    return None

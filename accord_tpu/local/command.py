@@ -127,6 +127,17 @@ class Command:
         self.listeners = listeners
 
     # -- derived ------------------------------------------------------------
+    def participants(self):
+        """Where this command participates, from the best local knowledge:
+        the sliced definition if present, else the route, else None.  The
+        one shared resolution used by the drain clearing rules and Cleanup
+        (keep in sync — divergent copies silently skew cleanup vs drain)."""
+        if self.partial_txn is not None:
+            return self.partial_txn.keys
+        if self.route is not None:
+            return self.route.participants
+        return None
+
     @property
     def status(self) -> Status:
         return self.save_status.status

@@ -587,6 +587,13 @@ class CommandStores:
         def expire():
             if not state["fired"]:
                 state["fired"] = True
+                # drop the dead waiters: a wedged bootstrap must not pin one
+                # read continuation per expired deferral for its whole outage
+                for s in blockers:
+                    try:
+                        s._bootstrap_waiters.remove(one_done)
+                    except ValueError:
+                        pass
                 if on_unavailable is not None:
                     on_unavailable()
 

@@ -598,15 +598,6 @@ def post_apply(safe: SafeCommandStore, txn_id: TxnId) -> None:
     safe.notify_listeners(new_cmd)
     safe.notify_transient(new_cmd)
     safe.progress_log().durable_local(safe, txn_id)
-    if txn_id.kind() is TxnKind.ExclusiveSyncPoint and \
-            new_cmd.partial_txn is not None and \
-            isinstance(new_cmd.partial_txn.keys, Ranges):
-        # an applied ESP awaited ALL lower TxnIds (awaits_only_deps): advance
-        # the local redundancy watermark
-        # (ref: Commands.java:721-725 -> markExclusiveSyncPointLocallyApplied)
-        from .cleanup import mark_exclusive_sync_point_locally_applied
-        mark_exclusive_sync_point_locally_applied(
-            safe, txn_id, new_cmd.partial_txn.keys)
 
 
 # ---------------------------------------------------------------------------
@@ -658,11 +649,7 @@ def _dep_clearance(safe: SafeCommandStore, dep: Command,
 
 def _never_applies_here(safe: SafeCommandStore, dep: Command,
                         dep_execute_at: Timestamp) -> bool:
-    participants = None
-    if dep.partial_txn is not None:
-        participants = dep.partial_txn.keys
-    elif dep.route is not None:
-        participants = dep.route.participants
+    participants = dep.participants()
     if participants is None:
         return False   # unknown participation: stay conservative
     window = safe.ranges(dep_execute_at.epoch()).with_(

@@ -433,6 +433,48 @@ class DeviceState:
                         ihi = min(int(shi[mm]), r.end - 1)
                         builder.add_range(Range(ilo, ihi + 1), dep_id)
 
+    def deps_query_batch(self, queries):
+        """Batched deps scan: ONE kernel call for B concurrent queries (the
+        server-side batching a pipelined deployment uses; the sim's
+        message-at-a-time path calls deps_query per message instead).
+
+        ``queries`` = [(txn_id, started_before, witnesses, tokens, ranges)].
+        Returns the dep sets in the device-native packed-CSR layout —
+        ``(row_ptr int64[B+1], msb int64[D], lsb int64[D], node int32[D])``
+        — the same encoding KeyDeps/RangeDeps use (ref: KeyDeps.java:150-156
+        CSR layout); consumers materialise TxnId objects lazily.  Floors and
+        key attribution are layered on top by the per-message path."""
+        if not queries:
+            return (np.zeros(1, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, np.int64), np.zeros(0, np.int32))
+        q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
+        packed = [(sb, wit, toks, rngs, tid)
+                  for (tid, sb, wit, toks, rngs) in queries]
+        table = self.deps.device_table()
+        query = dk.build_query(packed, q_m)
+        n = table.capacity
+        k = min(256, n)   # lax.top_k requires k <= the row width
+        idx, counts, _ = dk.calculate_deps_indices(table, query, k)
+        counts = np.asarray(counts)
+        if counts.max(initial=0) > k:
+            # a dense row overflowed the compact path: fall back to the
+            # bit-packed full mask
+            packed_mask, _ = dk.calculate_deps_packed(table, query)
+            mask = np.unpackbits(np.asarray(packed_mask), axis=1,
+                                 count=n).astype(bool)
+            b_idx, j_idx = np.nonzero(mask)
+        else:
+            rows = np.asarray(idx)
+            b_idx, kk = np.nonzero(rows >= 0)
+            j_idx = rows[b_idx, kk]
+        self.n_queries += len(queries)
+        self.n_kernel_deps += len(j_idx)
+        counts = np.bincount(b_idx, minlength=len(queries))
+        row_ptr = np.zeros(len(queries) + 1, np.int64)
+        np.cumsum(counts, out=row_ptr[1:])
+        m = self.deps
+        return (row_ptr, m.msb[j_idx], m.lsb[j_idx], m.node[j_idx])
+
     # ------------------------------------------------------------------
     # the drain (device replacement of listener fan-out)
     # ------------------------------------------------------------------

@@ -48,21 +48,18 @@ class RedundantStatus(enum.IntEnum):
 class RedundantEntry:
     """(ref: RedundantBefore.Entry).  ``redundant_before`` is the SHARD
     watermark (shardAppliedOrInvalidatedBefore: applied at every healthy
-    replica — set by SetShardDurable); ``locally_applied_before`` is the
-    LOCAL watermark (locallyAppliedOrInvalidatedBefore: set when an
-    ExclusiveSyncPoint applies locally, ref: CommandStore.java:516,721-725)."""
+    replica — set by SetShardDurable).  The reference's separate
+    locallyAppliedOrInvalidatedBefore watermark is a deliberate omission
+    until a consumer (finer local Cleanup) exists."""
 
-    __slots__ = ("redundant_before", "bootstrapped_at", "stale_until_at_least",
-                 "locally_applied_before")
+    __slots__ = ("redundant_before", "bootstrapped_at", "stale_until_at_least")
 
     def __init__(self, redundant_before: TxnId = TxnId.NONE,
                  bootstrapped_at: TxnId = TxnId.NONE,
-                 stale_until_at_least: Optional[Timestamp] = None,
-                 locally_applied_before: TxnId = TxnId.NONE):
+                 stale_until_at_least: Optional[Timestamp] = None):
         self.redundant_before = redundant_before
         self.bootstrapped_at = bootstrapped_at
         self.stale_until_at_least = stale_until_at_least
-        self.locally_applied_before = locally_applied_before
 
     def merge(self, other: "RedundantEntry") -> "RedundantEntry":
         stale = self.stale_until_at_least
@@ -71,8 +68,7 @@ class RedundantEntry:
         return RedundantEntry(
             max(self.redundant_before, other.redundant_before),
             max(self.bootstrapped_at, other.bootstrapped_at),
-            stale,
-            max(self.locally_applied_before, other.locally_applied_before))
+            stale)
 
     def status_of(self, txn_id: TxnId) -> RedundantStatus:
         if self.stale_until_at_least is not None or txn_id < self.bootstrapped_at:
@@ -85,8 +81,7 @@ class RedundantEntry:
         return (isinstance(o, RedundantEntry)
                 and self.redundant_before == o.redundant_before
                 and self.bootstrapped_at == o.bootstrapped_at
-                and self.stale_until_at_least == o.stale_until_at_least
-                and self.locally_applied_before == o.locally_applied_before)
+                and self.stale_until_at_least == o.stale_until_at_least)
 
 
 class RedundantBefore:
@@ -100,13 +95,6 @@ class RedundantBefore:
     def add_redundant(self, ranges: Ranges, redundant_before: TxnId) -> None:
         """Advance the SHARD-applied watermark (ref: markShardDurable)."""
         self._merge(ranges, RedundantEntry(redundant_before=redundant_before))
-
-    def add_locally_applied(self, ranges: Ranges, before: TxnId) -> None:
-        """Advance the LOCAL-applied watermark: an ExclusiveSyncPoint with
-        TxnId ``before`` applied locally, so every lower TxnId on these
-        ranges has locally applied or been invalidated
-        (ref: markExclusiveSyncPointLocallyApplied, CommandStore.java:516)."""
-        self._merge(ranges, RedundantEntry(locally_applied_before=before))
 
 
     def add_bootstrapped(self, ranges: Ranges, bootstrapped_at: TxnId) -> None:

@@ -155,6 +155,47 @@ def calculate_deps(table: DepsTable, query: DepsQuery,
     return dep_mask, max_conflict
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(2,))
+def calculate_deps_indices(table: DepsTable, query: DepsQuery, k: int):
+    """calculate_deps compacted ON DEVICE to per-row slot indices via
+    top_k (TPU-native compaction): returns (idx int32[B, k] — slot indices,
+    padded with -1 — and counts int32[B]).  Ships only the sparse result
+    across the PCIe/tunnel boundary — the host reads TxnIds from its own
+    mirror.  A row whose count exceeds ``k`` overflowed; the caller falls
+    back to the bit-packed full mask."""
+    dep_mask, max_conflict = calculate_deps(table, query)
+    n = dep_mask.shape[1]
+    # score = n - col for set bits, 0 otherwise: top_k yields ascending
+    # column order among hits, pads with zeros
+    col = jnp.arange(n, dtype=jnp.int32)
+    scores = jnp.where(dep_mask, n - col, 0)
+    top, _ = jax.lax.top_k(scores, k)
+    idx = jnp.where(top > 0, n - top, -1)
+    counts = jnp.sum(dep_mask, axis=1, dtype=jnp.int32)
+    return idx, counts, max_conflict
+
+
+@jax.jit
+def calculate_deps_packed(table: DepsTable, query: DepsQuery,
+                          prune_msb: jnp.ndarray = None,
+                          prune_lsb: jnp.ndarray = None,
+                          prune_node: jnp.ndarray = None):
+    """calculate_deps with the dep mask bit-packed ON DEVICE
+    (uint8[B, ceil(N/8)]): the mask is the dominant host<->device transfer
+    (B x N bools), and packing shrinks it 8x before it crosses the
+    PCIe/tunnel boundary.  Host side unpacks with np.unpackbits."""
+    dep_mask, max_conflict = calculate_deps(table, query, prune_msb,
+                                            prune_lsb, prune_node)
+    pad = (-dep_mask.shape[1]) % 8
+    if pad:
+        dep_mask = jnp.pad(dep_mask, ((0, 0), (0, pad)))
+    packed = jnp.packbits(dep_mask, axis=1)
+    return packed, max_conflict
+
+
 # -- host bridge --------------------------------------------------------------
 
 def _intervals_of(txn_keys, txn_ranges, max_intervals: int):

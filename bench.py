@@ -1,131 +1,243 @@
-"""Headline benchmark: PreAccept deps-calc throughput at 100k in-flight txns.
+"""Headline benchmark: PreAccept deps-calc throughput at 100k in-flight txns,
+through the LIVE protocol store (accord_tpu.local.device_index.DeviceState —
+the same table PreAccept/Accept/BeginRecovery query in the sim), not a
+sidecar table.
 
-BASELINE.json north star: >=10x deps-calc throughput vs the reference's
-scalar per-key scan (InMemoryCommandStore / CommandsForKey.mapReduceActive,
-ref: accord-core/src/main/java/accord/local/CommandsForKey.java:614-650) at
-100k concurrent overlapping transactions.  The reference publishes no
-numbers, so the baseline is measured here: the same workload run through
-this repo's host-side scalar implementation (a faithful re-implementation of
-the reference's scan semantics), then through the device kernel.
+BASELINE.json north star: >=10x deps-calc throughput vs the reference's scan
+(InMemoryCommandStore / CommandsForKey.mapReduceActive, ref:
+accord-core/src/main/java/accord/local/CommandsForKey.java:614-650 +
+the rangeCommands scan, InMemoryCommandStore.java:863-877) at 100k
+concurrent overlapping transactions.
+
+Baseline: BASELINE.md asks for the reference JVM — not buildable here (the
+gradle build needs maven-central dependencies and this environment has zero
+egress), so the baseline is a faithful HOST implementation of the
+reference's indexed scan semantics: a per-key inverted index (the
+CommandsForKey sorted-array analogue) plus a range-entry table stabbed per
+query, vectorized with numpy (generous to the baseline — the JVM scan is
+scalar per entry).  The limitation is stated here and on stderr.
+
+Method (per round-2 verdict): every timed run issues >=10k queries; 5
+repetitions; the reported value is the MEDIAN (min on stderr);
+insert+query interleaving (live table maintenance) is measured separately
+and reported on stderr.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import statistics
 import sys
 import time
 
 import numpy as np
 
 
-def main():
-    # device selection: whatever JAX gives us (the real TPU under the driver;
-    # CPU elsewhere).  x64 is an explicit opt-in at process start.
-    from accord_tpu.ops.packing import enable_x64
-    enable_x64()
-    from accord_tpu.ops import deps_kernel as dk
+def build_workload(rng, n, keyspace, max_iv):
     from accord_tpu.primitives.keys import Range
-    from accord_tpu.primitives.timestamp import Domain, Kinds, TxnId, TxnKind
-    import jax
-
-    N = 100_000            # in-flight txns (BASELINE.json configs[2])
-    CAP = 1 << 17          # padded capacity
-    KEYSPACE = 1_000_000
-    M = 8                  # intervals per txn
-    B = 128                # query batch per device step
-    rng = np.random.default_rng(42)
-
-    # -- synthetic workload: mixed point-key / range txns over 1M keys -------
-    hlcs = rng.choice(np.arange(1, 4_000_000), size=N, replace=False)
-    entries = []
-    for i in range(N):
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    hlcs = rng.choice(np.arange(1, 4_000_000), size=n, replace=False)
+    out = []
+    for i in range(n):
+        point = rng.random() < 0.5
         kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
-        tid = TxnId.create(1, int(hlcs[i]), kind, Domain.Key, int(rng.integers(1, 6)))
-        status = int(rng.choice([dk.SLOT_PREACCEPTED, dk.SLOT_ACCEPTED,
-                                 dk.SLOT_COMMITTED, dk.SLOT_STABLE]))
-        n_iv = int(rng.integers(1, M + 1))
+        tid = TxnId.create(1, int(hlcs[i]), kind,
+                           Domain.Key if point else Domain.Range,
+                           int(rng.integers(1, 6)))
+        n_iv = int(rng.integers(1, max_iv + 1))
         toks, rngs = [], []
         for _ in range(n_iv):
-            if rng.random() < 0.5:
-                toks.append(int(rng.integers(0, KEYSPACE)))
+            if point:
+                toks.append(int(rng.integers(0, keyspace)))
             else:
-                s = int(rng.integers(0, KEYSPACE - 64))
+                s = int(rng.integers(0, keyspace - 64))
                 rngs.append(Range(s, s + int(rng.integers(1, 64))))
-        entries.append((tid, status, toks, rngs))
+        out.append((tid, toks, rngs))
+    return out
 
-    t0 = time.time()
-    table = dk.build_table(entries, capacity=CAP, max_intervals=M)
-    pack_s = time.time() - t0
 
-    def make_queries(k, seed):
-        qrng = np.random.default_rng(seed)
-        qs = []
-        for _ in range(k):
-            bound = TxnId.create(1, int(qrng.integers(3_000_000, 5_000_000)),
-                                 TxnKind.Write, Domain.Key, 1)
-            n_iv = int(qrng.integers(1, M + 1))
-            toks, rngs = [], []
-            for _ in range(n_iv):
-                if qrng.random() < 0.5:
-                    toks.append(int(qrng.integers(0, KEYSPACE)))
-                else:
-                    s = int(qrng.integers(0, KEYSPACE - 64))
-                    rngs.append(Range(s, s + int(qrng.integers(1, 64))))
-            qs.append((bound, bound.kind().witnesses(), toks, rngs))
-        return qs
+def make_queries(seed, k, keyspace, max_iv):
+    from accord_tpu.primitives.keys import Range
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    qrng = np.random.default_rng(seed)
+    qs = []
+    for _ in range(k):
+        bound = TxnId.create(1, int(qrng.integers(4_000_000, 5_000_000)),
+                             TxnKind.Write, Domain.Key, 1)
+        n_iv = int(qrng.integers(1, max_iv + 1))
+        toks, rngs = [], []
+        for _ in range(n_iv):
+            if qrng.random() < 0.5:
+                toks.append(int(qrng.integers(0, keyspace)))
+            else:
+                s = int(qrng.integers(0, keyspace - 64))
+                rngs.append(Range(s, s + int(qrng.integers(1, 64))))
+        qs.append((bound, bound.kind().witnesses(), toks, rngs))
+    return qs
 
-    # -- device kernel -------------------------------------------------------
-    queries = [dk.build_query(make_queries(B, s), max_intervals=M)
-               for s in range(5)]
-    # warmup/compile
-    out = dk.calculate_deps(table, queries[0])
-    jax.block_until_ready(out)
-    t0 = time.time()
-    iters = 4
-    for i in range(iters):
-        out = dk.calculate_deps(table, queries[1 + i])
-        jax.block_until_ready(out)
-    dev_s = time.time() - t0
-    dev_rate = (B * iters) / dev_s
 
-    # -- scalar baseline (reference scan semantics, host) --------------------
-    HB = 8
-    host_queries = make_queries(HB, 99)
-    # index: interval list per entry, as the reference's per-key scan would
-    # traverse (we charge it only the per-entry constant work, no python
-    # object overhead beyond tuples)
-    flat = [((tid.msb, tid.lsb, tid.node), int(tid.kind()), st,
-             [(t, t) for t in toks] + [(r.start, r.end - 1) for r in rngs])
-            for (tid, st, toks, rngs) in entries]
-    t0 = time.time()
-    for bound, wit, toks, rngs in host_queries:
-        ivs = [(t, t) for t in toks] + [(r.start, r.end - 1) for r in rngs]
+class HostIndexedBaseline:
+    """The reference's scan shape on the host: per-key sorted TxnId lists
+    (CommandsForKey) + a flat range-entry table stabbed per query (the
+    InMemoryCommandStore rangeCommands scan; the reference adds a CINTIA
+    checkpoint index on top — numpy vectorization here is at least as
+    generous).  Answers the same question as the kernel: all live entries
+    with id < bound, witnessed kind, overlapping footprint."""
+
+    def __init__(self, entries):
+        self.per_key = {}
+        r_lo, r_hi, r_key, r_kind = [], [], [], []
+        for tid, toks, rngs in entries:
+            packed = (tid.msb, tid.lsb, tid.node)
+            kind = int(tid.kind())
+            for t in toks:
+                self.per_key.setdefault(t, []).append((packed, kind))
+            for r in rngs:
+                r_lo.append(r.start)
+                r_hi.append(r.end - 1)
+                r_key.append(packed)
+                r_kind.append(kind)
+        for lst in self.per_key.values():
+            lst.sort()
+        self.sorted_tokens = sorted(self.per_key)
+        self.r_lo = np.array(r_lo, np.int64)
+        self.r_hi = np.array(r_hi, np.int64)
+        # order-preserving comparable encoding of (msb, lsb, node)
+        self.r_msb = np.array([k[0] for k in r_key], np.uint64)
+        self.r_lsb = np.array([k[1] for k in r_key], np.uint64)
+        self.r_node = np.array([k[2] for k in r_key], np.int64)
+        self.r_kind = np.array(r_kind, np.int64)
+
+    def query(self, bound, witnesses, toks, rngs) -> int:
+        import bisect
         bkey = (bound.msb, bound.lsb, bound.node)
-        wmask = wit.mask()
+        wmask = witnesses.mask()
         found = 0
-        for tkey, kind, st, eivs in flat:
-            if st == dk.SLOT_INVALIDATED or not (wmask >> kind) & 1 or tkey >= bkey:
-                continue
-            for ql, qh in ivs:
-                hit = False
-                for el, eh in eivs:
-                    if ql <= eh and el <= qh:
-                        hit = True
-                        break
-                if hit:
-                    found += 1
-                    break
-    host_s = time.time() - t0
-    host_rate = HB / host_s
+        # point keys: bisect the per-key sorted lists (CommandsForKey scan)
+        for t in toks:
+            lst = self.per_key.get(t)
+            if lst:
+                hi = bisect.bisect_left(lst, (bkey, 0))
+                for i in range(hi):
+                    if (wmask >> lst[i][1]) & 1:
+                        found += 1
+        # ranges and range-entries: vectorized stab over the range table
+        sel = np.zeros(len(self.r_lo), bool)
+        for t in toks:
+            sel |= (self.r_lo <= t) & (t <= self.r_hi)
+        for r in rngs:
+            sel |= (self.r_lo <= r.end - 1) & (r.start <= self.r_hi)
+        if sel.any():
+            earlier = (self.r_msb < np.uint64(bound.msb)) | (
+                (self.r_msb == np.uint64(bound.msb)) &
+                ((self.r_lsb < np.uint64(bound.lsb)) |
+                 ((self.r_lsb == np.uint64(bound.lsb)) &
+                  (self.r_node < bound.node))))
+            witnessed = (wmask >> self.r_kind) & 1 > 0
+            found += int(np.sum(sel & earlier & witnessed))
+        # per-key entries hit via query RANGES: slice the sorted token array
+        # (the reference's AbstractKeys range slicing) then walk each key's
+        # sorted list
+        for r in rngs:
+            lo = bisect.bisect_left(self.sorted_tokens, r.start)
+            hi_i = bisect.bisect_left(self.sorted_tokens, r.end)
+            for t in self.sorted_tokens[lo:hi_i]:
+                lst = self.per_key[t]
+                hi = bisect.bisect_left(lst, (bkey, 0))
+                for i in range(hi):
+                    if (wmask >> lst[i][1]) & 1:
+                        found += 1
+        return found
+
+
+def main():
+    from accord_tpu.ops.packing import enable_x64
+    enable_x64()
+    import jax
+    from accord_tpu.local.device_index import DeviceState
+    from accord_tpu.local.commands_for_key import InternalStatus
+    from accord_tpu.primitives.keys import Keys, IntKey, Ranges
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    N = 100_000 if on_tpu else 20_000
+    KEYSPACE = 1_000_000
+    M = 8
+    B = 512 if on_tpu else 128
+    BATCHES = max(1, 10_000 // B) + (0 if (10_000 % B == 0) else 1)
+    REPS = 5
+    rng = np.random.default_rng(42)
+
+    entries = build_workload(rng, N, KEYSPACE, M)
+
+    # -- the live protocol store: same registration path the sim's
+    #    PreAccept/Commit transitions drive (device_index.DeviceState) ------
+    class _NullStore:     # DeviceState only touches .node for drain ticks
+        class node:       # (none fire here: no stable() transitions)
+            scheduler = None
+    dev = DeviceState(_NullStore())
+    t0 = time.time()
+    for tid, toks, rngs in entries:
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+    build_s = time.time() - t0
+    build_rate = N / build_s
+
+    # -- timed query phase: >=10k queries per rep, 5 reps, median ------------
+    batches = [[(q[0], q[0], q[1], q[2], q[3])
+                for q in make_queries(1000 + i, B, KEYSPACE, M)]
+               for i in range(BATCHES)]
+    dev.deps_query_batch(batches[0])   # warmup/compile
+    rates = []
+    for rep in range(REPS):
+        t0 = time.time()
+        n_deps = 0
+        for batch in batches:
+            row_ptr, msb, lsb, node = dev.deps_query_batch(batch)
+            n_deps += len(msb)
+        dt = time.time() - t0
+        rates.append(B * BATCHES / dt)
+    dev_med = statistics.median(rates)
+    dev_min = min(rates)
+
+    # -- live maintenance: interleave inserts with query batches -------------
+    extra = build_workload(np.random.default_rng(7), B * 8, KEYSPACE, M)
+    t0 = time.time()
+    i = 0
+    for batch in batches[:8]:
+        for tid, toks, rngs in extra[i * B:(i + 1) * B]:
+            keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+            dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+        dev.deps_query_batch(batch)
+        i += 1
+    live_s = time.time() - t0
+    live_rate = (B * 8 * 2) / live_s   # one insert + one query per txn
+
+    # -- host baseline: reference-shaped indexed scan ------------------------
+    base = HostIndexedBaseline(entries)
+    hq = make_queries(999, 64, KEYSPACE, M)
+    for q in hq[:4]:
+        base.query(*q)   # warm caches
+    t0 = time.time()
+    for q in hq:
+        base.query(*q)
+    host_rate = len(hq) / (time.time() - t0)
 
     print(json.dumps({
-        "metric": "preaccept_deps_calc_txns_per_sec_100k_inflight",
-        "value": round(dev_rate, 2),
+        "metric": "preaccept_deps_calc_txns_per_sec_100k_inflight"
+                  if on_tpu else
+                  "preaccept_deps_calc_txns_per_sec_20k_inflight_cpu",
+        "value": round(dev_med, 2),
         "unit": "txn/s",
-        "vs_baseline": round(dev_rate / host_rate, 2),
+        "vs_baseline": round(dev_med / host_rate, 2),
     }))
-    print(f"# device={jax.devices()[0].platform} pack_s={pack_s:.1f} "
-          f"dev_rate={dev_rate:.1f}/s host_rate={host_rate:.2f}/s",
+    print(f"# device={jax.devices()[0].platform} N={N} B={B} "
+          f"queries_per_rep={B * BATCHES} reps={REPS}\n"
+          f"# dev_median={dev_med:.1f}/s dev_min={dev_min:.1f}/s "
+          f"spread={max(rates) / min(rates):.2f}x\n"
+          f"# build={build_rate:.0f} reg/s live_insert+query={live_rate:.0f} op/s\n"
+          f"# baseline=host indexed scan (numpy-vectorized reference "
+          f"semantics) {host_rate:.1f} q/s; JVM baseline unavailable: "
+          f"zero-egress env cannot resolve the reference's gradle deps",
           file=sys.stderr)
 
 
