@@ -61,6 +61,10 @@ def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
     (ref: messages/Propagate.java).  Only ever upgrades: the underlying
     transitions are no-ops when local state is already as advanced."""
     status = ok.save_status.status
+    if node.journal is not None:
+        # local knowledge upgrades are side-effecting local messages
+        # (ref: PROPAGATE_* in messages/MessageType.java are journaled)
+        node.journal.record_propagate(txn_id, ok)
 
     def apply_fn(safe):
         if status is Status.Invalidated:
@@ -97,6 +101,4 @@ def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
 
 
 def _propagate_min_epoch(txn_id: TxnId) -> int:
-    if txn_id.kind().is_sync_point():
-        return max(1, txn_id.epoch() - 1)
-    return txn_id.epoch()
+    return commands.apply_window_epochs(txn_id, None)[0]

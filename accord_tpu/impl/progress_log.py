@@ -104,6 +104,8 @@ class SimpleProgressLog(api.ProgressLog):
     def _scan(self) -> None:
         self._scheduled = None
         node = self.store.node
+        if not getattr(node, "alive", True):
+            return   # this incarnation's process died (restart_node)
         for entry in list(self.home.values()):
             if entry.progress is _Progress.Investigating:
                 continue

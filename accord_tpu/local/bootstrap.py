@@ -43,6 +43,13 @@ class Bootstrap:
 
     def start(self) -> None:
         node = self.node
+        if not getattr(node, "alive", True):
+            # dead incarnation (restart): a surviving retry timer must not
+            # write phantom bootstrap records into the shared journal — a
+            # fresh fence recorded here would never coordinate (the dead
+            # sink drops sends) yet would raise the restored pre-bootstrap
+            # watermark past writes the real snapshot never covered
+            return
         # don't waste a cluster-wide consensus round on the fence if the
         # prior epoch's topology (our donor source) is not yet known
         prev_epoch = self.epoch - 1
@@ -60,6 +67,14 @@ class Bootstrap:
         self._current_fence = bootstrapped_at
         self.store.redundant_before.add_bootstrapped(self.ranges, bootstrapped_at)
         self.store.bootstrapping = self.store.bootstrapping.with_(self.ranges)
+        if node.journal is not None:
+            # the watermark + in-progress marker are per-store persisted
+            # fields (the reference stores RedundantBefore via its
+            # integration's storage, not the message log)
+            node.journal.record_bootstrap(self.store.store_id, self.ranges,
+                                          self.epoch)
+            node.journal.record_bootstrapped_at(self.store.store_id,
+                                                self.ranges, bootstrapped_at)
         # 2. fence, coordinated AT the watermark id
         from ..coordinate.sync_point import coordinate_sync_point
         coordinate_sync_point(node, self.ranges, exclusive=True,
@@ -93,18 +108,24 @@ class Bootstrap:
         self._fetch(donors, self.ranges, fence)
 
     def _donors(self) -> List[int]:
-        """Replicas of these ranges in the previous epoch, preferring nodes
-        other than ourselves."""
-        prev_epoch = self.epoch - 1
+        """Replicas of these ranges in any epoch from the adoption epoch's
+        predecessor up to the current predecessor, most recent first.  A
+        single-epoch donor set wedges after further churn: a retry's fresh
+        fence (current-epoch TxnId) never reaches a donor that no longer
+        owns the ranges, so it can never serve — while recent owners both
+        witness the fence and hold the data (their own bootstraps completed
+        or they Nack via the unavailable-for-read gate and we move on)."""
         manager = self.node.topology()
-        if not manager.has_epoch(prev_epoch):
-            return []
-        prev = manager.get_topology_for_epoch(prev_epoch)
         donors: List[int] = []
-        for shard in prev.for_selection(self.ranges):
-            for n in shard.nodes:
-                if n != self.node.node_id and n not in donors:
-                    donors.append(n)
+        newest = max(self.epoch, self.node.epoch())
+        for epoch in range(newest - 1, self.epoch - 2, -1):
+            if epoch < 1 or not manager.has_epoch(epoch):
+                continue
+            prev = manager.get_topology_for_epoch(epoch)
+            for shard in prev.for_selection(self.ranges):
+                for n in shard.nodes:
+                    if n != self.node.node_id and n not in donors:
+                        donors.append(n)
         return donors
 
     def _fetch(self, donors: List[int], remaining: Ranges, fence,
@@ -119,6 +140,8 @@ class Bootstrap:
         locally applied before serving (see messages/fetch_snapshot.py)."""
         from ..messages.fetch_snapshot import FetchSnapshot, FetchSnapshotOk
         node = self.node
+        if not getattr(node, "alive", True):
+            return
         if remaining.is_empty():
             self._complete()
             return
@@ -155,8 +178,13 @@ class Bootstrap:
         node.send(donor, FetchSnapshot(remaining, self.epoch - 1, fence), Cb())
 
     def _complete(self) -> None:
+        if not getattr(self.node, "alive", True):
+            return
         self.done = True
         self.store.bootstrapping = self.store.bootstrapping.without(self.ranges)
+        if self.node.journal is not None:
+            self.node.journal.record_bootstrap_done(self.store.store_id,
+                                                    self.ranges, self.epoch)
         if self.store.bootstrapping.is_empty():
             self.store.bootstrap_complete()
 

@@ -111,6 +111,13 @@ def cleanup_store(safe: "SafeCommandStore") -> int:
     purger; ours sweeps eagerly at watermark advances)."""
     from . import commands as commands_mod
     store = safe.store
+    journal = store.node.journal
+    if journal is not None:
+        # persist the advanced watermarks (latest-wins snapshot — the
+        # journal's bounded substitute for replaying every durability verb)
+        journal.record_watermarks(store.store_id,
+                                  store.durable_before.entries(),
+                                  store.redundant_before.redundant_entries())
     released = 0
     for txn_id in list(store.commands.keys()):
         cmd = store.commands.get(txn_id)
@@ -129,6 +136,11 @@ def cleanup_store(safe: "SafeCommandStore") -> int:
             commands_mod.set_truncated_apply(safe, txn_id)
         released += 1
     _prune_cfks(store)
+    # the watermark rose: frontiers built before the rise may hold bits for
+    # deps it now answers for — re-evaluate them (refresh applies the
+    # watermark clearance; host mode re-checks via erase notifications)
+    if store.device is not None:
+        store.device.schedule_tick()
     return released
 
 

@@ -85,6 +85,13 @@ class RangesForEpoch:
             return Ranges.empty()
         return self._by_epoch[max(self._by_epoch)]
 
+    def earliest(self) -> Ranges:
+        """The store's first-epoch snapshot — the ranges it has held since
+        its node joined (data present without any bootstrap)."""
+        if not self._by_epoch:
+            return Ranges.empty()
+        return self._by_epoch[min(self._by_epoch)]
+
     def all_between(self, min_epoch: int, max_epoch: int) -> Ranges:
         """Union of every snapshot in effect during [min_epoch, max_epoch]:
         the snapshots declared inside the window plus the one already active
@@ -163,6 +170,12 @@ class CommandStore:
             safe.complete()
             out.set_success(result)
 
+        if not getattr(self.node, "alive", True):
+            # dead incarnation (restart_node): its queued work must not run —
+            # ghost tasks would keep writing registers into the shared
+            # journal and data store, contaminating the new incarnation's
+            # durable state.  The chain never settles, like a crashed process.
+            return out
         self._queue.append(task)
         self._schedule_drain()
         return out
@@ -174,6 +187,10 @@ class CommandStore:
         self.node.scheduler.now(self._drain)
 
     def _drain(self) -> None:
+        if not getattr(self.node, "alive", True):
+            self._queue.clear()   # the process died with this work pending
+            self._draining = False
+            return
         while self._queue:
             task = self._queue.pop(0)
             try:
@@ -254,6 +271,12 @@ class SafeCommandStore:
         notifications for any watchers."""
         prev = self.store.commands.get(command.txn_id)
         self.store.commands[command.txn_id] = command
+        journal = self.store.node.journal
+        if journal is not None:
+            # the command's fixed-width columns are the journal's registers;
+            # variable-size fields reconstruct from the message log
+            # (ref: SerializerSupport.reconstruct's register arguments)
+            journal.record_registers(self.store.store_id, command)
         if notify and prev is not None and command.save_status != prev.save_status:
             for listener in command.listeners:
                 self._pending_notifications.append((listener, command.txn_id))
@@ -295,8 +318,17 @@ class SafeCommandStore:
                           witnesses: Kinds, fn, acc):
         """The PreAccept conflict scan over this store's owned slice
         (ref: SafeCommandStore.java:269-286; InMemoryCommandStore.java:863-877).
-        Covers both the per-key indexes and the range-txn scan."""
-        owned = self.ranges(started_before.epoch())
+        Covers both the per-key indexes and the range-txn scan.
+
+        The scan window is the store's FULL ownership history, not just the
+        ranges owned at started_before's epoch: a dual-quorum PreAccept at a
+        prior-epoch replica (epoch handoff — the replica owns NOTHING in the
+        new epoch) must still report the in-flight txns it witnessed on its
+        old ranges, or the new owner's capture fence collects empty deps and
+        writes committed at the old quorum are lost across the handoff.  The
+        caller already slices ``keys_or_ranges`` to the message's epoch
+        window; extra history only ever ADDS witnessed conflicts (safe)."""
+        owned = self.store.ranges_for_epoch.all()
         if isinstance(keys_or_ranges, Ranges):
             scan_ranges = keys_or_ranges.slice(owned)
             for token, cfk in self.store.commands_for_key.items():
@@ -345,8 +377,10 @@ class SafeCommandStore:
     def map_reduce_full(self, keys_or_ranges, test_txn_id: TxnId,
                         witnesses: Kinds, fn, acc):
         """Recovery-time scan over ALL witnessed txns
-        (ref: SafeCommandStore mapReduceFull)."""
-        owned = self.ranges(test_txn_id.epoch())
+        (ref: SafeCommandStore mapReduceFull).  Full ownership history for
+        the same reason as map_reduce_active: recovery votes from a
+        prior-epoch replica must include its old-range witnesses."""
+        owned = self.store.ranges_for_epoch.all()
         if isinstance(keys_or_ranges, Ranges):
             scan_ranges = keys_or_ranges.slice(owned)
             for token, cfk in self.store.commands_for_key.items():
@@ -459,7 +493,8 @@ class CommandStores:
         self._next_id = 0
 
     # -- topology -----------------------------------------------------------
-    def update_topology(self, topology, epoch: Optional[int] = None) -> None:
+    def update_topology(self, topology, epoch: Optional[int] = None,
+                        bootstrap: bool = True) -> None:
         """Assign this node's owned ranges across stores and bootstrap any
         newly-adopted ranges (ref: CommandStores.updateTopology :401-482).
 
@@ -488,7 +523,7 @@ class CommandStores:
         for store, extra in zip(self.stores, new_chunks):
             retained = store.ranges_for_epoch.current().intersecting(owned)
             store.ranges_for_epoch.snapshot(epoch, retained.with_(extra))
-            if not extra.is_empty():
+            if not extra.is_empty() and bootstrap:
                 from .bootstrap import Bootstrap
                 Bootstrap(store, extra, epoch).start()
 

@@ -431,27 +431,17 @@ def _maybe_clear_dep(safe: SafeCommandStore, txn_id: TxnId,
     dep_cmd = safe.if_present(dep)
     # the dep set itself records where the dep participates — essential for
     # deps we never witnessed locally (pre-bootstrap: the snapshot covers
-    # them, so they must clear instantly, not trigger a fetch)
+    # them, so they must clear instantly, not trigger a fetch).  Clearance
+    # is PER watermark entry (locally_settled): shard-redundant sub-ranges
+    # clear unconditionally; pre-bootstrap sub-ranges clear unless the dep
+    # has a KNOWN executeAt past that entry's fence (then it will apply
+    # here directly and per-key order vs the snapshot must hold — the
+    # cross-fence window is closed by reject_before; any residue fails
+    # loudly in the versioned data store rather than losing a write).
     participants = _resolve_dep_participants(safe, dep, partial_deps)
-    dep_status = safe.redundant_before().status(dep, participants)
-    if dep_status is RedundantStatus.SHARD_REDUNDANT:
+    dep_exec = (dep_cmd.execute_at_if_known() if dep_cmd is not None else None)
+    if safe.redundant_before().locally_settled(dep, participants, dep_exec):
         return waiting_on.with_done(dep, True)
-    if dep_status is RedundantStatus.PRE_BOOTSTRAP_OR_STALE:
-        # Pre-bootstrap by TxnId.  Unwitnessed deps clear instantly — the
-        # snapshot covers them (fetching each would make bootstrap O(history)
-        # in fetch rounds).  A WITNESSED dep with a known post-fence
-        # executeAt must instead be waited on: it will apply here directly
-        # and per-key execution order vs the snapshot must hold.  The
-        # cross-fence window (old TxnId slow-pathing past the fence) is
-        # closed by reject_before — an ExclusiveSyncPoint rejects later
-        # PreAccepts/Accepts of lower TxnIds (ref: CommandStore.rejectBefore,
-        # Commands.preaccept) — and any residue fails loudly in the
-        # versioned data store rather than losing a write silently.
-        dep_exec = (dep_cmd.execute_at_if_known()
-                    if dep_cmd is not None else None)
-        if dep_exec is None or \
-                safe.redundant_before().bootstrap_covers(dep_exec, participants):
-            return waiting_on.with_done(dep, True)
     device = safe.store.device is not None
     if dep_cmd is None:
         # not yet witnessed locally: register a placeholder that will notify
@@ -613,8 +603,38 @@ def listener_update(safe: SafeCommandStore, listener_id: TxnId,
         return
     dep = safe.if_present(updated_id)
     if dep is None:
+        # the dep's record was erased (Cleanup dropped it after the shard
+        # watermark passed it): the watermark answers for it now — without
+        # this leg the erase notification is a lost wakeup and the waiter
+        # wedges forever (ref: Commands.removeRedundantDependencies)
+        if not listener.waiting_on.is_waiting_on(updated_id):
+            return
+        cleared = _settle_absent_or_redundant_dep(safe, listener, updated_id,
+                                                  None)
+        if cleared is None:
+            return
+        safe.update(listener.updated(
+            waiting_on=listener.waiting_on.with_done(updated_id, cleared)),
+            notify=False)
+        maybe_execute(safe, listener_id)
         return
     update_dependency_and_maybe_execute(safe, listener, dep)
+
+
+def _settle_absent_or_redundant_dep(safe: SafeCommandStore, waiter: Command,
+                                    dep_id: TxnId,
+                                    dep_cmd: Optional[Command]
+                                    ) -> Optional[bool]:
+    """Clearance that needs no dep record: the redundancy/bootstrap
+    watermarks answer for erased or never-witnessed dependencies (the same
+    rules _maybe_clear_dep applies at WaitingOn construction, re-applied
+    when the watermark advances under an already-built frontier).
+    Returns True (clear as applied/invalidated) or None (still gating)."""
+    participants = _resolve_dep_participants(safe, dep_id, waiter.partial_deps)
+    dep_exec = (dep_cmd.execute_at_if_known() if dep_cmd is not None else None)
+    if safe.redundant_before().locally_settled(dep_id, participants, dep_exec):
+        return True
+    return None
 
 
 def _dep_clearance(safe: SafeCommandStore, dep: Command,
@@ -647,13 +667,33 @@ def _dep_clearance(safe: SafeCommandStore, dep: Command,
     return None
 
 
+def apply_window_epochs(txn_id: TxnId,
+                        execute_at: Optional[Timestamp]) -> Tuple[int, int]:
+    """The epoch window a txn's Commit/Apply distribution can reach on a
+    store: [txn epoch .. executeAt epoch], extended ONE EPOCH BELOW for sync
+    points — the dual-quorum handoff leg, where a dropped prior-epoch owner
+    still receives and applies the fence over its old ranges (shared by the
+    drain clearance, journal reconstruction, and fetch_data's propagate —
+    keep in sync or reconstruction slices silently diverge from clearance)."""
+    min_epoch = txn_id.epoch()
+    if txn_id.kind().is_sync_point():
+        min_epoch = max(1, min_epoch - 1)
+    max_epoch = max(txn_id.epoch(),
+                    execute_at.epoch() if execute_at is not None else 0)
+    return min_epoch, max_epoch
+
+
 def _never_applies_here(safe: SafeCommandStore, dep: Command,
                         dep_execute_at: Timestamp) -> bool:
     participants = dep.participants()
     if participants is None:
         return False   # unknown participation: stay conservative
-    window = safe.ranges(dep_execute_at.epoch()).with_(
-        safe.ranges(dep.txn_id.epoch()))
+    # Without the sync-point epoch extension a donor clears its waiting on a
+    # joiner's bootstrap fence as "never applies here", applies its own
+    # fence early, and serves a snapshot missing writes the fence was
+    # supposed to gate on (lost write on the joiner).
+    min_epoch, max_epoch = apply_window_epochs(dep.txn_id, dep_execute_at)
+    window = safe.store.ranges_for_epoch.all_between(min_epoch, max_epoch)
     if isinstance(participants, Ranges):
         return not window.intersects(participants)
     return not participants.intersects(window)
@@ -696,9 +736,12 @@ def refresh_waiting_and_maybe_execute(safe: SafeCommandStore,
     w = cmd.waiting_on
     for dep in w.waiting_ids():
         dep_cmd = safe.if_present(dep)
-        if dep_cmd is None:
-            continue
-        cleared = _dep_clearance(safe, dep_cmd, txn_id, cmd.execute_at)
+        cleared = None
+        if dep_cmd is not None:
+            cleared = _dep_clearance(safe, dep_cmd, txn_id, cmd.execute_at)
+        if cleared is None:
+            # erased record or stale placeholder: the watermarks decide
+            cleared = _settle_absent_or_redundant_dep(safe, cmd, dep, dep_cmd)
         if cleared is not None:
             w = w.with_done(dep, cleared)
     if w is not cmd.waiting_on:
@@ -734,6 +777,8 @@ def set_truncated_apply(safe: SafeCommandStore, txn_id: TxnId) -> None:
                           waiting_on=None)
     safe.update(new_cmd)
     safe.notify_listeners(new_cmd)
+    if safe.store.device is not None:
+        safe.store.device.on_terminal(txn_id)
 
 
 def set_erased(safe: SafeCommandStore, txn_id: TxnId) -> None:
@@ -744,3 +789,5 @@ def set_erased(safe: SafeCommandStore, txn_id: TxnId) -> None:
                           route=None)
     safe.update(new_cmd)
     safe.notify_listeners(new_cmd)
+    if safe.store.device is not None:
+        safe.store.device.on_terminal(txn_id)

@@ -378,8 +378,10 @@ class DeviceState:
                    witnesses: Kinds, builder) -> None:
         """Run the PreAccept/Accept/Recover dependency scan on device and
         fold the result into ``builder`` with the same per-key semantics as
-        the host CommandsForKey path."""
-        owned = safe.ranges(started_before.epoch())
+        the host CommandsForKey path (full ownership history, matching
+        SafeCommandStore.map_reduce_active — a dual-quorum scan at a
+        dropped prior-epoch owner must still see its old-range witnesses)."""
+        owned = safe.store.ranges_for_epoch.all()
         if isinstance(keys, Ranges):
             q_toks: List[int] = []
             q_rngs = list(keys.slice(owned))
@@ -502,6 +504,18 @@ class DeviceState:
         status, exec_at = _drain_status_of(cmd)
         self.drain.set_status(slot, status, exec_at)
         return slot
+
+    def on_terminal(self, txn_id: TxnId) -> None:
+        """Truncation/erasure: the txn can never gate execution again
+        (ref: _dep_clearance treats truncated as done).  Mark its drain row
+        terminal and re-evaluate waiters — without this, truncating a dep
+        whose record Cleanup then drops is a lost wakeup in device mode
+        (no listeners exist to carry the erase notification)."""
+        dslot = self.drain.slot_of.get(txn_id)
+        if dslot is not None:
+            self.drain.set_status(dslot, dk.SLOT_INVALIDATED, None)
+            if self.drain.active.any():
+                self.schedule_tick()
 
     def on_driven(self, txn_id: TxnId) -> None:
         """The txn reached ReadyToExecute/Applying — stop driving it (its

@@ -49,7 +49,8 @@ class Node:
                  progress_log_factory: Optional[Callable] = None,
                  num_stores: int = 2,
                  local_config: Optional[api.LocalConfig] = None,
-                 device_mode: Optional[bool] = None):
+                 device_mode: Optional[bool] = None,
+                 journal=None):
         self.node_id = node_id
         self.message_sink = message_sink
         self.config_service = config_service
@@ -66,7 +67,16 @@ class Node:
         self.progress_log_factory = progress_log_factory
         self.topology_manager = TopologyManager(node_id)
         self.command_stores = CommandStores(self, num_stores)
+        self.journal = journal
+        self.alive = True
         self._hlc = 0
+        if journal is not None and journal.max_hlc:
+            # a restarted incarnation must never reissue a timestamp the
+            # previous one used: the journal's high-water mark bounds every
+            # id this node witnessed OR issued-and-recorded; ids issued but
+            # never journaled anywhere are covered by the slack (ids per
+            # microsecond << 1000 in any workload we run)
+            self._hlc = journal.max_hlc + 1000
         self._coordinating: Dict[TxnId, object] = {}  # active coordinations
         self._pending_topologies: Dict[int, Topology] = {}  # out-of-order epochs
 
@@ -114,6 +124,22 @@ class Node:
         nxt = self._pending_topologies.pop(topology.epoch + 1, None)
         if nxt is not None:
             self.on_topology_update(nxt)
+
+    def restore_topologies(self, topologies) -> None:
+        """Restart path: re-ingest the epoch history WITHOUT re-bootstrapping
+        (the data store is durable; the journal restores the metadata) and
+        without re-fencing every historical epoch (the previous incarnation
+        already synced them — the reject_before fences themselves come back
+        via journal reconstruction of the sync-point commands)."""
+        latest = None
+        for topology in sorted(topologies, key=lambda t: t.epoch):
+            if self.topology_manager.has_epoch(topology.epoch):
+                continue
+            self.topology_manager.on_topology_update(topology)
+            self.command_stores.update_topology(topology, bootstrap=False)
+            latest = topology
+        if latest is not None:
+            self._ack_epoch(latest.epoch)
 
     def _start_epoch_sync(self, topology: Topology) -> None:
         """Fence the new epoch: an ExclusiveSyncPoint over our owned ranges
@@ -240,7 +266,24 @@ class Node:
             return
         self.scheduler.now(lambda: self._process(request, from_id, reply_context))
 
+    def witness_timestamp(self, ts) -> None:
+        """HLC receive rule: merge a remotely-witnessed timestamp into the
+        local clock so later ids exceed it (ref: Node.java uniqueNow(atLeast)
+        — without it, a node with a lagging physical clock keeps issuing ids
+        below its peers' epoch fences and every txn it coordinates bounces)."""
+        h = ts.hlc()
+        if h > self._hlc:
+            self._hlc = h
+
     def _process(self, request, from_id: int, reply_context) -> None:
+        tid = getattr(request, "txn_id", None)
+        if tid is not None:
+            self.witness_timestamp(tid)
+        ex = getattr(request, "execute_at", None)
+        if ex is not None:
+            self.witness_timestamp(ex)
+        if self.journal is not None and request.type.has_side_effects:
+            self.journal.record_message(request, from_id)
         try:
             request.process(self, from_id, reply_context)
         except BaseException as e:  # noqa: BLE001

@@ -162,6 +162,42 @@ class RedundantBefore:
             return acc
         return self._map.fold_with_bounds(fold, [])
 
+    def redundant_entries(self):
+        """(start, end, redundant_before) segments with a non-trivial shard
+        watermark — the journal's persisted form (bootstrapped_at is
+        journaled separately at its Bootstrap call sites)."""
+        def fold(e, start, end, acc):
+            if e.redundant_before > TxnId.NONE:
+                acc.append((start, end, e.redundant_before))
+            return acc
+        return self._map.fold_with_bounds(fold, [])
+
+    def locally_settled(self, txn_id: TxnId, participants,
+                        execute_at: Optional[Timestamp] = None) -> bool:
+        """Per-entry clearance: True when EVERY watermark entry intersecting
+        ``participants`` classifies txn_id as done here — shard-redundant
+        (applied at every replica) or pre-bootstrap (the snapshot covers it,
+        provided no known executeAt lands past that entry's fence).  The
+        aggregate status() collapses mixed coverage into PARTIALLY_* and
+        loses exactly this case: a dep redundant on one sub-range and
+        pre-bootstrap on the rest is settled on both, yet neither aggregate
+        branch fires (ref: RedundantBefore folds per Entry; the WaitingOn
+        clearance consumes the per-range answer)."""
+        ranges = _as_ranges(participants)
+        entries = self._map.values_intersecting(ranges)
+        if not entries:
+            return False
+        for e in entries:
+            s = e.status_of(txn_id)
+            if s is RedundantStatus.SHARD_REDUNDANT:
+                continue
+            if s is RedundantStatus.PRE_BOOTSTRAP_OR_STALE:
+                if execute_at is None or e.stale_until_at_least is not None \
+                        or execute_at < e.bootstrapped_at:
+                    continue
+            return False
+        return True
+
     def bootstrap_covers(self, execute_at: Timestamp, participants) -> bool:
         """Whether a dep KNOWN to execute at ``execute_at`` is fully covered
         by the bootstrap snapshot over ``participants``.  Callers must not

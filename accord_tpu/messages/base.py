@@ -20,59 +20,62 @@ from ..primitives.timestamp import TxnId
 
 class MessageType(enum.Enum):
     """Verb registry (ref: messages/MessageType.java:34-116).
-    has_side_effects drives journal persistence."""
+    has_side_effects drives journal persistence.  Values must be UNIQUE
+    (id, has_side_effects) pairs: Python enums alias equal values, and an
+    aliased registry breaks any dispatch on the member identity (the journal
+    switches on it)."""
 
-    SIMPLE_RSP = (False,)
-    FAILURE_RSP = (False,)
-    PRE_ACCEPT_REQ = (True,)
-    PRE_ACCEPT_RSP = (False,)
-    ACCEPT_REQ = (True,)
-    ACCEPT_RSP = (False,)
-    ACCEPT_INVALIDATE_REQ = (True,)
-    ACCEPT_INVALIDATE_RSP = (False,)
-    GET_DEPS_REQ = (False,)
-    GET_DEPS_RSP = (False,)
-    GET_EPHEMERAL_READ_DEPS_REQ = (False,)
-    GET_EPHEMERAL_READ_DEPS_RSP = (False,)
-    GET_MAX_CONFLICT_REQ = (False,)
-    GET_MAX_CONFLICT_RSP = (False,)
-    COMMIT_SLOW_PATH_REQ = (True,)
-    COMMIT_MAXIMAL_REQ = (True,)
-    STABLE_FAST_PATH_REQ = (True,)
-    STABLE_SLOW_PATH_REQ = (True,)
-    STABLE_MAXIMAL_REQ = (True,)
-    COMMIT_INVALIDATE_REQ = (True,)
-    APPLY_MINIMAL_REQ = (True,)
-    APPLY_MAXIMAL_REQ = (True,)
-    APPLY_RSP = (False,)
-    READ_REQ = (False,)
-    READ_EPHEMERAL_REQ = (False,)
-    READ_RSP = (False,)
-    BEGIN_RECOVER_REQ = (True,)
-    BEGIN_RECOVER_RSP = (False,)
-    BEGIN_INVALIDATE_REQ = (True,)
-    BEGIN_INVALIDATE_RSP = (False,)
-    WAIT_ON_COMMIT_REQ = (False,)
-    WAIT_ON_COMMIT_RSP = (False,)
-    WAIT_UNTIL_APPLIED_REQ = (False,)
-    APPLY_THEN_WAIT_UNTIL_APPLIED_REQ = (True,)
-    INFORM_OF_TXN_REQ = (True,)
-    INFORM_DURABLE_REQ = (True,)
-    INFORM_HOME_DURABLE_REQ = (True,)
-    CHECK_STATUS_REQ = (False,)
-    CHECK_STATUS_RSP = (False,)
-    FETCH_DATA_REQ = (False,)
-    FETCH_DATA_RSP = (False,)
-    SET_SHARD_DURABLE_REQ = (True,)
-    SET_GLOBALLY_DURABLE_REQ = (True,)
-    QUERY_DURABLE_BEFORE_REQ = (False,)
-    QUERY_DURABLE_BEFORE_RSP = (False,)
-    PROPAGATE_PRE_ACCEPT_MSG = (True,)
-    PROPAGATE_STABLE_MSG = (True,)
-    PROPAGATE_APPLY_MSG = (True,)
-    PROPAGATE_OTHER_MSG = (True,)
+    SIMPLE_RSP = (0, False)
+    FAILURE_RSP = (1, False)
+    PRE_ACCEPT_REQ = (2, True)
+    PRE_ACCEPT_RSP = (3, False)
+    ACCEPT_REQ = (4, True)
+    ACCEPT_RSP = (5, False)
+    ACCEPT_INVALIDATE_REQ = (6, True)
+    ACCEPT_INVALIDATE_RSP = (7, False)
+    GET_DEPS_REQ = (8, False)
+    GET_DEPS_RSP = (9, False)
+    GET_EPHEMERAL_READ_DEPS_REQ = (10, False)
+    GET_EPHEMERAL_READ_DEPS_RSP = (11, False)
+    GET_MAX_CONFLICT_REQ = (12, False)
+    GET_MAX_CONFLICT_RSP = (13, False)
+    COMMIT_SLOW_PATH_REQ = (14, True)
+    COMMIT_MAXIMAL_REQ = (15, True)
+    STABLE_FAST_PATH_REQ = (16, True)
+    STABLE_SLOW_PATH_REQ = (17, True)
+    STABLE_MAXIMAL_REQ = (18, True)
+    COMMIT_INVALIDATE_REQ = (19, True)
+    APPLY_MINIMAL_REQ = (20, True)
+    APPLY_MAXIMAL_REQ = (21, True)
+    APPLY_RSP = (22, False)
+    READ_REQ = (23, False)
+    READ_EPHEMERAL_REQ = (24, False)
+    READ_RSP = (25, False)
+    BEGIN_RECOVER_REQ = (26, True)
+    BEGIN_RECOVER_RSP = (27, False)
+    BEGIN_INVALIDATE_REQ = (28, True)
+    BEGIN_INVALIDATE_RSP = (29, False)
+    WAIT_ON_COMMIT_REQ = (30, False)
+    WAIT_ON_COMMIT_RSP = (31, False)
+    WAIT_UNTIL_APPLIED_REQ = (32, False)
+    APPLY_THEN_WAIT_UNTIL_APPLIED_REQ = (33, True)
+    INFORM_OF_TXN_REQ = (34, True)
+    INFORM_DURABLE_REQ = (35, True)
+    INFORM_HOME_DURABLE_REQ = (36, True)
+    CHECK_STATUS_REQ = (37, False)
+    CHECK_STATUS_RSP = (38, False)
+    FETCH_DATA_REQ = (39, False)
+    FETCH_DATA_RSP = (40, False)
+    SET_SHARD_DURABLE_REQ = (41, True)
+    SET_GLOBALLY_DURABLE_REQ = (42, True)
+    QUERY_DURABLE_BEFORE_REQ = (43, False)
+    QUERY_DURABLE_BEFORE_RSP = (44, False)
+    PROPAGATE_PRE_ACCEPT_MSG = (45, True)
+    PROPAGATE_STABLE_MSG = (46, True)
+    PROPAGATE_APPLY_MSG = (47, True)
+    PROPAGATE_OTHER_MSG = (48, True)
 
-    def __init__(self, has_side_effects: bool):
+    def __init__(self, _id: int, has_side_effects: bool):
         self.has_side_effects = has_side_effects
 
 

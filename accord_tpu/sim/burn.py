@@ -7,9 +7,13 @@ impl/basic/Cluster.java:102.  One seeded RandomSource drives:
   simulated times (zipf-ish key skew);
 - network chaos re-randomized periodically: partitions + message drops over
   the simulated links (ref: NodeSink DELIVER/DROP, Cluster.java:518-630);
-- per-node clock drift (ref: BurnTest.java:330-340 FrequentLargeRange);
+- per-node clock drift: each node's local clock runs at a distinct rational
+  rate with a distinct offset (ref: BurnTest.java:330-340 FrequentLargeRange);
 - topology churn: periodic epochs shuffling membership/shard counts
   (ref: topology/TopologyRandomizer.java:58-115);
+- simulated persistence: random node crash-restarts reconstructing state
+  from the journal, plus random command eviction/reload
+  (ref: impl/basic/Journal.java:82-171, DelayedCommandStores.java:96-175);
 - strict-serializability verification of every client-observed result plus
   end-of-run accounting that every op resolved
   (ref: verify/StrictSerializabilityVerifier.java, BurnTest.java:480-499).
@@ -39,17 +43,20 @@ class BurnResult:
         self.ops_failed = 0
         self.ops_unresolved = 0
         self.epochs = 1
+        self.restarts = 0
+        self.evictions = 0
         self.stats: Dict[str, int] = {}
 
     def __repr__(self):
         return (f"BurnResult(ok={self.ops_ok}, failed={self.ops_failed}, "
-                f"unresolved={self.ops_unresolved}, epochs={self.epochs})")
+                f"unresolved={self.ops_unresolved}, epochs={self.epochs}, "
+                f"restarts={self.restarts}, evictions={self.evictions})")
 
 
 def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
              node_ids=(1, 2, 3, 4, 5), rf: int = 3, shards: int = 4,
              workload_micros: int = 20_000_000,
-             chaos: bool = True, churn: bool = True,
+             chaos: bool = True, churn: bool = True, restarts: bool = True,
              drain_micros: int = 120_000_000) -> BurnResult:
     rs = RandomSource(seed)
     topology = build_topology(1, node_ids, rf, shards)
@@ -60,6 +67,15 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     wl = rs.fork()           # workload randomness
     net = rs.fork()          # chaos randomness
     top = rs.fork()          # churn randomness
+
+    # per-node clock drift: ±1% rate + up to 2s initial offset — orders of
+    # magnitude beyond real crystal drift, enough to exercise every
+    # HLC-merge/fence path without drowning the run in slow paths
+    # (ref: BurnTest.java:330-340 FrequentLargeRange)
+    drift = rs.fork()
+    for nid in node_ids:
+        cluster.clock_drift[nid] = (990 + drift.next_int(21), 1000,
+                                    drift.next_int(2_000_000))
 
     # hot-key skew: a few keys get most of the traffic
     hot = [wl.next_int(n_keys) for _ in range(max(2, n_keys // 5))]
@@ -80,10 +96,12 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
             if wl.decide(0.6):
                 writes[k] = (f"s{op_seed}k{k}",)
         op = {"id": verifier.begin(), "start": cluster.queue.now,
-              "done": False, "writes": writes, "keys": keys}
+              "done": False, "writes": writes, "keys": keys, "node": node_id}
         outstanding.append(op)
 
         def on_done(res, failure):
+            if op["done"]:
+                return   # already counted lost (coordinator restarted)
             op["done"] = True
             if failure is not None:
                 result.ops_failed += 1
@@ -175,6 +193,45 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
 
     cluster.queue.add(1_000_000 + dur.next_int(1_000_000), durability_round)
 
+    # simulated persistence chaos: node crash-restarts (journal restore) and
+    # random command eviction/reload (ref: the burn's Journal +
+    # DelayedCommandStores random isLoadedCheck evictions)
+    rst = rs.fork()
+
+    def maybe_restart():
+        if cluster.queue.now > workload_micros:
+            return
+        nid = sorted(cluster.nodes)[rst.next_int(len(cluster.nodes))]
+        # the crash kills the node's client sessions: their ops become
+        # indeterminate for the client (not fed to the verifier)
+        for op in outstanding:
+            if not op["done"] and op["node"] == nid:
+                op["done"] = True
+                result.ops_failed += 1
+        cluster.restart_node(nid)
+        result.restarts += 1
+        cluster.queue.add(cluster.queue.now + 6_000_000 +
+                          rst.next_int(6_000_000), maybe_restart)
+
+    def evict_tick():
+        if cluster.queue.now > workload_micros:
+            return
+        nid = sorted(cluster.nodes)[rst.next_int(len(cluster.nodes))]
+        node = cluster.nodes[nid]
+        journal = cluster.journals[nid]
+        for store in node.command_stores.unsafe_all_stores():
+            txn_ids = sorted(store.commands)
+            for _ in range(min(3, len(txn_ids))):
+                tid = txn_ids[rst.next_int(len(txn_ids))]
+                journal.evict_and_reload(store, tid)
+                result.evictions += 1
+        cluster.queue.add(cluster.queue.now + 1_500_000 +
+                          rst.next_int(1_000_000), evict_tick)
+
+    if restarts:
+        cluster.queue.add(4_000_000 + rst.next_int(4_000_000), maybe_restart)
+        cluster.queue.add(1_000_000 + rst.next_int(1_000_000), evict_tick)
+
     # run the workload window + drain until every op resolves
     cluster.run_for(workload_micros)
     cluster.heal()
@@ -218,19 +275,21 @@ def main(argv=None):
                    help="run seeds loop-seed, loop-seed+1, ... forever")
     p.add_argument("--no-chaos", action="store_true")
     p.add_argument("--no-churn", action="store_true")
+    p.add_argument("--no-restarts", action="store_true")
     args = p.parse_args(argv)
 
     if args.loop_seed is not None:
         seed = args.loop_seed
         while True:
             r = run_burn(seed, n_ops=args.ops, chaos=not args.no_chaos,
-                         churn=not args.no_churn)
+                         churn=not args.no_churn,
+                         restarts=not args.no_restarts)
             print(f"seed {seed}: {r}")
             seed += 1
     start = args.seed if args.seed is not None else 0
     for seed in range(start, start + args.count):
         r = run_burn(seed, n_ops=args.ops, chaos=not args.no_chaos,
-                     churn=not args.no_churn)
+                     churn=not args.no_churn, restarts=not args.no_restarts)
         print(f"seed {seed}: {r}")
 
 

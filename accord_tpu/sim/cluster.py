@@ -110,13 +110,20 @@ class NodeSink(api.MessageSink):
     def __init__(self, node_id: int, cluster: "Cluster"):
         self.node_id = node_id
         self.cluster = cluster
+        # set on restart: this incarnation's process died — everything it
+        # still tries to send is a ghost and is silently dropped
+        self.dead = False
         self._callbacks: Dict[int, api.Callback] = {}
         self._callback_seq = itertools.count(1)
 
     def send(self, to: int, request) -> None:
+        if self.dead:
+            return
         self.cluster.route_request(self.node_id, to, request, callback_id=0)
 
     def send_with_callback(self, to: int, request, callback: api.Callback) -> None:
+        if self.dead:
+            return
         cid = next(self._callback_seq)
         self._callbacks[cid] = callback
         self.cluster.route_request(self.node_id, to, request, callback_id=cid)
@@ -128,6 +135,8 @@ class NodeSink(api.MessageSink):
             timeout *= 10
 
         def on_timeout():
+            if self.dead:
+                return
             cb = self._callbacks.pop(cid, None)
             if cb is not None:
                 from ..coordinate.errors import Timeout as TimeoutError_
@@ -136,7 +145,9 @@ class NodeSink(api.MessageSink):
                     lambda: cb.on_failure(to, TimeoutError_(msg=f"timeout to {to}")))
         self.cluster.queue.add(self.cluster.queue.now + timeout, on_timeout)
 
-    def reply(self, to: int, reply_context: _ReplyContext, reply) -> None:
+    def reply(self, to: int, reply_context, reply) -> None:
+        if self.dead:
+            return
         self.cluster.route_reply(self.node_id, to, reply_context, reply)
 
     # -- inbound (called by cluster on delivery) ----------------------------
@@ -241,6 +252,11 @@ class Cluster:
         self._num_stores = num_stores
         self.partitioned: Set[frozenset] = set()  # pairs that cannot talk
         self.drop_probability = 0.0
+        # per-node clock drift: node_id -> (num, den, offset_micros); a
+        # node's local clock reads queue.now * num // den + offset
+        # (ref: BurnTest.java:330-340 FrequentLargeRange clock drift).
+        # Rational arithmetic keeps the simulation bit-deterministic.
+        self.clock_drift: Dict[int, Tuple[int, int, int]] = {}
         # per-directed-link FIFO floor: messages on one link never reorder
         # (TCP-like; multi-part replies such as CommitOk-then-ReadOk rely on
         # it).  Latency stays random ACROSS links.
@@ -251,6 +267,10 @@ class Cluster:
         # per-node durability scheduling, driven by explicit ticks (sim) —
         # (ref: CoordinateDurabilityScheduling wired in test Cluster.java)
         self.durability: Dict[int, "object"] = {}
+        # per-node-identity durable journal: survives restart_node
+        # (ref: the simulation Journal, impl/basic/Journal.java)
+        from ..local.journal import Journal
+        self.journals: Dict[int, Journal] = {}
 
         scheduler = SimScheduler(self.queue)
         for nid in node_ids:
@@ -258,20 +278,30 @@ class Cluster:
             self.sinks[nid] = sink
             data_store = (data_store_factory(nid) if data_store_factory
                           else _NullDataStore())
+            self.journals[nid] = Journal()
             node = Node(
                 node_id=nid, message_sink=sink,
                 config_service=SimConfigService(self, nid),
                 scheduler=scheduler, data_store=data_store,
                 agent=SimAgent(self), random=self.random.fork(),
-                now_micros=lambda: self.queue.now,
+                now_micros=lambda nid=nid: self.node_now(nid),
                 progress_log_factory=progress_log_factory,
-                num_stores=num_stores, device_mode=device_mode)
+                num_stores=num_stores, device_mode=device_mode,
+                journal=self.journals[nid])
             self.nodes[nid] = node
             from ..impl.durability_scheduling import DurabilityScheduling
             self.durability[nid] = DurabilityScheduling(node)
         if topology is not None:
             for node in self.nodes.values():
                 node.on_topology_update(topology)
+
+    def node_now(self, nid: int) -> int:
+        """The node's drifted local clock (simulated time by default)."""
+        d = self.clock_drift.get(nid)
+        if d is None:
+            return self.queue.now
+        num, den, offset = d
+        return self.queue.now * num // den + offset
 
     # -- network ------------------------------------------------------------
     def _latency(self) -> int:
@@ -331,19 +361,22 @@ class Cluster:
                            lambda n=node: n.on_topology_update(topology))
 
     def _add_node(self, nid: int) -> Node:
+        from ..local.journal import Journal
         scheduler = SimScheduler(self.queue)
         sink = NodeSink(nid, self)
         self.sinks[nid] = sink
         data_store = (self._data_store_factory(nid) if self._data_store_factory
                       else _NullDataStore())
+        self.journals.setdefault(nid, Journal())
         node = Node(node_id=nid, message_sink=sink,
                     config_service=SimConfigService(self, nid),
                     scheduler=scheduler, data_store=data_store,
                     agent=SimAgent(self), random=self.random.fork(),
-                    now_micros=lambda: self.queue.now,
+                    now_micros=lambda nid=nid: self.node_now(nid),
                     progress_log_factory=self._progress_log_factory,
                     num_stores=self._num_stores,
-                    device_mode=self._device_mode)
+                    device_mode=self._device_mode,
+                    journal=self.journals[nid])
         self.nodes[nid] = node
         from ..impl.durability_scheduling import DurabilityScheduling
         self.durability[nid] = DurabilityScheduling(node)
@@ -351,6 +384,43 @@ class Cluster:
         for t in self.topologies:
             self.queue.add(self.queue.now,
                            lambda tt=t, n=node: n.on_topology_update(tt))
+        return node
+
+    # -- restart ------------------------------------------------------------
+    def restart_node(self, nid: int) -> Node:
+        """Crash-and-restart one node: the old incarnation's process state
+        (in-flight coordinations, listeners, caches) dies; the durable state
+        (data store + journal) survives, and the new incarnation rebuilds
+        its command stores from the journal
+        (ref: the journal-reload leg of the burn test,
+        impl/basic/DelayedCommandStores.java:96-175 — generalized to a full
+        process restart)."""
+        old = self.nodes[nid]
+        old.alive = False
+        old_sink = self.sinks[nid]
+        old_sink.dead = True
+        sink = NodeSink(nid, self)
+        # continue the callback numbering: a late reply addressed to a dead
+        # incarnation's callback id must never resolve to a fresh callback
+        # of the new incarnation (type confusion — e.g. a ghost ReadOk
+        # delivered into a Propose round)
+        sink._callback_seq = old_sink._callback_seq
+        self.sinks[nid] = sink
+        node = Node(node_id=nid, message_sink=sink,
+                    config_service=SimConfigService(self, nid),
+                    scheduler=SimScheduler(self.queue),
+                    data_store=old.data_store,        # durable
+                    agent=SimAgent(self), random=self.random.fork(),
+                    now_micros=lambda nid=nid: self.node_now(nid),
+                    progress_log_factory=self._progress_log_factory,
+                    num_stores=self._num_stores,
+                    device_mode=self._device_mode,
+                    journal=self.journals[nid])       # durable
+        self.nodes[nid] = node
+        from ..impl.durability_scheduling import DurabilityScheduling
+        self.durability[nid] = DurabilityScheduling(node)
+        node.restore_topologies(self.topologies)
+        self.journals[nid].restore(node)
         return node
 
     # -- partitions / chaos -------------------------------------------------
