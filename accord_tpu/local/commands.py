@@ -127,9 +127,21 @@ def _compute_witnessed_at(safe: SafeCommandStore, txn_id: TxnId,
     return node.unique_now_at_least(max_conflict).with_epoch_at_least(txn_id.epoch())
 
 
+def _per_key_deps(partial_deps: Optional[PartialDeps],
+                  token: int) -> Optional[List[TxnId]]:
+    """The command's dep ids on one key — what freezes into the CFK's
+    missing[] divergence when the deps are fixed."""
+    if partial_deps is None:
+        return None
+    ids = list(partial_deps.key_deps.txn_ids_for(token))
+    ids.extend(partial_deps.range_deps.intersecting_token(token))
+    return ids
+
+
 def _register_txn(safe: SafeCommandStore, txn_id: TxnId,
                   partial_txn: PartialTxn, status: InternalStatus,
-                  execute_at: Optional[Timestamp] = None) -> None:
+                  execute_at: Optional[Timestamp] = None,
+                  partial_deps: Optional[PartialDeps] = None) -> None:
     if not txn_id.kind().is_globally_visible():
         return
     keys = partial_txn.keys if partial_txn is not None else None
@@ -141,7 +153,9 @@ def _register_txn(safe: SafeCommandStore, txn_id: TxnId,
                                              else existing.with_(keys))
     else:
         for key in keys:
-            safe.cfk(key.token()).update(txn_id, status, execute_at)
+            safe.cfk(key.token()).update(
+                txn_id, status, execute_at,
+                witnessed_deps=_per_key_deps(partial_deps, key.token()))
     if safe.store.device is not None:
         safe.store.device.register(txn_id, int(status), keys)
         if execute_at is not None and status.has_execute_at():
@@ -150,7 +164,8 @@ def _register_txn(safe: SafeCommandStore, txn_id: TxnId,
 
 def _update_cfk_status(safe: SafeCommandStore, cmd: Command,
                        status: InternalStatus,
-                       execute_at: Optional[Timestamp] = None) -> None:
+                       execute_at: Optional[Timestamp] = None,
+                       partial_deps: Optional[PartialDeps] = None) -> None:
     if not cmd.txn_id.kind().is_globally_visible():
         return
     if safe.store.device is not None:
@@ -161,7 +176,9 @@ def _update_cfk_status(safe: SafeCommandStore, cmd: Command,
     if isinstance(keys, Ranges):
         return  # range txns tracked via range_commands + command status
     for key in keys:
-        safe.cfk(key.token()).update(cmd.txn_id, status, execute_at)
+        safe.cfk(key.token()).update(
+            cmd.txn_id, status, execute_at,
+            witnessed_deps=_per_key_deps(partial_deps, key.token()))
 
 
 def recover(safe: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
@@ -222,7 +239,8 @@ def accept(safe: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
         partial_deps=partial_deps)
     safe.update(new_cmd)
     safe.update_max_conflicts(keys, execute_at)
-    _update_cfk_status(safe, new_cmd, InternalStatus.ACCEPTED)
+    _update_cfk_status(safe, new_cmd, InternalStatus.ACCEPTED, execute_at,
+                       partial_deps=partial_deps)
     safe.progress_log().accepted(safe, txn_id)
     return AcceptOutcome.Success, None
 
@@ -283,7 +301,8 @@ def commit(safe: SafeCommandStore, txn_id: TxnId, target_stable: bool,
         partial_deps=deps)
     new_cmd = safe.update(new_cmd)
     safe.update_max_conflicts(merged_txn.keys, execute_at)
-    _register_txn(safe, txn_id, merged_txn, InternalStatus.COMMITTED, execute_at)
+    _register_txn(safe, txn_id, merged_txn, InternalStatus.COMMITTED,
+                  execute_at, partial_deps=deps)
     safe.progress_log().precommitted(safe, txn_id)
 
     if target_stable:
@@ -321,9 +340,13 @@ def precommit(safe: SafeCommandStore, txn_id: TxnId,
         if known_at is not None and known_at != execute_at:
             safe.agent().on_inconsistent_timestamp(cmd, known_at, execute_at)
         return CommitOutcome.Redundant
-    safe.update(cmd.updated(
+    new_cmd = safe.update(cmd.updated(
         save_status=save_status_for(Status.PreCommitted, cmd.known()),
         execute_at=execute_at))
+    # surface the decided executeAt in the per-key index (as an accepted-
+    # grade entry: deps not yet frozen) so recovery's accepted-no-witness
+    # scan sees it even before the full Commit arrives
+    _update_cfk_status(safe, new_cmd, InternalStatus.ACCEPTED, execute_at)
     safe.progress_log().precommitted(safe, txn_id)
     return CommitOutcome.Success
 

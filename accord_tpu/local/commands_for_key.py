@@ -1,7 +1,8 @@
 """Per-key conflict index — the PreAccept hot structure.
 
 Rebuild of ref: accord-core/src/main/java/accord/local/CommandsForKey.java:132
-(TxnInfo ladder :293-410, mapReduceActive :614-650, mapReduceFull :553-612).
+(TxnInfo ladder :293-410, mapReduceActive :614-650, mapReduceFull :553-612,
+the missing[]/transitive-elision design comment :73-131).
 
 This is the host (correctness) implementation: a sorted vector of TxnInfo per
 key with the scan API.  The batched device analogue — the same scan as a
@@ -9,11 +10,26 @@ masked searchsorted/prefix kernel over the CSR key->txn adjacency, vmapped
 over keys and in-flight txns — lives in accord_tpu.ops.deps_kernels and is
 validated against this implementation.
 
-The reference additionally compresses deps via ``missing[]`` arrays and
-transitive-dependency elision against maxAppliedWrite (CommandsForKey.java:73-131).
-Here we keep the full (uncompressed, always-correct) dep set host-side and
-apply pruning only through RedundantBefore watermarks; compression is a
-device-format concern.
+Two compressions keep dep sets O(active) instead of O(history), both from
+the reference's design comment (CommandsForKey.java:73-131):
+
+- **missing[] encoding.**  The collection implies the deps of every command
+  in it ("deps = every lower TxnId here"); each command stores only its
+  DIVERGENCE — the lower TxnIds it did NOT witness — in ``TxnInfo.missing``.
+  The invariant making later inserts cheap: when a command's deps freeze,
+  every per-key dep id is ensured present in the collection (transitively
+  witnessed if unseen), so any id inserted AFTER the freeze is guaranteed
+  unwitnessed and is appended to the frozen command's missing.  Ids that
+  reach Committed+ (or Invalidated) are elided from every missing array —
+  recovery of a decided id never deciphers fast-path votes, which is the
+  missing collection's only consumer.
+
+- **Transitive-dependency elision.**  mapReduceActive skips any decided
+  (Committed+) txn whose executeAt is below the latest committed WRITE
+  executing before the query bound: depending on that later write reaches
+  them transitively through its stable deps.  Recovery stays exact (see the
+  reference's argument: any recovery quorum either reports the later write
+  Stable — recovering its deps — or witnesses the earlier txn directly).
 """
 
 from __future__ import annotations
@@ -38,20 +54,41 @@ class InternalStatus(enum.IntEnum):
     INVALIDATED = 6
 
     def has_execute_at(self) -> bool:
-        return InternalStatus.COMMITTED <= self <= InternalStatus.APPLIED
+        """ACCEPTED carries the proposed executeAt (recovery's accepted-
+        no-witness reasoning needs it); COMMITTED+ the decided one."""
+        return InternalStatus.ACCEPTED <= self <= InternalStatus.APPLIED
 
 
 class TxnInfo:
     """(ref: CommandsForKey.java:293-410) — TxnId + per-key status +
-    executeAt."""
+    executeAt + the missing divergence (None until deps freeze)."""
 
-    __slots__ = ("txn_id", "status", "execute_at")
+    __slots__ = ("txn_id", "status", "execute_at", "missing")
 
     def __init__(self, txn_id: TxnId, status: InternalStatus,
-                 execute_at: Optional[Timestamp] = None):
+                 execute_at: Optional[Timestamp] = None,
+                 missing: Optional[List[TxnId]] = None):
         self.txn_id = txn_id
         self.status = status
         self.execute_at = execute_at if execute_at is not None else txn_id
+        # sorted lower TxnIds this command did NOT witness; None = deps not
+        # yet known here (witness queries must fall back to the Command)
+        self.missing = missing
+
+    def deps_known(self) -> bool:
+        return self.missing is not None
+
+    def witnesses_id(self, txn_id: TxnId) -> Optional[bool]:
+        """Whether this command's per-key deps include txn_id; None if the
+        collection cannot answer.  missing[] only records LOWER unwitnessed
+        ids (the implied-deps convention covers only ids below this one), so
+        membership of HIGHER ids — possible via accept-phase deps collected
+        up to a later executeAt — must fall back to the Command record."""
+        if self.missing is None or txn_id > self.txn_id:
+            return None
+        i = bisect.bisect_left(self.missing, txn_id)
+        present_in_missing = i < len(self.missing) and self.missing[i] == txn_id
+        return not present_in_missing
 
     def __repr__(self):
         return f"TxnInfo({self.txn_id}, {self.status.name})"
@@ -73,27 +110,106 @@ class CommandsForKey:
 
     # -- update path --------------------------------------------------------
     def update(self, txn_id: TxnId, status: InternalStatus,
-               execute_at: Optional[Timestamp] = None) -> None:
+               execute_at: Optional[Timestamp] = None,
+               witnessed_deps: Optional[List[TxnId]] = None) -> None:
         """Witness or advance a txn on this key
-        (ref: CommandsForKey insert/update :652+)."""
+        (ref: CommandsForKey insert/update :652+).  ``witnessed_deps`` is
+        the command's per-key dep ids when its deps freeze (accept/commit):
+        it drives the missing[] maintenance."""
         if not txn_id.kind().is_globally_visible():
             return
         info = self._infos.get(txn_id)
         if info is None:
-            self._infos[txn_id] = TxnInfo(txn_id, status, execute_at)
+            info = TxnInfo(txn_id, status, execute_at)
+            self._infos[txn_id] = info
             bisect.insort(self._ids, txn_id)
+            self._on_inserted(txn_id, status)
         else:
             # never regress
             if status < info.status and not (
                     status == InternalStatus.INVALIDATED):
                 return
+            prev = info.status
             info.status = max(info.status, status)
+            if status is InternalStatus.INVALIDATED:
+                info.status = InternalStatus.INVALIDATED
             if execute_at is not None and status.has_execute_at():
                 info.execute_at = execute_at
+            if prev < InternalStatus.COMMITTED and (
+                    info.status >= InternalStatus.COMMITTED):
+                # decided: elide from every missing array — recovery of a
+                # decided id never needs fast-path witness info
+                # (ref: the missing-elision rule, CommandsForKey.java:82-88)
+                self._elide_from_missing(txn_id)
+        if witnessed_deps is not None:
+            # (re)freeze: a higher-ballot accept or the commit may carry a
+            # different proposal — last-wins, recomputed vs the collection
+            self._freeze_deps(info, witnessed_deps)
+
+    def _freeze_deps(self, info: TxnInfo, witnessed_deps: List[TxnId]) -> None:
+        """The command's per-key deps are now fixed: ensure every dep id is
+        present (transitively witnessed) so later inserts are provably
+        unwitnessed, then record the divergence."""
+        witnessed = set()
+        for d in witnessed_deps:
+            if d == info.txn_id:
+                continue
+            witnessed.add(d)
+            # sync points are range-domain: they never enter a per-key index
+            # (ref: the CommandsForKey invariant that key deps on
+            # (Exclusive)SyncPoints are not added) — without this, every
+            # boundary fence dep lands in EVERY key's collection as a
+            # transitive entry and the index grows with fence history
+            if not d.kind().is_sync_point():
+                self.witness_transitive(d)
+        kinds = info.txn_id.kind().witnesses()
+        hi = bisect.bisect_left(self._ids, info.txn_id)
+        missing = []
+        for i in range(hi):
+            tid = self._ids[i]
+            if tid in witnessed or not kinds.test(tid.kind()):
+                continue
+            other = self._infos[tid]
+            if other.status >= InternalStatus.COMMITTED:
+                continue   # decided (or invalidated): elided
+            missing.append(tid)
+        info.missing = missing
+
+    def _on_inserted(self, txn_id: TxnId, status: InternalStatus) -> None:
+        """A new id entered the collection: every LATER command whose deps
+        are already frozen is guaranteed not to have witnessed it (its dep
+        ids were all ensured present at freeze time)."""
+        if status >= InternalStatus.COMMITTED:
+            return   # decided on arrival: elided everywhere
+        lo = bisect.bisect_right(self._ids, txn_id)
+        for i in range(lo, len(self._ids)):
+            info = self._infos[self._ids[i]]
+            if info.missing is None:
+                continue
+            if not info.txn_id.kind().witnesses().test(txn_id.kind()):
+                continue
+            j = bisect.bisect_left(info.missing, txn_id)
+            if j >= len(info.missing) or info.missing[j] != txn_id:
+                info.missing.insert(j, txn_id)
+
+    def _elide_from_missing(self, txn_id: TxnId) -> None:
+        lo = bisect.bisect_right(self._ids, txn_id)
+        for i in range(lo, len(self._ids)):
+            info = self._infos[self._ids[i]]
+            if not info.missing:
+                continue
+            j = bisect.bisect_left(info.missing, txn_id)
+            if j < len(info.missing) and info.missing[j] == txn_id:
+                del info.missing[j]
 
     def witness_transitive(self, txn_id: TxnId) -> None:
-        if txn_id not in self._infos:
-            self.update(txn_id, InternalStatus.TRANSITIVELY_KNOWN)
+        if self.prune_before is not None and txn_id < self.prune_before:
+            return   # decided+applied everywhere: never re-enters the index
+        if txn_id.kind().is_globally_visible() and txn_id not in self._infos:
+            self._infos[txn_id] = TxnInfo(txn_id,
+                                          InternalStatus.TRANSITIVELY_KNOWN)
+            bisect.insort(self._ids, txn_id)
+            self._on_inserted(txn_id, InternalStatus.TRANSITIVELY_KNOWN)
 
     def remove(self, txn_id: TxnId) -> None:
         if txn_id in self._infos:
@@ -117,26 +233,51 @@ class CommandsForKey:
         cut = bisect.bisect_left(self._ids, self.prune_before)
         if cut == 0:
             return 0
-        for tid in self._ids[:cut]:
+        dropped = self._ids[:cut]
+        for tid in dropped:
             del self._infos[tid]
         del self._ids[:cut]
+        # their missing entries are dead weight now
+        for tid in dropped:
+            self._elide_from_missing(tid)
         return cut
 
     # -- scan API -----------------------------------------------------------
+    def max_committed_write_before(self, bound: Timestamp) -> Optional[Timestamp]:
+        """The latest executeAt of a decided (Committed+) WRITE executing
+        before ``bound`` — the transitive-elision pivot
+        (ref: mapReduceActive's maxCommittedBefore, CommandsForKey.java:614)."""
+        best: Optional[Timestamp] = None
+        for info in self._infos.values():
+            if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED \
+                    and info.txn_id.kind().is_write() \
+                    and info.execute_at < bound:
+                if best is None or info.execute_at > best:
+                    best = info.execute_at
+        return best
+
     def map_reduce_active(self, started_before: Timestamp, witnesses: Kinds,
                           fn: Callable[[TxnId, "object"], "object"], acc):
         """Fold over active txns with txnId < started_before whose kind the
         querying txn must witness (ref: CommandsForKey.java:614-650).
-        Skips invalidated txns and anything below the prune watermark."""
+        Skips invalidated and transitively-known txns, anything below the
+        prune watermark, and — the transitive elision — decided txns whose
+        executeAt is below the latest committed write before the bound."""
         hi = bisect.bisect_left(self._ids, started_before)
         lo = 0
         if self.prune_before is not None:
             lo = bisect.bisect_left(self._ids, self.prune_before)
+        max_committed = self.max_committed_write_before(started_before)
         for i in range(lo, hi):
             tid = self._ids[i]
             info = self._infos[tid]
-            if info.status is InternalStatus.INVALIDATED:
+            if info.status in (InternalStatus.INVALIDATED,
+                               InternalStatus.TRANSITIVELY_KNOWN):
                 continue
+            if info.status >= InternalStatus.COMMITTED \
+                    and max_committed is not None \
+                    and info.execute_at < max_committed:
+                continue   # reached transitively via the later write's deps
             if not witnesses.test(tid.kind()):
                 continue
             acc = fn(tid, acc)
@@ -146,7 +287,7 @@ class CommandsForKey:
                         fn: Callable[[TxnInfo, "object"], "object"], acc):
         """Fold over ALL txns (any bound, any status) for recovery queries
         (ref: CommandsForKey.java:553-612)."""
-        for tid in self._ids:
+        for tid in list(self._ids):
             info = self._infos[tid]
             if not witnesses.test(tid.kind()):
                 continue

@@ -407,6 +407,27 @@ class DeviceState:
 
         rb = safe.redundant_before()
         m = self.deps
+        # per-key transitive-elision pivots (mirror the host
+        # CommandsForKey.map_reduce_active compression — see its docstring)
+        from .commands_for_key import InternalStatus
+        bounds: Dict[int, object] = {}
+
+        def elide(t: int, dep_id: TxnId) -> bool:
+            cfk = self.store.commands_for_key.get(t)
+            if cfk is None:
+                return False
+            info = cfk.get(dep_id)
+            if info is None:
+                return False
+            if info.status is InternalStatus.TRANSITIVELY_KNOWN:
+                return True
+            if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED:
+                if t not in bounds:
+                    bounds[t] = cfk.max_committed_write_before(started_before)
+                b = bounds[t]
+                return b is not None and info.execute_at < b
+            return False
+
         # attribute each dep to the query keys/ranges its footprint overlaps
         # (the kernel answers "who", the mirror answers "where")
         for j in dep_slots:
@@ -416,13 +437,13 @@ class DeviceState:
             if dep_id.domain() is Domain.Key:
                 for t in q_toks:
                     if np.any(used & (slo <= t) & (t <= shi)) and \
-                            dep_id >= rb.deps_floor(t):
+                            dep_id >= rb.deps_floor(t) and not elide(t, dep_id):
                         builder.add_key(t, dep_id)
                 for r in q_rngs:
                     sel = used & (slo <= r.end - 1) & (r.start <= shi)
                     for mm in np.nonzero(sel)[0]:
                         t = int(slo[mm])   # key-domain footprints are points
-                        if dep_id >= rb.deps_floor(t):
+                        if dep_id >= rb.deps_floor(t) and not elide(t, dep_id):
                             builder.add_key(t, dep_id)
             else:
                 for t in q_toks:

@@ -43,8 +43,8 @@ from .status import Durability, SaveStatus, Status
 
 
 # Message-body slots per txn, by what reconstruct() needs from them
-# (ref: SerializerSupport PRE_ACCEPT_TYPES / ACCEPT / COMMIT / APPLY sets).
-_TXN_SOURCE_TYPES = ("PRE_ACCEPT_REQ", "BEGIN_RECOVER_REQ", "ACCEPT_REQ")
+# (ref: SerializerSupport PRE_ACCEPT_TYPES / ACCEPT / COMMIT / APPLY sets;
+# txn bodies are captured generically from any message carrying one).
 _COMMIT_TYPES = ("COMMIT_SLOW_PATH_REQ", "COMMIT_MAXIMAL_REQ",
                  "STABLE_FAST_PATH_REQ", "STABLE_SLOW_PATH_REQ",
                  "STABLE_MAXIMAL_REQ")
@@ -412,8 +412,12 @@ class Journal:
                 store.range_commands[txn_id] = (keys if existing is None
                                                 else existing.with_(keys))
         else:
+            from .commands import _per_key_deps
             for key in keys:
-                store.cfk(key.token()).update(txn_id, status, execute_at)
+                store.cfk(key.token()).update(
+                    txn_id, status, execute_at,
+                    witnessed_deps=_per_key_deps(cmd.partial_deps,
+                                                 key.token()))
         ts = cmd.execute_at if cmd.execute_at is not None else txn_id
         store.max_conflicts.update(keys, ts)
         if txn_id.kind() is TxnKind.ExclusiveSyncPoint \

@@ -83,8 +83,9 @@ class RecoverOk(Reply):
                 f"accepted={self.accepted}, rejectsFP={self.rejects_fast_path})")
 
 
-def _witnesses_us(cmd, txn_id: TxnId, token: int) -> bool:
-    """Does cmd's (partial) dep set include txn_id at this key?"""
+def _witnesses_us_cmd(cmd, txn_id: TxnId, token: int) -> bool:
+    """Fallback witness query against the Command record (range txns and
+    pre-missing[] states): does its (partial) dep set include txn_id?"""
     if cmd is None or cmd.partial_deps is None:
         return False
     if txn_id in cmd.partial_deps.key_deps.txn_ids_for(token):
@@ -94,7 +95,12 @@ def _witnesses_us(cmd, txn_id: TxnId, token: int) -> bool:
 
 def _recovery_scans(safe: SafeCommandStore, txn_id: TxnId, keys):
     """The three BeginRecovery scans (ref: BeginRecovery.java:329-380) in one
-    pass over the store's full per-key history."""
+    pass over the store's full per-key history.  Witness membership comes
+    from the CFK's missing[] divergence where frozen (self-contained even
+    after the Command's deps are evicted/truncated, ref the missing[]
+    design comment CommandsForKey.java:73-99), falling back to the Command
+    record otherwise."""
+    from ..local.commands_for_key import InternalStatus as IS
     witnessed_by = txn_id.kind().witnessed_by()
     rejects_fast_path = False
     ecw = DepsBuilder()   # earlier committed witness
@@ -105,33 +111,28 @@ def _recovery_scans(safe: SafeCommandStore, txn_id: TxnId, keys):
         other = info.txn_id
         if other == txn_id:
             return acc
-        cmd = safe.if_present(other)
-        if cmd is None:
+        st = info.status
+        if st in (IS.INVALIDATED, IS.TRANSITIVELY_KNOWN, IS.PREACCEPTED):
+            # no decided/accepted state of its own to vote with
             return acc
-        status = cmd.status
-        witnesses = _witnesses_us(cmd, txn_id, token)
+        witnesses = info.witnesses_id(txn_id)
+        if witnesses is None:
+            witnesses = _witnesses_us_cmd(safe.if_present(other), txn_id, token)
+        exec_at = info.execute_at
         if other > txn_id:
             # started after us: accepted/committed without witnessing us
             # proves our fast path cannot have been taken
-            if (status in (Status.Accepted, Status.PreCommitted,
-                           Status.Committed, Status.Stable, Status.PreApplied,
-                           Status.Applied)
-                    and not witnesses):
+            if st >= IS.ACCEPTED and not witnesses:
                 rejects_fast_path = True
         else:
             # stable+ that executes after us without witnessing us also
             # rejects (ref: hasStableExecutesAfterWithoutWitnessing)
-            if (status in (Status.Stable, Status.PreApplied, Status.Applied)
-                    and not witnesses and cmd.execute_at is not None
-                    and cmd.execute_at > txn_id):
+            if st >= IS.STABLE and not witnesses and exec_at > txn_id:
                 rejects_fast_path = True
-            if status in (Status.Stable, Status.PreApplied, Status.Applied) \
-                    and witnesses:
+            if st >= IS.STABLE and witnesses:
                 ecw.add_key(token, other)
-            elif (status in (Status.Accepted, Status.PreCommitted,
-                             Status.Committed)
-                  and not witnesses and cmd.execute_at is not None
-                  and cmd.execute_at > txn_id):
+            elif st in (IS.ACCEPTED, IS.COMMITTED) and not witnesses \
+                    and exec_at > txn_id:
                 eanw.add_key(token, other)
         return acc
 
