@@ -1,0 +1,120 @@
+"""Barriers: wait until everything ordered before now is visible.
+
+Rebuild of ref: accord-core/src/main/java/accord/coordinate/Barrier.java:58 —
+a LOCAL barrier settles once a barrier transaction (an inclusive SyncPoint
+over the ranges, or an existing applied one piggybacked) has applied on THIS
+node, proving every transaction ordered before it is locally visible; a
+GLOBAL barrier further waits until it has applied at a quorum of every
+shard (via WaitUntilApplied), proving cluster-wide visibility.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import api
+from ..messages.durability import WaitUntilApplied
+from ..messages.fetch_snapshot import await_applied
+from ..primitives.keys import Ranges
+from ..primitives.timestamp import TxnId
+from ..primitives.writes import SyncPoint
+from ..utils import async_chain
+from .errors import Timeout
+from .sync_point import coordinate_sync_point
+from .tracking import QuorumTracker, RequestStatus
+
+
+def barrier(node, ranges: Ranges, global_: bool = False
+            ) -> async_chain.AsyncChain:
+    """Settles with the barrier SyncPoint handle once the barrier condition
+    holds.  ``global_=False``: applied locally on every intersecting store;
+    ``global_=True``: additionally applied at a quorum of every shard."""
+    result: async_chain.AsyncResult = async_chain.AsyncResult()
+
+    existing = None if global_ else _try_existing(node, ranges)
+    if existing is not None:
+        # piggyback (ref: Barrier.tryExistingTxn): an applied barrier txn
+        # covering the ranges already proves the local condition
+        result.set_success(SyncPoint(existing, None, None))
+        return result
+
+    def on_coordinated(sp, failure):
+        if failure is not None:
+            result.set_failure(failure)
+            return
+        if global_:
+            _await_global(node, sp, ranges, result)
+        else:
+            _await_local(node, sp, ranges, result)
+
+    coordinate_sync_point(node, ranges, exclusive=False).begin(on_coordinated)
+    return result
+
+
+def _try_existing(node, ranges: Ranges) -> Optional[TxnId]:
+    """An already-applied sync point covering the ranges on every
+    intersecting local store."""
+    epoch = node.epoch()
+    stores = node.command_stores.intersecting(ranges, epoch, epoch)
+    if not stores:
+        return None
+    candidates: Optional[set] = None
+    for store in stores:
+        local = set()
+        for tid, covered in store.range_commands.items():
+            if not tid.kind().is_sync_point():
+                continue
+            if not covered.contains_all_ranges(ranges.intersecting(
+                    store.owned_current())):
+                continue
+            cmd = store.commands.get(tid)
+            if cmd is not None and cmd.is_applied():
+                local.add(tid)
+        candidates = local if candidates is None else candidates & local
+        if not candidates:
+            return None
+    return max(candidates) if candidates else None
+
+
+def _await_local(node, sp, ranges: Ranges,
+                 result: async_chain.AsyncResult) -> None:
+    from ..local.command_store import PreLoadContext
+    epoch = node.epoch()
+    stores = node.command_stores.intersecting(ranges, sp.sync_id.epoch(),
+                                              max(epoch, sp.sync_id.epoch()))
+    if not stores:
+        result.set_success(sp)
+        return
+    chains = [s.execute(PreLoadContext.for_txn(sp.sync_id),
+                        lambda safe: await_applied(safe, sp.sync_id, ranges))
+              for s in stores]
+    async_chain.all_of(chains).flat_map(async_chain.all_of).begin(
+        lambda _v, f: result.settle(sp if f is None else None, f))
+
+
+def _await_global(node, sp, ranges: Ranges,
+                  result: async_chain.AsyncResult) -> None:
+    topologies = node.topology().for_epoch(ranges, sp.sync_id.epoch())
+    tracker = QuorumTracker(topologies)
+
+    class Cb(api.Callback):
+        done = False
+
+        def on_success(self, from_id: int, reply) -> None:
+            if self.done:
+                return
+            if tracker.record_success(from_id) is RequestStatus.Success:
+                self.done = True
+                result.set_success(sp)
+
+        def on_failure(self, from_id: int, failure: BaseException) -> None:
+            if self.done:
+                return
+            if tracker.record_failure(from_id) is RequestStatus.Failed:
+                self.done = True
+                result.set_failure(Timeout(sp.sync_id))
+
+    cb = Cb()
+    request = WaitUntilApplied(sp.sync_id, ranges)
+    for to in sorted(tracker.nodes()):
+        node.send(to, request, cb)

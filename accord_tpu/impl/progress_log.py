@@ -163,6 +163,39 @@ class SimpleProgressLog(api.ProgressLog):
         node = self.store.node
         txn_id = entry.txn_id
 
+        if entry.participants is None or entry.participants.is_empty():
+            # we know the id but not where it lives: discover a route first
+            # (ref: coordinate/FindSomeRoute.java — recovery/fetch no longer
+            # assumes the caller knows the route)
+            from ..coordinate.find_route import find_some_route
+
+            def on_route(route, failure):
+                current = self.blocked.get(txn_id)
+                if current is not entry:
+                    return
+                if failure is not None or route is None:
+                    entry.no_progress()
+                    if failure is None:
+                        # nobody anywhere knows this id: an abandoned
+                        # coordination — escalate to invalidation so waiters
+                        # unblock (the same escape hatch as the fetch leg;
+                        # the blocker intersects our ranges or we would not
+                        # be waiting on it, and one participating shard's
+                        # quorum suffices for the invalidation ballot)
+                        entry.empty_fetches += 1
+                        if entry.empty_fetches >= 2:
+                            entry.empty_fetches = 0
+                            node.invalidate_abandoned(
+                                txn_id, self.store.owned_current())
+                else:
+                    entry.participants = route.participants
+                    entry.progress = _Progress.Expected
+                    entry.countdown = 0
+                self._arm()
+
+            find_some_route(node, txn_id, entry.participants).begin(on_route)
+            return
+
         def on_done(merged, failure):
             current = self.blocked.get(txn_id)
             if current is not entry:
@@ -201,16 +234,8 @@ class SimpleProgressLog(api.ProgressLog):
     def _inform_home(self, txn_id: TxnId, route) -> None:
         """Tell the home shard's replicas to track (and so recover) the txn
         (ref: messages/InformOfTxnId.java / InformHomeOfTxn)."""
-        from ..messages.inform import InformOfTxnId
-        from ..primitives.keys import RoutingKeys
-        node = self.store.node
-        if route.home_key is None:
-            return
-        home = RoutingKeys.of(route.home_key)
-        topologies = node.topology().for_epoch(home, txn_id.epoch())
-        request = InformOfTxnId(txn_id, route)
-        for to in sorted(topologies.nodes()):
-            node.send(to, request)
+        from ..coordinate.find_route import inform_home_of_txn
+        inform_home_of_txn(self.store.node, txn_id, route)
 
     # -- helpers -------------------------------------------------------------
     def _track_home(self, safe, txn_id: TxnId) -> None:

@@ -98,7 +98,8 @@ class CommandsForKey:
     """All (globally visible) transactions witnessed on one key, ordered by
     TxnId, with a parallel executeAt-ordered view of committed txns."""
 
-    __slots__ = ("token", "_ids", "_infos", "prune_before")
+    __slots__ = ("token", "_ids", "_infos", "prune_before",
+                 "_committed_write_execs")
 
     def __init__(self, token: int):
         self.token = token
@@ -107,6 +108,10 @@ class CommandsForKey:
         # txns with txnId < prune_before are redundant (covered by
         # RedundantBefore) and excluded from deps
         self.prune_before: Optional[TxnId] = None
+        # executeAts of decided (Committed+) writes, sorted — the elision
+        # pivot lookup must not rescan the whole history on the hot path
+        # (ref: the committed[] executeAt-ordered array, CommandsForKey.java)
+        self._committed_write_execs: List[Timestamp] = []
 
     # -- update path --------------------------------------------------------
     def update(self, txn_id: TxnId, status: InternalStatus,
@@ -124,23 +129,34 @@ class CommandsForKey:
             self._infos[txn_id] = info
             bisect.insort(self._ids, txn_id)
             self._on_inserted(txn_id, status)
+            if InternalStatus.COMMITTED <= status <= InternalStatus.APPLIED \
+                    and txn_id.kind().is_write():
+                bisect.insort(self._committed_write_execs, info.execute_at)
         else:
-            # never regress
-            if status < info.status and not (
-                    status == InternalStatus.INVALIDATED):
-                return
             prev = info.status
-            info.status = max(info.status, status)
-            if status is InternalStatus.INVALIDATED:
-                info.status = InternalStatus.INVALIDATED
+            info.status = max(info.status, status)   # never regress
             if execute_at is not None and status.has_execute_at():
                 info.execute_at = execute_at
+            if info.status is InternalStatus.INVALIDATED \
+                    and InternalStatus.COMMITTED <= prev <= InternalStatus.APPLIED \
+                    and txn_id.kind().is_write():
+                # illegal in a healthy run (commit_invalidate guards it) but
+                # a stale pivot from an invalidated write must never elide
+                # genuinely-live deps
+                i = bisect.bisect_left(self._committed_write_execs,
+                                       info.execute_at)
+                if i < len(self._committed_write_execs) \
+                        and self._committed_write_execs[i] == info.execute_at:
+                    del self._committed_write_execs[i]
             if prev < InternalStatus.COMMITTED and (
                     info.status >= InternalStatus.COMMITTED):
                 # decided: elide from every missing array — recovery of a
                 # decided id never needs fast-path witness info
                 # (ref: the missing-elision rule, CommandsForKey.java:82-88)
                 self._elide_from_missing(txn_id)
+                if info.status is not InternalStatus.INVALIDATED \
+                        and txn_id.kind().is_write():
+                    bisect.insort(self._committed_write_execs, info.execute_at)
         if witnessed_deps is not None:
             # (re)freeze: a higher-ballot accept or the commit may carry a
             # different proposal — last-wins, recomputed vs the collection
@@ -240,21 +256,38 @@ class CommandsForKey:
         # their missing entries are dead weight now
         for tid in dropped:
             self._elide_from_missing(tid)
+        # rebuild the pivot list (prune is rare; the hot path stays O(log n))
+        self._committed_write_execs = sorted(
+            info.execute_at for info in self._infos.values()
+            if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED
+            and info.txn_id.kind().is_write())
         return cut
 
     # -- scan API -----------------------------------------------------------
     def max_committed_write_before(self, bound: Timestamp) -> Optional[Timestamp]:
         """The latest executeAt of a decided (Committed+) WRITE executing
-        before ``bound`` — the transitive-elision pivot
-        (ref: mapReduceActive's maxCommittedBefore, CommandsForKey.java:614)."""
-        best: Optional[Timestamp] = None
-        for info in self._infos.values():
-            if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED \
-                    and info.txn_id.kind().is_write() \
-                    and info.execute_at < bound:
-                if best is None or info.execute_at > best:
-                    best = info.execute_at
-        return best
+        before ``bound`` — the transitive-elision pivot, answered from the
+        incrementally-maintained executeAt-sorted list in O(log n)
+        (ref: mapReduceActive's maxCommittedBefore over the committed[]
+        array, CommandsForKey.java:614)."""
+        i = bisect.bisect_left(self._committed_write_execs, bound)
+        return self._committed_write_execs[i - 1] if i > 0 else None
+
+    def is_elided(self, info: TxnInfo, bound: Timestamp,
+                  pivot: Optional[Timestamp] = None) -> bool:
+        """The one active-scan skip rule, shared by the host fold and the
+        device query attribution (keep them in lockstep): transitively-known
+        and invalidated entries never appear; decided entries executing
+        below the latest decided write before ``bound`` are reached through
+        that write's stable deps."""
+        if info.status in (InternalStatus.INVALIDATED,
+                           InternalStatus.TRANSITIVELY_KNOWN):
+            return True
+        if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED:
+            if pivot is None:
+                pivot = self.max_committed_write_before(bound)
+            return pivot is not None and info.execute_at < pivot
+        return False
 
     def map_reduce_active(self, started_before: Timestamp, witnesses: Kinds,
                           fn: Callable[[TxnId, "object"], "object"], acc):
@@ -267,17 +300,12 @@ class CommandsForKey:
         lo = 0
         if self.prune_before is not None:
             lo = bisect.bisect_left(self._ids, self.prune_before)
-        max_committed = self.max_committed_write_before(started_before)
+        pivot = self.max_committed_write_before(started_before)
         for i in range(lo, hi):
             tid = self._ids[i]
             info = self._infos[tid]
-            if info.status in (InternalStatus.INVALIDATED,
-                               InternalStatus.TRANSITIVELY_KNOWN):
+            if self.is_elided(info, started_before, pivot):
                 continue
-            if info.status >= InternalStatus.COMMITTED \
-                    and max_committed is not None \
-                    and info.execute_at < max_committed:
-                continue   # reached transitively via the later write's deps
             if not witnesses.test(tid.kind()):
                 continue
             acc = fn(tid, acc)

@@ -407,26 +407,17 @@ class DeviceState:
 
         rb = safe.redundant_before()
         m = self.deps
-        # per-key transitive-elision pivots (mirror the host
-        # CommandsForKey.map_reduce_active compression — see its docstring)
-        from .commands_for_key import InternalStatus
-        bounds: Dict[int, object] = {}
 
         def elide(t: int, dep_id: TxnId) -> bool:
+            # the SAME skip rule as the host CommandsForKey.map_reduce_active
+            # (one shared predicate — the device path must not drift)
             cfk = self.store.commands_for_key.get(t)
             if cfk is None:
                 return False
             info = cfk.get(dep_id)
             if info is None:
                 return False
-            if info.status is InternalStatus.TRANSITIVELY_KNOWN:
-                return True
-            if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED:
-                if t not in bounds:
-                    bounds[t] = cfk.max_committed_write_before(started_before)
-                b = bounds[t]
-                return b is not None and info.execute_at < b
-            return False
+            return cfk.is_elided(info, started_before)
 
         # attribute each dep to the query keys/ranges its footprint overlaps
         # (the kernel answers "who", the mirror answers "where")

@@ -312,6 +312,12 @@ class Node:
                    _retries: int = 0) -> async_chain.AsyncResult:
         from ..coordinate.coordinate_transaction import CoordinateTransaction
         from ..coordinate.errors import Rejected
+        if txn.kind is TxnKind.EphemeralRead:
+            # non-durable: no consensus rounds, no recovery, no watchdog —
+            # a failure surfaces to the caller, who simply retries
+            # (ref: CoordinateEphemeralRead)
+            from ..coordinate.ephemeral import coordinate_ephemeral_read
+            return coordinate_ephemeral_read(self, txn)
         explicit_id = txn_id is not None
         if txn_id is None:
             txn_id = self.next_txn_id(txn.kind, txn.domain())

@@ -32,7 +32,7 @@ from typing import Dict, List, Optional, Tuple
 from ..topology.topology import Topology
 from ..utils.random_source import RandomSource
 from .cluster import Cluster
-from .kvstore import KVDataStore, kv_txn
+from .kvstore import (KVDataStore, kv_ephemeral_read, kv_range_read, kv_txn)
 from .topology_factory import build_topology
 from .verifier import StrictSerializabilityVerifier
 
@@ -88,13 +88,31 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     outstanding: List[dict] = []
 
     def submit_op(op_seed: int):
+        from ..primitives.keys import Range, Ranges
         node_id = sorted(cluster.nodes)[wl.next_int(len(cluster.nodes))]
-        n = wl.next_int(3) + 1
-        keys = sorted({pick_key() for _ in range(n)})
-        writes = {}
-        for k in keys:
-            if wl.decide(0.6):
-                writes[k] = (f"s{op_seed}k{k}",)
+        roll = wl.next_float()
+        window = None
+        if roll < 0.06:
+            # non-durable single-key linearizable read
+            # (ref: the burn's EphemeralRead mix, BurnTest.java:124-259)
+            keys = [pick_key()]
+            writes = {}
+            txn = kv_ephemeral_read(keys)
+        elif roll < 0.14:
+            # range-domain read over a zipf-ish key window
+            lo = wl.next_int(n_keys)
+            hi = min(n_keys, lo + 1 + wl.next_int(4))
+            window = [k * 10 for k in range(lo, hi)]
+            keys, writes = window, {}
+            txn = kv_range_read(Ranges.of(Range(lo * 10, hi * 10)))
+        else:
+            n = wl.next_int(3) + 1
+            keys = sorted({pick_key() for _ in range(n)})
+            writes = {}
+            for k in keys:
+                if wl.decide(0.6):
+                    writes[k] = (f"s{op_seed}k{k}",)
+            txn = kv_txn(keys, writes)
         op = {"id": verifier.begin(), "start": cluster.queue.now,
               "done": False, "writes": writes, "keys": keys, "node": node_id}
         outstanding.append(op)
@@ -107,10 +125,15 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
                 result.ops_failed += 1
                 return
             result.ops_ok += 1
+            reads = res.reads
+            if window is not None:
+                # a range read observing nothing on a window key observed
+                # the empty prefix — record it so real-time checks bite
+                reads = {t: res.reads.get(t, ()) for t in window}
             verifier.on_result(op["id"], op["start"], cluster.queue.now,
-                               res.reads, res.appends)
+                               reads, res.appends)
 
-        cluster.nodes[node_id].coordinate(kv_txn(keys, writes)).begin(on_done)
+        cluster.nodes[node_id].coordinate(txn).begin(on_done)
 
     # schedule the workload across the window
     for i in range(n_ops):

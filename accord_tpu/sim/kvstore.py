@@ -107,6 +107,32 @@ class KVRead(api.Read):
         return KVRead(self._keys.with_(other._keys))
 
 
+class KVRangeRead(api.Read):
+    """Range-domain read: scans every key the store holds within the ranges
+    (ref: the reference burn's range reads through list/ListRead)."""
+
+    def __init__(self, ranges: Ranges):
+        self._ranges = ranges
+
+    def keys(self) -> Ranges:
+        return self._ranges
+
+    def read(self, rng, safe_store, execute_at, store: KVDataStore):
+        vals = {}
+        for token in list(store.tokens()):
+            if rng.start <= token < rng.end:
+                vals[token] = store.read_at(token, execute_at)
+        return async_chain.success(KVData(vals))
+
+    def slice(self, ranges: Ranges) -> "KVRangeRead":
+        return KVRangeRead(self._ranges.intersecting(ranges))
+
+    def merge(self, other: Optional["KVRangeRead"]) -> "KVRangeRead":
+        if other is None:
+            return self
+        return KVRangeRead(self._ranges.with_(other._ranges))
+
+
 class KVWrite(api.Write):
     def __init__(self, appends: Dict[int, tuple]):
         self.appends = appends
@@ -168,3 +194,15 @@ def kv_txn(read_tokens: List[int], appends: Dict[int, tuple]) -> Txn:
     read = KVRead(Keys([IntKey(t) for t in sorted(set(read_tokens))]))
     update = KVUpdate(appends) if appends else None
     return Txn(kind, keys, read, update, KVQuery())
+
+
+def kv_ephemeral_read(read_tokens: List[int]) -> Txn:
+    """A non-durable per-key-linearizable read
+    (ref: coordinate/CoordinateEphemeralRead.java)."""
+    keys = Keys([IntKey(t) for t in sorted(set(read_tokens))])
+    return Txn(TxnKind.EphemeralRead, keys, KVRead(keys), None, KVQuery())
+
+
+def kv_range_read(ranges: Ranges) -> Txn:
+    """A range-domain read transaction."""
+    return Txn(TxnKind.Read, ranges, KVRangeRead(ranges), None, KVQuery())
