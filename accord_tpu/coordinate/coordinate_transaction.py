@@ -15,8 +15,7 @@ from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
 from ..utils import async_chain
 from .errors import Exhausted, Preempted, Rejected, Timeout
-from .execute import execute
-from .propose import propose
+from .adapter import Adapters
 from .tracking import FastPathTracker, RequestStatus
 
 
@@ -32,6 +31,8 @@ class CoordinateTransaction(api.Callback):
         self.txn_id = txn_id
         self.txn = txn
         self.route = node.compute_route(txn_id, txn.keys)
+        # the pipeline-strategy seam (ref: CoordinationAdapter.java:49)
+        self.adapter = Adapters.for_kind(txn_id.kind())
         self.result: async_chain.AsyncResult = async_chain.AsyncResult()
         self.topologies = node.topology().with_unsynced_epochs(
             self.route.participants, txn_id.epoch(), txn_id.epoch())
@@ -90,8 +91,8 @@ class CoordinateTransaction(api.Callback):
             deps = Deps.merge([ok.deps for ok in oks
                                if ok.witnessed_at == self.txn_id])
             self.node.agent.events_listener().on_fast_path_taken(self.txn_id, deps)
-            execute(self.node, self.txn_id, self.txn, self.route,
-                    self.txn_id, deps).begin(self.result.settle)
+            self.adapter.execute(self.node, self.txn_id, self.txn, self.route,
+                                 self.txn_id, deps).begin(self.result.settle)
         else:
             execute_at = self.txn_id
             for ok in oks:
@@ -115,16 +116,17 @@ class CoordinateTransaction(api.Callback):
                 return
             deps = Deps.merge([ok.deps for ok in oks])
             self.node.agent.events_listener().on_slow_path_taken(self.txn_id, deps)
-            propose(self.node, Ballot.ZERO, self.txn_id, self.txn, self.route,
-                    execute_at, deps).begin(self._on_proposed)
+            self.adapter.propose(self.node, Ballot.ZERO, self.txn_id, self.txn,
+                                 self.route, execute_at, deps).begin(
+                self._on_proposed)
 
     def _on_proposed(self, value, failure) -> None:
         if failure is not None:
             self.result.set_failure(failure)
             return
         execute_at, deps = value
-        execute(self.node, self.txn_id, self.txn, self.route, execute_at,
-                deps).begin(self.result.settle)
+        self.adapter.execute(self.node, self.txn_id, self.txn, self.route,
+                             execute_at, deps).begin(self.result.settle)
 
     def _fail(self, exc: BaseException) -> None:
         if not self.done:

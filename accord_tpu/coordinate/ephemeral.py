@@ -115,16 +115,10 @@ class _EphemeralRead(api.Callback):
                 self.execution_epoch), self)
 
     def _read_nodes(self) -> Set[int]:
-        chosen: Set[int] = set()
-        for t in self.read_tracker.trackers:
-            shard = t.shard
-            if any(n in chosen for n in shard.nodes):
-                continue
-            if self.node.node_id in shard.nodes:
-                chosen.add(self.node.node_id)
-            else:
-                chosen.add(shard.nodes[0])
-        return chosen
+        from ..impl.sorter import pick_read_nodes
+        return pick_read_nodes(
+            self.node, self.read_tracker.trackers,
+            self.topologies.for_epoch(self.execution_epoch))
 
     def _read_failed(self, from_id: int) -> None:
         status, to_contact = self.read_tracker.record_read_failure(from_id)

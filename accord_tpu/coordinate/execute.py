@@ -66,19 +66,21 @@ class _ExecuteTxn(api.Callback):
 
     def _read_nodes(self) -> Set[int]:
         """One replica per execution shard, preferring ourselves then the
-        first live candidate (ref: ReadTracker initial contact)."""
-        chosen: Set[int] = set()
-        for t in self.read_tracker.trackers:
-            shard = t.shard
-            if any(n in chosen for n in shard.nodes):
-                continue
-            if self.node.node_id in shard.nodes:
-                chosen.add(self.node.node_id)
-            else:
-                chosen.add(shard.nodes[0])
-        return chosen
+        widest-covering replica (ref: ReadTracker initial contact via
+        SizeOfIntersectionSorter)."""
+        from ..impl.sorter import pick_read_nodes
+        return pick_read_nodes(
+            self.node, self.read_tracker.trackers,
+            self.all_topologies.for_epoch(self.execute_at.epoch()))
 
     def _start(self) -> async_chain.AsyncChain:
+        from ..utils import faults
+        if faults.TRANSACTION_INSTABILITY:
+            # FAULT INJECTION (ref: Faults.TRANSACTION_INSTABILITY consumed
+            # at CoordinationAdapter.java:173): deliberately skip ensuring
+            # stability before execution so the burn proves it would catch
+            # the resulting recovery hazard
+            self.stable_done = True
         if not self.read_done:
             self.read_nodes = self._read_nodes()
         for n in self.read_nodes:

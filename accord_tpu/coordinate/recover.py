@@ -39,8 +39,7 @@ from ..primitives.writes import ProgressToken
 from ..local.status import Status, recovery_rank
 from ..utils import async_chain
 from .errors import Preempted, Timeout, Truncated
-from .execute import execute
-from .propose import propose
+from .adapter import Adapters
 from .tracking import QuorumTracker, RecoveryTracker, RequestStatus
 
 
@@ -218,13 +217,13 @@ class Recover(api.Callback):
             if status in (Status.Stable, Status.Committed, Status.PreCommitted):
                 deps = _merge_committed_deps(self.oks)
                 node.with_epoch(max_ok.execute_at.epoch(), lambda: (
-                    execute(node, txn_id, self.txn, self.route,
+                    Adapters.recovery.execute(node, txn_id, self.txn, self.route,
                             max_ok.execute_at, deps, ballot=self.ballot)
                     .begin(self._executed)))
                 return
             if status is Status.Accepted:
                 deps = _merge_proposal_deps(self.oks)
-                propose(node, self.ballot, txn_id, self.txn, self.route,
+                Adapters.recovery.propose(node, self.ballot, txn_id, self.txn, self.route,
                         max_ok.execute_at, deps).begin(self._proposed)
                 return
             if status is Status.AcceptedInvalidate:
@@ -252,7 +251,7 @@ class Recover(api.Callback):
             return
 
         deps = _merge_proposal_deps(self.oks)
-        propose(node, self.ballot, txn_id, self.txn, self.route, txn_id,
+        Adapters.recovery.propose(node, self.ballot, txn_id, self.txn, self.route, txn_id,
                 deps).begin(self._proposed)
 
     # -- continuations -------------------------------------------------------
@@ -268,7 +267,7 @@ class Recover(api.Callback):
             return
         execute_at, deps = value
         self.node.with_epoch(execute_at.epoch(), lambda: (
-            execute(self.node, self.txn_id, self.txn, self.route, execute_at,
+            Adapters.recovery.execute(self.node, self.txn_id, self.txn, self.route, execute_at,
                     deps, ballot=self.ballot).begin(self._executed)))
 
     def _executed(self, value, failure) -> None:

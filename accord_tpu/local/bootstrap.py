@@ -115,6 +115,7 @@ class Bootstrap:
         owns the ranges, so it can never serve — while recent owners both
         witness the fence and hold the data (their own bootstraps completed
         or they Nack via the unavailable-for-read gate and we move on)."""
+        from ..impl.sorter import SizeOfIntersectionSorter
         manager = self.node.topology()
         donors: List[int] = []
         newest = max(self.epoch, self.node.epoch())
@@ -122,10 +123,14 @@ class Bootstrap:
             if epoch < 1 or not manager.has_epoch(epoch):
                 continue
             prev = manager.get_topology_for_epoch(epoch)
-            for shard in prev.for_selection(self.ranges):
-                for n in shard.nodes:
-                    if n != self.node.node_id and n not in donors:
-                        donors.append(n)
+            epoch_donors = {n for shard in prev.for_selection(self.ranges)
+                            for n in shard.nodes if n != self.node.node_id}
+            # within an epoch, widest-covering donors first: one snapshot
+            # fetch can then cover the whole request
+            for n in SizeOfIntersectionSorter.preferred(prev, epoch_donors,
+                                                        self.ranges):
+                if n not in donors:
+                    donors.append(n)
         return donors
 
     def _fetch(self, donors: List[int], remaining: Ranges, fence,

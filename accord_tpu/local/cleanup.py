@@ -146,7 +146,7 @@ def cleanup_store(safe: "SafeCommandStore") -> int:
 
 def _release_indexes(store, cmd) -> None:
     txn_id = cmd.txn_id
-    store.range_commands.pop(txn_id, None)
+    store.drop_range_command(txn_id)
     if store.device is not None:
         store.device.free(txn_id)
     if cmd.partial_txn is not None and not isinstance(cmd.partial_txn.keys,

@@ -119,11 +119,18 @@ class CommandStore:
         self.commands: Dict[TxnId, Command] = {}
         self.commands_for_key: Dict[int, CommandsForKey] = {}
         # Range-domain txns indexed for the range scan path
-        # (ref: InMemoryCommandStore.rangeCommands TreeMap scan :524)
+        # (ref: InMemoryCommandStore.rangeCommands TreeMap scan :524).
+        # Mutate ONLY via put_range_command/drop_range_command: the interval
+        # index below is rebuilt lazily on version change.
         self.range_commands: Dict[TxnId, Ranges] = {}
+        self._range_index = None
+        self._range_index_version = -1
+        self._range_version = 0
         self.max_conflicts = MaxConflicts()
         self.redundant_before = RedundantBefore()
         self.durable_before = DurableBefore()
+        from ..impl.timestamps_for_key import TimestampsForKeys
+        self.timestamps_for_key = TimestampsForKeys()
         # ranges adopted this epoch whose snapshot has not yet arrived —
         # reads are Nacked until clear (ref: safeToRead,
         # local/CommandStore.java:159-176), and writes landing on them are
@@ -198,6 +205,31 @@ class CommandStore:
             except BaseException as e:  # noqa: BLE001
                 self.node.agent.on_uncaught_exception(e)
         self._draining = False
+
+    # -- range-txn interval index -------------------------------------------
+    def put_range_command(self, txn_id: TxnId, ranges: Ranges) -> None:
+        if self.range_commands.get(txn_id) == ranges:
+            return   # re-registration on a status message: index unchanged
+        self.range_commands[txn_id] = ranges
+        self._range_version += 1
+
+    def drop_range_command(self, txn_id: TxnId) -> None:
+        if self.range_commands.pop(txn_id, None) is not None:
+            self._range_version += 1
+
+    def range_index(self):
+        """Checkpointed interval index over the range-domain txns — the
+        CINTIA stabbing structure (ref: utils/SearchableRangeList.java:19-48),
+        rebuilt lazily after mutations (range txns mutate rarely — epoch
+        fences and durability rounds — while the PreAccept scan stabs it on
+        every keyed dep computation)."""
+        if self._range_index_version != self._range_version:
+            from ..utils.interval_index import SearchableRangeList
+            self._range_index = SearchableRangeList(
+                (r.start, r.end, tid)
+                for tid, rs in self.range_commands.items() for r in rs)
+            self._range_index_version = self._range_version
+        return self._range_index
 
     # -- state helpers ------------------------------------------------------
     def cfk(self, token: int) -> CommandsForKey:
@@ -349,29 +381,30 @@ class SafeCommandStore:
                                                       witnesses, fn, acc)
         return acc
 
+    def _range_txn_live(self, tid: TxnId, started_before, witnesses) -> bool:
+        if tid >= started_before or not witnesses.test(tid.kind()):
+            return False
+        cmd = self.store.commands.get(tid)
+        return cmd is None or not cmd.is_invalidated()
+
     def _scan_range_commands_token(self, token: int, started_before, witnesses,
                                    fn, acc):
-        for tid, ranges in self.store.range_commands.items():
-            if tid >= started_before or not witnesses.test(tid.kind()):
-                continue
-            cmd = self.store.commands.get(tid)
-            if cmd is not None and cmd.is_invalidated():
-                continue
-            if ranges.contains_token(token):
+        for _s, _e, tid in self.store.range_index().stabbing(token):
+            if self._range_txn_live(tid, started_before, witnesses):
                 acc = fn(Ranges.of(Range(token, token + 1)), tid, acc)
         return acc
 
     def _scan_range_commands_ranges(self, scan: Ranges, started_before,
                                     witnesses, fn, acc):
-        for tid, ranges in self.store.range_commands.items():
-            if tid >= started_before or not witnesses.test(tid.kind()):
-                continue
-            cmd = self.store.commands.get(tid)
-            if cmd is not None and cmd.is_invalidated():
-                continue
-            inter = ranges.intersecting(scan)
-            if not inter.is_empty():
-                acc = fn(inter, tid, acc)
+        index = self.store.range_index()
+        per_tid: Dict[TxnId, List[Range]] = {}
+        for sel in scan:
+            for s, e, tid in index.overlapping(sel.start, sel.end):
+                per_tid.setdefault(tid, []).append(
+                    Range(max(s, sel.start), min(e, sel.end)))
+        for tid in sorted(per_tid):
+            if self._range_txn_live(tid, started_before, witnesses):
+                acc = fn(Ranges.of(*per_tid[tid]), tid, acc)
         return acc
 
     def map_reduce_full(self, keys_or_ranges, test_txn_id: TxnId,

@@ -149,8 +149,8 @@ def _register_txn(safe: SafeCommandStore, txn_id: TxnId,
         return
     if isinstance(keys, Ranges):
         existing = safe.store.range_commands.get(txn_id)
-        safe.store.range_commands[txn_id] = (keys if existing is None
-                                             else existing.with_(keys))
+        safe.store.put_range_command(txn_id, keys if existing is None
+                                     else existing.with_(keys))
     else:
         for key in keys:
             safe.cfk(key.token()).update(
@@ -363,7 +363,7 @@ def commit_invalidate(safe: SafeCommandStore, txn_id: TxnId) -> None:
     safe.update(new_cmd)
     safe.notify_listeners(new_cmd)
     _update_cfk_status(safe, new_cmd, InternalStatus.INVALIDATED)
-    safe.store.range_commands.pop(txn_id, None)
+    safe.store.drop_range_command(txn_id)
     safe.progress_log().clear(txn_id)
 
 
@@ -608,6 +608,11 @@ def post_apply(safe: SafeCommandStore, txn_id: TxnId) -> None:
     new_cmd = cmd.updated(save_status=SaveStatus.Applied)
     safe.update(new_cmd)
     _update_cfk_status(safe, new_cmd, InternalStatus.APPLIED, new_cmd.execute_at)
+    if new_cmd.partial_txn is not None and new_cmd.execute_at is not None \
+            and not isinstance(new_cmd.partial_txn.keys, Ranges):
+        for key in new_cmd.partial_txn.keys:
+            safe.store.timestamps_for_key.get(key.token()).on_executed(
+                safe, txn_id, new_cmd.execute_at)
     safe.notify_listeners(new_cmd)
     safe.notify_transient(new_cmd)
     safe.progress_log().durable_local(safe, txn_id)

@@ -409,8 +409,8 @@ class Journal:
         if isinstance(keys, Ranges):
             if status is not InternalStatus.INVALIDATED:
                 existing = store.range_commands.get(txn_id)
-                store.range_commands[txn_id] = (keys if existing is None
-                                                else existing.with_(keys))
+                store.put_range_command(txn_id, keys if existing is None
+                                        else existing.with_(keys))
         else:
             from .commands import _per_key_deps
             for key in keys:

@@ -264,6 +264,9 @@ class Cluster:
         # test hook (ref: test NetworkFilter): return True to drop a request
         self.message_filter: Optional[Callable[[int, int, object], bool]] = None
         self.stats: Dict[str, int] = {}
+        # structured event trace (ref: accord.impl.basic.Trace); off unless
+        # a Trace instance is attached
+        self.trace = None
         # per-node durability scheduling, driven by explicit ticks (sim) —
         # (ref: CoordinateDurabilityScheduling wired in test Cluster.java)
         self.durability: Dict[int, "object"] = {}
@@ -327,9 +330,14 @@ class Cluster:
     def route_request(self, src: int, dst: int, request, callback_id: int) -> None:
         self.stats[type(request).__name__] = self.stats.get(type(request).__name__, 0) + 1
         action = self._action(src, dst)
-        if action is Action.DROP:
-            return
-        if self.message_filter is not None and self.message_filter(src, dst, request):
+        filtered = (action is not Action.DROP and self.message_filter is not None
+                    and self.message_filter(src, dst, request))
+        if self.trace is not None:
+            delivered = action is Action.DELIVER and not filtered
+            self.trace.record(self.queue.now,
+                              "SEND" if delivered else "DROP",
+                              src, dst, repr(request))
+        if action is Action.DROP or filtered:
             return
         ctx = _ReplyContext(src, callback_id)
         self.queue.add(self._deliver_at(src, dst),
@@ -337,7 +345,12 @@ class Cluster:
 
     def route_reply(self, src: int, dst: int, ctx: _ReplyContext, reply) -> None:
         self.stats[type(reply).__name__] = self.stats.get(type(reply).__name__, 0) + 1
-        if self._action(src, dst) is Action.DROP:
+        action = self._action(src, dst)
+        if self.trace is not None:
+            self.trace.record(self.queue.now,
+                              "REPLY" if action is Action.DELIVER
+                              else "DROP_REPLY", src, dst, repr(reply))
+        if action is Action.DROP:
             return
         self.queue.add(self._deliver_at(src, dst),
                        lambda: self.sinks[dst].deliver_reply(src, ctx, reply))
@@ -399,6 +412,8 @@ class Cluster:
         old.alive = False
         old_sink = self.sinks[nid]
         old_sink.dead = True
+        if self.trace is not None:
+            self.trace.record(self.queue.now, "RESTART", nid, nid, "")
         sink = NodeSink(nid, self)
         # continue the callback numbering: a late reply addressed to a dead
         # incarnation's callback id must never resolve to a fresh callback

@@ -1,0 +1,12 @@
+"""Static fault-injection flags.
+
+Rebuild of ref: accord-core/src/main/java/accord/utils/Faults.java:22-28 —
+compile-time-style switches that deliberately weaken a protocol guarantee so
+the verification harness can prove it would catch the resulting violation.
+All default off; tests flip them in a try/finally."""
+
+from __future__ import annotations
+
+# Skip ensuring stability (deps durable at a quorum) before execution
+# (ref: Faults.TRANSACTION_INSTABILITY consumed at CoordinationAdapter.java:173)
+TRANSACTION_INSTABILITY = False

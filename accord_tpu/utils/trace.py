@@ -1,0 +1,42 @@
+"""Structured event tracing for the simulator.
+
+Rebuild of ref: the dedicated trace logger accord.impl.basic.Trace
+(accord-core/src/test/java/accord/impl/basic/Cluster.java:104,179-245) —
+every simulated send / reply / drop / restart is recorded with a logical
+clock, so a failing seed's message flow can be replayed and diffed without
+parsing logs.  Off by default (zero overhead beyond one None check)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class Trace:
+    """Bounded in-memory event trace with a logical clock."""
+
+    def __init__(self, capacity: int = 200_000):
+        self.capacity = capacity
+        self.events: List[Tuple[int, int, str, int, int, str]] = []
+        self._clock = itertools.count()
+        self.dropped = 0
+
+    def record(self, sim_now: int, kind: str, src: int, dst: int,
+               what: str) -> None:
+        if len(self.events) >= self.capacity:
+            self.dropped += 1
+            return
+        self.events.append((next(self._clock), sim_now, kind, src, dst, what))
+
+    # -- queries -------------------------------------------------------------
+    def for_txn(self, needle: str) -> List[Tuple[int, int, str, int, int, str]]:
+        return [e for e in self.events if needle in e[5]]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for _lc, _t, kind, _s, _d, _w in self.events:
+            out[kind] = out.get(kind, 0) + 1
+        return out
+
+    def __len__(self) -> int:
+        return len(self.events)

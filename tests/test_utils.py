@@ -102,3 +102,29 @@ def test_random_zipf_skews():
     assert all(0 <= d < 100 for d in draws)
     low = sum(1 for d in draws if d < 10)
     assert low > len(draws) * 0.4  # heavily skewed to small indices
+
+
+def test_searchable_range_list_matches_bruteforce():
+    """CINTIA index vs brute force on random interval sets
+    (ref: utils/SearchableRangeListTest)."""
+    import random
+    from accord_tpu.utils.interval_index import SearchableRangeList
+    rng = random.Random(7)
+    for trial in range(30):
+        n = rng.randint(0, 60)
+        entries = []
+        for i in range(n):
+            s = rng.randint(0, 500)
+            e = s + rng.randint(1, 80)
+            entries.append((s, e, f"p{i}"))
+        idx = SearchableRangeList(entries)
+        for _ in range(40):
+            t = rng.randint(-10, 600)
+            got = sorted(p for _s, _e, p in idx.stabbing(t))
+            want = sorted(p for s, e, p in entries if s <= t < e)
+            assert got == want, (trial, t, got, want)
+            lo = rng.randint(-10, 600)
+            hi = lo + rng.randint(1, 120)
+            got = sorted(p for _s, _e, p in idx.overlapping(lo, hi))
+            want = sorted(p for s, e, p in entries if s < hi and e > lo)
+            assert got == want, (trial, lo, hi, got, want)
