@@ -67,7 +67,7 @@ def _try_existing(node, ranges: Ranges) -> Optional[TxnId]:
             if not covered.contains_all_ranges(ranges.intersecting(
                     store.owned_current())):
                 continue
-            cmd = store.commands.get(tid)
+            cmd = store.command_maybe_paged(tid)
             if cmd is not None and cmd.is_applied():
                 local.add(tid)
         candidates = local if candidates is None else candidates & local

@@ -29,6 +29,7 @@ from ..utils.interval_map import ReducingRangeMap
 from .command import Command
 from .commands_for_key import CommandsForKey, InternalStatus
 from .redundant import DurableBefore, MaxConflicts, RedundantBefore
+from .status import SaveStatus
 
 
 class PreLoadContext:
@@ -112,9 +113,13 @@ class RangesForEpoch:
 class CommandStore:
     """One single-threaded metadata shard (ref: local/CommandStore.java:80)."""
 
-    def __init__(self, store_id: int, node):
+    def __init__(self, store_id: int, node, paged_limit: Optional[int] = None):
         self.store_id = store_id
         self.node = node                      # local.node.Node
+        # paged mode (ref: the cache-limited DelayedCommandStores): above
+        # this many command records, terminal commands are paged out to the
+        # journal and reloaded on demand via PreLoadContext / page_in
+        self.paged_limit = paged_limit
         self.ranges_for_epoch = RangesForEpoch()
         self.commands: Dict[TxnId, Command] = {}
         self.commands_for_key: Dict[int, CommandsForKey] = {}
@@ -167,6 +172,11 @@ class CommandStore:
         out: async_chain.AsyncResult = async_chain.AsyncResult()
 
         def task():
+            # honor the PreLoadContext contract (ref: PreLoadContext.java:42):
+            # everything the task declared is in memory before it runs.  With
+            # the journal as backing store the load is synchronous; a disk
+            # journal would await the reads here before scheduling fn.
+            self._load_context(context)
             safe = SafeCommandStore(self, context)
             try:
                 result = fn(safe)
@@ -205,6 +215,51 @@ class CommandStore:
             except BaseException as e:  # noqa: BLE001
                 self.node.agent.on_uncaught_exception(e)
         self._draining = False
+        if self.paged_limit is not None:
+            self._maybe_page_out()
+
+    # -- journal-backed paging ----------------------------------------------
+    def _load_context(self, context: PreLoadContext) -> None:
+        if self.paged_limit is None:
+            return   # nothing is ever paged out: every lookup would miss
+        for txn_id in (context.primary_txn_id, *context.additional_txn_ids):
+            if txn_id is not None and txn_id not in self.commands:
+                self.page_in(txn_id)
+
+    def page_in(self, txn_id: TxnId):
+        """Reload a paged-out (terminal) command from the journal.  Returns
+        the installed Command or None if the journal has no record (never
+        witnessed, or erased — the watermarks answer for those)."""
+        journal = self.node.journal
+        if journal is None:
+            return None
+        cmd = journal.reconstruct(self, txn_id)
+        if cmd is None or not (cmd.save_status is SaveStatus.Applied
+                               or cmd.is_truncated() or cmd.is_invalidated()):
+            return None   # only terminal commands are ever paged out
+        self.commands[txn_id] = cmd
+        return cmd
+
+    def _maybe_page_out(self) -> None:
+        """Evict terminal commands beyond the page limit; the journal
+        retains their registers + bodies for page_in.  Listener sets on
+        terminal commands are dead (notifications fire on transitions, and
+        terminal commands have none left)."""
+        excess = len(self.commands) - self.paged_limit
+        if excess <= 0:
+            return
+        journal = self.node.journal
+        if journal is None:
+            return
+        regs = set(journal.registered_txns(self.store_id))
+        evictable = [tid for tid, cmd in self.commands.items()
+                     if (cmd.save_status is SaveStatus.Applied
+                         or cmd.is_truncated() or cmd.is_invalidated())
+                     and tid in regs]
+        evictable.sort()
+        for tid in evictable[:excess]:
+            del self.commands[tid]
+            self.transient_listeners.pop(tid, None)
 
     # -- range-txn interval index -------------------------------------------
     def put_range_command(self, txn_id: TxnId, ranges: Ranges) -> None:
@@ -240,6 +295,14 @@ class CommandStore:
 
     def command_if_present(self, txn_id: TxnId) -> Optional[Command]:
         return self.commands.get(txn_id)
+
+    def command_maybe_paged(self, txn_id: TxnId) -> Optional[Command]:
+        """Command record, reloading a paged-out terminal one if needed —
+        for readers that bypass SafeCommandStore (scans, barriers)."""
+        cmd = self.commands.get(txn_id)
+        if cmd is None and self.paged_limit is not None:
+            cmd = self.page_in(txn_id)
+        return cmd
 
     # -- exclusive sync point fencing (ref: CommandStore.rejectBefore) ------
     def mark_reject_before(self, ranges: Ranges, txn_id: TxnId) -> None:
@@ -288,15 +351,21 @@ class SafeCommandStore:
     # -- command access -----------------------------------------------------
     def get(self, txn_id: TxnId) -> Command:
         """Get or create the command record (ref: SafeCommandStore.get with
-        truncation-on-read via RedundantBefore, :79-189)."""
+        truncation-on-read via RedundantBefore, :79-189).  A paged-out
+        terminal command reloads from the journal first."""
         cmd = self.store.commands.get(txn_id)
+        if cmd is None and self.store.paged_limit is not None:
+            cmd = self.store.page_in(txn_id)
         if cmd is None:
             cmd = Command(txn_id)
             self.store.commands[txn_id] = cmd
         return cmd
 
     def if_present(self, txn_id: TxnId) -> Optional[Command]:
-        return self.store.commands.get(txn_id)
+        cmd = self.store.commands.get(txn_id)
+        if cmd is None and self.store.paged_limit is not None:
+            cmd = self.store.page_in(txn_id)
+        return cmd
 
     def update(self, command: Command, notify: bool = True) -> Command:
         """Install a new version of the command; queues listener
@@ -384,7 +453,7 @@ class SafeCommandStore:
     def _range_txn_live(self, tid: TxnId, started_before, witnesses) -> bool:
         if tid >= started_before or not witnesses.test(tid.kind()):
             return False
-        cmd = self.store.commands.get(tid)
+        cmd = self.store.command_maybe_paged(tid)
         return cmd is None or not cmd.is_invalidated()
 
     def _scan_range_commands_token(self, token: int, started_before, witnesses,
@@ -422,7 +491,7 @@ class SafeCommandStore:
                                               lambda info, a, t=token: fn(t, info, a), acc)
             for tid, ranges in self.store.range_commands.items():
                 if witnesses.test(tid.kind()) and not ranges.intersecting(scan_ranges).is_empty():
-                    cmd = self.store.commands.get(tid)
+                    cmd = self.store.command_maybe_paged(tid)
                     info = _range_txn_info(tid, cmd)
                     if info is not None:
                         acc = fn(ranges[0].start, info, acc)
@@ -436,7 +505,7 @@ class SafeCommandStore:
                                               lambda info, a, t=token: fn(t, info, a), acc)
                 for tid, ranges in self.store.range_commands.items():
                     if witnesses.test(tid.kind()) and ranges.contains_token(token):
-                        cmd = self.store.commands.get(tid)
+                        cmd = self.store.command_maybe_paged(tid)
                         info = _range_txn_info(tid, cmd)
                         if info is not None:
                             acc = fn(token, info, acc)
@@ -540,7 +609,9 @@ class CommandStores:
         first = not self.stores
         if first:
             for _ in range(self.num_stores):
-                store = CommandStore(self._next_id, self.node)
+                store = CommandStore(self._next_id, self.node,
+                                     paged_limit=getattr(self.node,
+                                                         "paged_limit", None))
                 self._next_id += 1
                 self.stores.append(store)
             for store, chunk in zip(self.stores,

@@ -321,6 +321,9 @@ class DeviceState:
         self.deps = _DepsMirror()
         self.drain = _DrainMirror()
         self._tick_scheduled = False
+        # learned compaction width for batched queries (sticky across
+        # batches; see deps_query_batch)
+        self._batch_k = 64
         # counters surfaced through sim stats / bench
         self.n_queries = 0
         self.n_ticks = 0
@@ -461,30 +464,57 @@ class DeviceState:
         if not queries:
             return (np.zeros(1, np.int64), np.zeros(0, np.int64),
                     np.zeros(0, np.int64), np.zeros(0, np.int32))
+        return self.deps_query_batch_end(self.deps_query_batch_begin(queries))
+
+    def deps_query_batch_begin(self, queries):
+        """Dispatch a batched deps scan WITHOUT waiting: one fused query
+        upload + kernel enqueue; returns an opaque handle for
+        deps_query_batch_end.  Callers overlap the next batch's dispatch
+        with the previous batch's result download (double-buffering) — on a
+        tunneled accelerator the round trips dominate the kernel by ~1000x,
+        so the pipeline nearly doubles sustained throughput."""
         q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
         packed = [(sb, wit, toks, rngs, tid)
                   for (tid, sb, wit, toks, rngs) in queries]
         table = self.deps.device_table()
-        query = dk.build_query(packed, q_m)
         n = table.capacity
-        k = min(256, n)   # lax.top_k requires k <= the row width
-        idx, counts, _ = dk.calculate_deps_indices(table, query, k)
-        counts = np.asarray(counts)
-        if counts.max(initial=0) > k:
-            # a dense row overflowed the compact path: fall back to the
-            # bit-packed full mask
+        qmat = jnp.asarray(dk.pack_query_matrix(packed, q_m))  # ONE upload
+        # adaptive + STICKY compaction width: per-query dep sets are
+        # O(active), so a small k gives an 8x smaller download; an overflow
+        # escalates (counts ride in the same download, so detection is free)
+        # and the learned k persists so steady state stays one round trip
+        k = min(self._batch_k, n)
+        out_dev = dk.calculate_deps_indices_fused(table, qmat, q_m, k)
+        return (out_dev, table, qmat, packed, q_m, k, n, len(queries))
+
+    def deps_query_batch_end(self, handle):
+        """Collect a dispatched batch: ONE download (plus a re-run when the
+        learned compaction width overflowed).  The re-run and fallback use
+        the table snapshot captured at begin — registrations interleaved
+        between begin and end must not shift the queried snapshot (nor
+        desync the capacity the bit-unpack count is sized to)."""
+        out_dev, table, qmat, packed, q_m, k, n, n_queries = handle
+        out = np.asarray(out_dev)
+        if out[:, 0].max(initial=0) > k and n > k:
+            k = min(_pow2_at_least(int(out[:, 0].max())), n)
+            self._batch_k = k
+            out = np.asarray(dk.calculate_deps_indices_fused(table, qmat,
+                                                             q_m, k))
+        if out[:, 0].max(initial=0) > k:
+            # still overflowing a huge row: bit-packed full mask fallback
+            query = dk.build_query(packed, q_m)
             packed_mask, _ = dk.calculate_deps_packed(table, query)
             mask = np.unpackbits(np.asarray(packed_mask), axis=1,
                                  count=n).astype(bool)
             b_idx, j_idx = np.nonzero(mask)
         else:
-            rows = np.asarray(idx)
+            rows = out[:, 1:]
             b_idx, kk = np.nonzero(rows >= 0)
             j_idx = rows[b_idx, kk]
-        self.n_queries += len(queries)
+        self.n_queries += n_queries
         self.n_kernel_deps += len(j_idx)
-        counts = np.bincount(b_idx, minlength=len(queries))
-        row_ptr = np.zeros(len(queries) + 1, np.int64)
+        counts = np.bincount(b_idx, minlength=n_queries)
+        row_ptr = np.zeros(n_queries + 1, np.int64)
         np.cumsum(counts, out=row_ptr[1:])
         m = self.deps
         return (row_ptr, m.msb[j_idx], m.lsb[j_idx], m.node[j_idx])

@@ -50,8 +50,11 @@ class Node:
                  num_stores: int = 2,
                  local_config: Optional[api.LocalConfig] = None,
                  device_mode: Optional[bool] = None,
-                 journal=None):
+                 journal=None,
+                 paged_limit: Optional[int] = None):
         self.node_id = node_id
+        # journal-backed command paging threshold (None = keep everything)
+        self.paged_limit = paged_limit
         self.message_sink = message_sink
         self.config_service = config_service
         self.scheduler = scheduler

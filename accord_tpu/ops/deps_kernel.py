@@ -178,6 +178,63 @@ def calculate_deps_indices(table: DepsTable, query: DepsQuery, k: int):
     return idx, counts, max_conflict
 
 
+@partial(jax.jit, static_argnums=(2, 3))
+def calculate_deps_indices_fused(table: DepsTable, qmat: jnp.ndarray,
+                                 m: int, k: int) -> jnp.ndarray:
+    """The batched query with ONE upload and ONE download: ``qmat`` packs a
+    whole DepsQuery as int64[B, 7+2m] columns (msb, lsb, node, wmask,
+    self_msb, self_lsb, self_node, lo[m], hi[m]); the result fuses counts
+    and slot indices as int32[B, 1+k] (counts in column 0, -1-padded
+    ascending indices after).  On a tunneled accelerator the round trips,
+    not the kernel, dominate: the 9-array query upload and the
+    idx/counts/max_conflict downloads each cost a full RTT."""
+    query = DepsQuery(
+        qmat[:, 0], qmat[:, 1], qmat[:, 2].astype(jnp.int32),
+        qmat[:, 3].astype(jnp.int32),
+        qmat[:, 7:7 + m], qmat[:, 7 + m:7 + 2 * m],
+        qmat[:, 4], qmat[:, 5], qmat[:, 6].astype(jnp.int32))
+    dep_mask, _mc = calculate_deps(table, query)
+    n = dep_mask.shape[1]
+    col = jnp.arange(n, dtype=jnp.int32)
+    scores = jnp.where(dep_mask, n - col, 0)
+    top, _ = jax.lax.top_k(scores, k)
+    idx = jnp.where(top > 0, n - top, -1)
+    counts = jnp.sum(dep_mask, axis=1, dtype=jnp.int32)
+    return jnp.concatenate([counts[:, None], idx], axis=1)
+
+
+def pack_query_matrix(queries: Sequence[tuple], max_intervals: int) -> np.ndarray:
+    """Host packer for calculate_deps_indices_fused: one int64 matrix instead
+    of nine arrays (single device upload).  queries as in build_query."""
+    b = len(queries)
+    m = max_intervals
+    q = np.empty((b, 7 + 2 * m), np.int64)
+    q[:, 7:7 + m] = PAD_LO
+    q[:, 7 + m:] = PAD_HI
+    for i, item in enumerate(queries):
+        (bound, witnesses, toks, rngs), self_id = \
+            item[:4], (item[4] if len(item) > 4 else item[0])
+        q[i, 0] = to_i64(bound.msb)
+        q[i, 1] = to_i64(bound.lsb)
+        q[i, 2] = bound.node
+        q[i, 3] = witnesses.mask()
+        q[i, 4] = to_i64(self_id.msb)
+        q[i, 5] = to_i64(self_id.lsb)
+        q[i, 6] = self_id.node
+        if len(toks) + len(rngs) > m:
+            raise ValueError(f"txn touches > {m} intervals")
+        j = 0
+        for t in toks:
+            q[i, 7 + j] = t
+            q[i, 7 + m + j] = t
+            j += 1
+        for r in rngs:
+            q[i, 7 + j] = r.start
+            q[i, 7 + m + j] = r.end - 1
+            j += 1
+    return q
+
+
 @jax.jit
 def calculate_deps_packed(table: DepsTable, query: DepsQuery,
                           prune_msb: jnp.ndarray = None,

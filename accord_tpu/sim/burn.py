@@ -61,7 +61,10 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     rs = RandomSource(seed)
     topology = build_topology(1, node_ids, rf, shards)
     cluster = Cluster(topology=topology, seed=rs.next_int(1 << 30),
-                      data_store_factory=KVDataStore)
+                      data_store_factory=KVDataStore,
+                      # journal-backed paging: terminal commands beyond this
+                      # per-store count page out and reload on demand
+                      paged_limit=150)
     verifier = StrictSerializabilityVerifier()
     result = BurnResult()
     wl = rs.fork()           # workload randomness

@@ -236,9 +236,11 @@ class Cluster:
                  progress_log_factory=None,
                  mean_latency_micros: int = 1_000,
                  request_timeout_micros: int = 1_000_000,
-                 device_mode: Optional[bool] = None):
+                 device_mode: Optional[bool] = None,
+                 paged_limit: Optional[int] = None):
         node_ids = list(node_ids if node_ids is not None else topology.nodes())
         self._device_mode = device_mode
+        self._paged_limit = paged_limit
         self.random = RandomSource(seed)
         self.queue = PendingQueue()
         self.topologies: List[Topology] = [topology] if topology else []
@@ -290,7 +292,7 @@ class Cluster:
                 now_micros=lambda nid=nid: self.node_now(nid),
                 progress_log_factory=progress_log_factory,
                 num_stores=num_stores, device_mode=device_mode,
-                journal=self.journals[nid])
+                journal=self.journals[nid], paged_limit=paged_limit)
             self.nodes[nid] = node
             from ..impl.durability_scheduling import DurabilityScheduling
             self.durability[nid] = DurabilityScheduling(node)
@@ -389,7 +391,8 @@ class Cluster:
                     progress_log_factory=self._progress_log_factory,
                     num_stores=self._num_stores,
                     device_mode=self._device_mode,
-                    journal=self.journals[nid])
+                    journal=self.journals[nid],
+                    paged_limit=self._paged_limit)
         self.nodes[nid] = node
         from ..impl.durability_scheduling import DurabilityScheduling
         self.durability[nid] = DurabilityScheduling(node)
@@ -430,7 +433,8 @@ class Cluster:
                     progress_log_factory=self._progress_log_factory,
                     num_stores=self._num_stores,
                     device_mode=self._device_mode,
-                    journal=self.journals[nid])       # durable
+                    journal=self.journals[nid],
+                    paged_limit=self._paged_limit)       # durable
         self.nodes[nid] = node
         from ..impl.durability_scheduling import DurabilityScheduling
         self.durability[nid] = DurabilityScheduling(node)
