@@ -118,6 +118,17 @@ def cleanup_store(safe: "SafeCommandStore") -> int:
         journal.record_watermarks(store.store_id,
                                   store.durable_before.entries(),
                                   store.redundant_before.redundant_entries())
+    if store.paged_limit is not None and journal is not None:
+        # paged-out commands must not escape erasure (their journal
+        # registers/bodies and device slots would grow forever): page the
+        # erasure-eligible ones — below the universal watermark — back in
+        # so the sweep below retires them, dropping their registers too
+        owned = store.ranges_for_epoch.all()
+        if not owned.is_empty():
+            floor = store.durable_before.min_universal_before(owned)
+            for tid in journal.registered_txns(store.store_id):
+                if tid < floor and tid not in store.commands:
+                    store.page_in(tid)
     released = 0
     for txn_id in list(store.commands.keys()):
         cmd = store.commands.get(txn_id)

@@ -244,22 +244,31 @@ class CommandStore:
         """Evict terminal commands beyond the page limit; the journal
         retains their registers + bodies for page_in.  Listener sets on
         terminal commands are dead (notifications fire on transitions, and
-        terminal commands have none left)."""
+        terminal commands have none left).  A command is only evicted after
+        proving the journal round-trips it to the SAME terminal status —
+        paging must never degrade state (a degraded Stable without its
+        frontier would execute early on reload)."""
+        import heapq
         excess = len(self.commands) - self.paged_limit
         if excess <= 0:
             return
         journal = self.node.journal
         if journal is None:
             return
-        regs = set(journal.registered_txns(self.store_id))
-        evictable = [tid for tid, cmd in self.commands.items()
+        evictable = (tid for tid, cmd in self.commands.items()
                      if (cmd.save_status is SaveStatus.Applied
                          or cmd.is_truncated() or cmd.is_invalidated())
-                     and tid in regs]
-        evictable.sort()
-        for tid in evictable[:excess]:
+                     and journal.has_register(self.store_id, tid))
+        for tid in heapq.nsmallest(excess * 2, evictable):
+            if excess <= 0:
+                break
+            rc = journal.reconstruct(self, tid)
+            if rc is None or rc.save_status is not \
+                    self.commands[tid].save_status:
+                continue   # not faithfully reloadable: keep it in memory
             del self.commands[tid]
             self.transient_listeners.pop(tid, None)
+            excess -= 1
 
     # -- range-txn interval index -------------------------------------------
     def put_range_command(self, txn_id: TxnId, ranges: Ranges) -> None:
@@ -588,11 +597,15 @@ def _range_txn_info(tid: TxnId, cmd: Optional[Command]):
 class CommandStores:
     """The shard group for one node (ref: local/CommandStores.java:78)."""
 
-    def __init__(self, node, num_stores: int = 1):
+    def __init__(self, node, num_stores: int = 1, distributor=None):
+        from .shard_distributor import EvenSplit
         self.node = node
         self.num_stores = num_stores
         self.stores: List[CommandStore] = []
         self._next_id = 0
+        # pluggable range->store policy (ref: local/ShardDistributor.java)
+        self.distributor = distributor if distributor is not None \
+            else EvenSplit()
 
     # -- topology -----------------------------------------------------------
     def update_topology(self, topology, epoch: Optional[int] = None,
@@ -615,7 +628,7 @@ class CommandStores:
                 self._next_id += 1
                 self.stores.append(store)
             for store, chunk in zip(self.stores,
-                                    self._split(owned, len(self.stores))):
+                                    self.distributor.split(owned, len(self.stores))):
                 store.ranges_for_epoch.snapshot(epoch, chunk)
             return
 
@@ -623,36 +636,13 @@ class CommandStores:
         for store in self.stores:
             prev_union = prev_union.with_(store.ranges_for_epoch.current())
         net_new = owned.without(prev_union)
-        new_chunks = self._split(net_new, len(self.stores))
+        new_chunks = self.distributor.split(net_new, len(self.stores))
         for store, extra in zip(self.stores, new_chunks):
             retained = store.ranges_for_epoch.current().intersecting(owned)
             store.ranges_for_epoch.snapshot(epoch, retained.with_(extra))
             if not extra.is_empty() and bootstrap:
                 from .bootstrap import Bootstrap
                 Bootstrap(store, extra, epoch).start()
-
-    @staticmethod
-    def _split(ranges: Ranges, n: int) -> List[Ranges]:
-        if n == 1 or ranges.is_empty():
-            return [ranges] + [Ranges.empty()] * (n - 1)
-        total = sum(r.end - r.start for r in ranges)
-        per = max(1, total // n)
-        chunks: List[List[Range]] = [[] for _ in range(n)]
-        i, budget = 0, per
-        for r in ranges:
-            start = r.start
-            while start < r.end:
-                take = min(budget, r.end - start)
-                chunks[i].append(Range(start, start + take))
-                start += take
-                budget -= take
-                if budget == 0:
-                    if i < n - 1:
-                        i += 1
-                        budget = per
-                    else:
-                        budget = total  # remainder all lands in the last chunk
-        return [Ranges(c) for c in chunks]
 
     # -- scatter-gather -----------------------------------------------------
     def intersecting(self, select: Unseekables, min_epoch: int,

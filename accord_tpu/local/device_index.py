@@ -485,7 +485,12 @@ class DeviceState:
         # and the learned k persists so steady state stays one round trip
         k = min(self._batch_k, n)
         out_dev = dk.calculate_deps_indices_fused(table, qmat, q_m, k)
-        return (out_dev, table, qmat, packed, q_m, k, n, len(queries))
+        # snapshot the mirror's id columns: the mirror mutates in place, and
+        # a slot freed+reallocated between begin and end would otherwise
+        # resolve this batch's indices to the WRONG TxnId
+        ids = (self.deps.msb.copy(), self.deps.lsb.copy(),
+               self.deps.node.copy())
+        return (out_dev, table, ids, qmat, packed, q_m, k, n, len(queries))
 
     def deps_query_batch_end(self, handle):
         """Collect a dispatched batch: ONE download (plus a re-run when the
@@ -493,7 +498,7 @@ class DeviceState:
         the table snapshot captured at begin — registrations interleaved
         between begin and end must not shift the queried snapshot (nor
         desync the capacity the bit-unpack count is sized to)."""
-        out_dev, table, qmat, packed, q_m, k, n, n_queries = handle
+        out_dev, table, ids, qmat, packed, q_m, k, n, n_queries = handle
         out = np.asarray(out_dev)
         if out[:, 0].max(initial=0) > k and n > k:
             k = min(_pow2_at_least(int(out[:, 0].max())), n)
@@ -516,8 +521,8 @@ class DeviceState:
         counts = np.bincount(b_idx, minlength=n_queries)
         row_ptr = np.zeros(n_queries + 1, np.int64)
         np.cumsum(counts, out=row_ptr[1:])
-        m = self.deps
-        return (row_ptr, m.msb[j_idx], m.lsb[j_idx], m.node[j_idx])
+        msb, lsb, node = ids
+        return (row_ptr, msb[j_idx], lsb[j_idx], node[j_idx])
 
     # ------------------------------------------------------------------
     # the drain (device replacement of listener fan-out)

@@ -209,6 +209,18 @@ class Journal:
     def registered_txns(self, store_id: int):
         return sorted(self._registers.get(store_id, {}))
 
+    def has_register(self, store_id: int, txn_id: TxnId) -> bool:
+        return txn_id in self._registers.get(store_id, {})
+
+    def drop_register(self, store_id: int, txn_id: TxnId) -> None:
+        """Erase one store's register (and the bodies once no store retains
+        any) — the paged-out analogue of the Erased register drop."""
+        regs = self._registers.get(store_id)
+        if regs is not None:
+            regs.pop(txn_id, None)
+        if not any(txn_id in r for r in self._registers.values()):
+            self._bodies.pop(txn_id, None)
+
     def reconstruct(self, store, txn_id: TxnId) -> Optional[Command]:
         """Rebuild one command from registers + message bodies
         (ref: SerializerSupport.reconstruct).  WaitingOn is NOT built here —

@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import api, wire
 from ..coordinate.errors import Timeout
+from ..impl.config_service import AbstractConfigurationService
 from ..local.node import Node
 from ..primitives.keys import IntKey, Keys, Range, Ranges
 from ..primitives.txn import Txn
@@ -142,23 +143,14 @@ class MaelstromSink(api.MessageSink):
             p.callback.on_failure(from_id, RuntimeError(error))
 
 
-class StaticConfigService(api.ConfigurationService):
-    """Single static epoch (ref: maelstrom/SimpleConfigService.java)."""
+class StaticConfigService(AbstractConfigurationService):
+    """Single static epoch on the shared epoch-ledger base
+    (ref: maelstrom/SimpleConfigService.java over
+    impl/AbstractConfigurationService.java)."""
 
     def __init__(self, topology: Topology):
-        self.topology = topology
-
-    def register_listener(self, listener) -> None:
-        pass
-
-    def current_topology(self) -> Topology:
-        return self.topology
-
-    def get_topology_for_epoch(self, epoch: int) -> Optional[Topology]:
-        return self.topology if epoch == self.topology.epoch else None
-
-    def fetch_topology_for_epoch(self, epoch: int) -> None:
-        pass
+        super().__init__()
+        self.report_topology(topology)
 
     def acknowledge_epoch(self, epoch_ready, start_sync: bool = True) -> None:
         pass

@@ -158,23 +158,28 @@ def calculate_deps(table: DepsTable, query: DepsQuery,
 from functools import partial
 
 
-@partial(jax.jit, static_argnums=(2,))
-def calculate_deps_indices(table: DepsTable, query: DepsQuery, k: int):
-    """calculate_deps compacted ON DEVICE to per-row slot indices via
-    top_k (TPU-native compaction): returns (idx int32[B, k] — slot indices,
-    padded with -1 — and counts int32[B]).  Ships only the sparse result
-    across the PCIe/tunnel boundary — the host reads TxnIds from its own
-    mirror.  A row whose count exceeds ``k`` overflowed; the caller falls
-    back to the bit-packed full mask."""
-    dep_mask, max_conflict = calculate_deps(table, query)
+def _compact_topk(dep_mask: jnp.ndarray, k: int):
+    """Mask -> (idx int32[B, k] ascending slot indices padded with -1,
+    counts int32[B]) via top_k — the TPU-native compaction shared by every
+    indices path.  score = n - col for set bits, 0 otherwise, so top_k
+    yields ascending column order among hits and pads with zeros."""
     n = dep_mask.shape[1]
-    # score = n - col for set bits, 0 otherwise: top_k yields ascending
-    # column order among hits, pads with zeros
     col = jnp.arange(n, dtype=jnp.int32)
     scores = jnp.where(dep_mask, n - col, 0)
     top, _ = jax.lax.top_k(scores, k)
     idx = jnp.where(top > 0, n - top, -1)
     counts = jnp.sum(dep_mask, axis=1, dtype=jnp.int32)
+    return idx, counts
+
+
+@partial(jax.jit, static_argnums=(2,))
+def calculate_deps_indices(table: DepsTable, query: DepsQuery, k: int):
+    """calculate_deps compacted ON DEVICE to per-row slot indices: ships
+    only the sparse result across the PCIe/tunnel boundary — the host reads
+    TxnIds from its own mirror.  A row whose count exceeds ``k`` overflowed;
+    the caller falls back to the bit-packed full mask."""
+    dep_mask, max_conflict = calculate_deps(table, query)
+    idx, counts = _compact_topk(dep_mask, k)
     return idx, counts, max_conflict
 
 
@@ -194,12 +199,7 @@ def calculate_deps_indices_fused(table: DepsTable, qmat: jnp.ndarray,
         qmat[:, 7:7 + m], qmat[:, 7 + m:7 + 2 * m],
         qmat[:, 4], qmat[:, 5], qmat[:, 6].astype(jnp.int32))
     dep_mask, _mc = calculate_deps(table, query)
-    n = dep_mask.shape[1]
-    col = jnp.arange(n, dtype=jnp.int32)
-    scores = jnp.where(dep_mask, n - col, 0)
-    top, _ = jax.lax.top_k(scores, k)
-    idx = jnp.where(top > 0, n - top, -1)
-    counts = jnp.sum(dep_mask, axis=1, dtype=jnp.int32)
+    idx, counts = _compact_topk(dep_mask, k)
     return jnp.concatenate([counts[:, None], idx], axis=1)
 
 
@@ -312,29 +312,16 @@ def build_query(queries: Sequence[tuple],
 
     When self_txn_id is omitted it defaults to the bound itself (correct for
     PreAccept, where bound == own TxnId); pass it explicitly for Accept-phase
-    queries whose bound is the proposed executeAt."""
+    queries whose bound is the proposed executeAt.  Packs through the same
+    matrix encoder as the fused path (one upload, one source of truth for
+    the column/interval layout) and slices the columns on device."""
     ensure_x64()
-    b = len(queries)
-    msb, lsb, node = np.zeros(b, np.int64), np.zeros(b, np.int64), np.zeros(b, np.int32)
-    smsb, slsb, snode = np.zeros(b, np.int64), np.zeros(b, np.int64), np.zeros(b, np.int32)
-    wmask = np.zeros(b, np.int32)
-    lo = np.full((b, max_intervals), PAD_LO, np.int64)
-    hi = np.full((b, max_intervals), PAD_HI, np.int64)
-    for i, q in enumerate(queries):
-        (bound, witnesses, toks, rngs), self_id = q[:4], (q[4] if len(q) > 4 else q[0])
-        msb[i] = to_i64(bound.msb)
-        lsb[i] = to_i64(bound.lsb)
-        node[i] = bound.node
-        smsb[i] = to_i64(self_id.msb)
-        slsb[i] = to_i64(self_id.lsb)
-        snode[i] = self_id.node
-        wmask[i] = witnesses.mask()
-        row_lo, row_hi = _intervals_of(toks, rngs, max_intervals)
-        lo[i] = row_lo
-        hi[i] = row_hi
-    return DepsQuery(jnp.asarray(msb), jnp.asarray(lsb), jnp.asarray(node),
-                     jnp.asarray(wmask), jnp.asarray(lo), jnp.asarray(hi),
-                     jnp.asarray(smsb), jnp.asarray(slsb), jnp.asarray(snode))
+    m = max_intervals
+    q = jnp.asarray(pack_query_matrix(queries, m))
+    return DepsQuery(q[:, 0], q[:, 1], q[:, 2].astype(jnp.int32),
+                     q[:, 3].astype(jnp.int32),
+                     q[:, 7:7 + m], q[:, 7 + m:7 + 2 * m],
+                     q[:, 4], q[:, 5], q[:, 6].astype(jnp.int32))
 
 
 def extract_deps(table: DepsTable, dep_mask) -> List[List[TxnId]]:
