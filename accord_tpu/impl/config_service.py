@@ -46,12 +46,22 @@ class AbstractConfigurationService(api.ConfigurationService):
                 topology.epoch, last)
         self._epochs.append(topology)
         for listener in list(self._listeners):
+            self._notify(listener, topology)
+
+    @staticmethod
+    def _notify(listener, topology: Topology) -> None:
+        """Listeners per the SPI are ConfigurationServiceListener objects
+        (on_topology_update); bare callables are accepted for tests."""
+        fn = getattr(listener, "on_topology_update", None)
+        if fn is not None:
+            fn(topology, True)
+        else:
             listener(topology)
 
     def register_listener(self, listener) -> None:
         self._listeners.append(listener)
         for t in self._epochs:   # replay known history to late registrants
-            listener(t)
+            self._notify(listener, t)
 
     def current_topology(self) -> Topology:
         invariants.check_state(bool(self._epochs), "no topology known")

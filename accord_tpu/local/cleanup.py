@@ -122,13 +122,19 @@ def cleanup_store(safe: "SafeCommandStore") -> int:
         # paged-out commands must not escape erasure (their journal
         # registers/bodies and device slots would grow forever): page the
         # erasure-eligible ones — below the universal watermark — back in
-        # so the sweep below retires them, dropping their registers too
+        # so the sweep below retires them, dropping their registers too.
+        # Only when the floor ADVANCED since the last attempt: candidates
+        # decide() refuses (e.g. truncated cross-shard routes whose non-
+        # owned ranges gap the watermark) must not be reconstructed again
+        # on every durability round.
         owned = store.ranges_for_epoch.all()
         if not owned.is_empty():
             floor = store.durable_before.min_universal_before(owned)
-            for tid in journal.registered_txns(store.store_id):
-                if tid < floor and tid not in store.commands:
-                    store.page_in(tid)
+            if floor != getattr(store, "_cleanup_paged_floor", None):
+                store._cleanup_paged_floor = floor
+                for tid in journal.registered_txns(store.store_id):
+                    if tid < floor and tid not in store.commands:
+                        store.page_in(tid)
     released = 0
     for txn_id in list(store.commands.keys()):
         cmd = store.commands.get(txn_id)

@@ -248,18 +248,17 @@ class CommandStore:
         proving the journal round-trips it to the SAME terminal status —
         paging must never degrade state (a degraded Stable without its
         frontier would execute early on reload)."""
-        import heapq
         excess = len(self.commands) - self.paged_limit
         if excess <= 0:
             return
         journal = self.node.journal
         if journal is None:
             return
-        evictable = (tid for tid, cmd in self.commands.items()
-                     if (cmd.save_status is SaveStatus.Applied
-                         or cmd.is_truncated() or cmd.is_invalidated())
-                     and journal.has_register(self.store_id, tid))
-        for tid in heapq.nsmallest(excess * 2, evictable):
+        evictable = sorted(tid for tid, cmd in self.commands.items()
+                           if (cmd.save_status is SaveStatus.Applied
+                               or cmd.is_truncated() or cmd.is_invalidated())
+                           and journal.has_register(self.store_id, tid))
+        for tid in evictable:
             if excess <= 0:
                 break
             rc = journal.reconstruct(self, tid)

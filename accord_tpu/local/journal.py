@@ -161,12 +161,8 @@ class Journal:
         if command.save_status is SaveStatus.Erased:
             # erased on this store: the watermarks answer for it here —
             # drop its registers (the journal's own truncation, ref: Cleanup
-            # ERASE wipes the journal's messages).  Bodies go only once NO
-            # store retains registers: a sibling store whose watermark lags
-            # still needs them to reconstruct its own copy.
-            regs.pop(command.txn_id, None)
-            if not any(command.txn_id in r for r in self._registers.values()):
-                self._bodies.pop(command.txn_id, None)
+            # ERASE wipes the journal's messages)
+            self.drop_register(store_id, command.txn_id)
             return
         regs[command.txn_id] = _Registers(
             command.save_status, command.execute_at, command.promised,

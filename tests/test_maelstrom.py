@@ -74,10 +74,10 @@ def test_wire_rejects_unknown():
 # in-process runner (the north-star gate: lin-kv list-append passing)
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
 def test_runner_list_append_linearizable(seed):
     r = MaelstromRunner(n_nodes=3, seed=seed)
-    res = r.run_workload(n_ops=40, n_keys=8)   # verify=True checks history
+    res = r.run_workload(n_ops=100, n_keys=8)   # verify=True checks history
     assert res.ops_unresolved == 0, res
     assert res.ops_ok >= res.ops_failed, res
 
