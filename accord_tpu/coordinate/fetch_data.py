@@ -10,13 +10,11 @@ on dependencies whose Commit/Apply messages this node missed.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 from ..local import commands
-from ..local.command_store import PreLoadContext
-from ..local.status import Status
 from ..messages.check_status import CheckStatusOk, IncludeInfo
-from ..primitives.timestamp import Ballot, TxnId
+from ..primitives.timestamp import TxnId
 from ..utils import async_chain
 from .errors import Timeout
 
@@ -42,62 +40,14 @@ def fetch_data(node, txn_id: TxnId, participants, epoch: int
     return result
 
 
-def _deps_cover(partial_deps, route, owned) -> bool:
-    """Committing locally with deps that do not cover this store's owned
-    slice of the route could let the txn execute before dependencies it
-    should wait for (a single replica's CheckStatus reply need not cover our
-    ranges).  Verify coverage; otherwise fall back to precommit and let the
-    progress log fetch more."""
-    from ..primitives.keys import Ranges
-    p = route.participants
-    if isinstance(p, Ranges):
-        return partial_deps.covers(p.intersecting(owned))
-    needed = [t for t in p.tokens() if owned.contains_token(t)]
-    return all(partial_deps.covering.contains_token(t) for t in needed)
-
-
 def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
-    """Apply remotely-learned knowledge to the local stores
-    (ref: messages/Propagate.java).  Only ever upgrades: the underlying
-    transitions are no-ops when local state is already as advanced."""
-    status = ok.save_status.status
-    if node.journal is not None:
-        # local knowledge upgrades are side-effecting local messages
-        # (ref: PROPAGATE_* in messages/MessageType.java are journaled)
-        node.journal.record_propagate(txn_id, ok)
-
-    def apply_fn(safe):
-        if status is Status.Invalidated:
-            commands.commit_invalidate(safe, txn_id)
-            return
-        if ok.route is None or ok.partial_txn is None:
-            return
-        # Sync points extend one epoch below: a dropped donor fetching a
-        # bootstrap fence's outcome must be able to apply it over its old
-        # ranges.  Data txns do NOT — processing them over lost ranges would
-        # create gap-divergent stale copies (the fan-out no longer includes
-        # this node for those ranges).
-        owned = safe.store.ranges_for_epoch.all_between(
-            _propagate_min_epoch(txn_id), txn_id.epoch())
-        partial_txn = ok.partial_txn.slice(owned, True)
-        if status >= Status.PreApplied and ok.writes is not None \
-                and ok.execute_at is not None:
-            deps = ok.partial_deps.slice(owned) if ok.partial_deps is not None else None
-            commands.apply(safe, txn_id, ok.route, ok.execute_at, deps,
-                           partial_txn, ok.writes, ok.result)
-            return
-        if status >= Status.Committed and ok.execute_at is not None \
-                and ok.partial_deps is not None \
-                and _deps_cover(ok.partial_deps, ok.route, owned):
-            commands.commit(safe, txn_id, status >= Status.Stable, Ballot.MAX,
-                            ok.route, partial_txn, ok.execute_at,
-                            ok.partial_deps.slice(owned))
-            return
-        if status >= Status.PreCommitted and ok.execute_at is not None:
-            commands.precommit(safe, txn_id, ok.execute_at)
-
-    node.for_each_local(PreLoadContext.for_txn(txn_id), participants,
-                        _propagate_min_epoch(txn_id), txn_id.epoch(), apply_fn)
+    """Apply remotely-learned knowledge to the local stores, as the
+    side-effecting LOCAL message the reference models it as
+    (ref: messages/Propagate.java; PROPAGATE_* in MessageType.java) — it
+    flows through Node._process so the journal persists it and restart
+    reconstruction covers knowledge learned via fetches."""
+    from ..messages.propagate import Propagate
+    node._process(Propagate(txn_id, participants, ok), node.node_id, None)
 
 
 def _propagate_min_epoch(txn_id: TxnId) -> int:

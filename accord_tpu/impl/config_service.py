@@ -51,12 +51,22 @@ class AbstractConfigurationService(api.ConfigurationService):
     @staticmethod
     def _notify(listener, topology: Topology) -> None:
         """Listeners per the SPI are ConfigurationServiceListener objects
-        (on_topology_update); bare callables are accepted for tests."""
+        (on_topology_update(topology, started_sync)); single-argument
+        implementations (Node/TopologyManager's own on_topology_update) and
+        bare callables are accepted too."""
+        import inspect
         fn = getattr(listener, "on_topology_update", None)
-        if fn is not None:
-            fn(topology, True)
-        else:
+        if fn is None:
             listener(topology)
+            return
+        try:
+            n_params = len(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            n_params = 2
+        if n_params <= 1:
+            fn(topology)
+        else:
+            fn(topology, True)
 
     def register_listener(self, listener) -> None:
         self._listeners.append(listener)

@@ -111,6 +111,11 @@ class Journal:
         if txn_id is None:
             return
         type_name = request.type.name
+        if type_name.startswith("PROPAGATE"):
+            # local knowledge-upgrade message: its body is the merged
+            # CheckStatusOk (ref: Propagate.java carries the found state)
+            self.record_propagate(txn_id, request.ok)
+            return
         self._note_hlc(txn_id)
         ex = getattr(request, "execute_at", None)
         if ex is not None:

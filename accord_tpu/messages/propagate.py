@@ -1,0 +1,87 @@
+"""Propagate: apply remotely-learned knowledge to the local stores.
+
+Rebuild of ref: accord-core/src/main/java/accord/messages/Propagate.java:63 —
+the "local message" half of FetchData: a CheckStatus quorum's merged
+knowledge (route, definition, executeAt, deps, outcome) is applied to this
+node's own stores, only ever upgrading them.  As in the reference it is a
+side-effecting LOCAL request (MessageType PROPAGATE_*): it flows through
+Node._process so the journal persists it, and a restart reconstructs
+commands learned this way exactly like commands learned from the wire.
+"""
+
+from __future__ import annotations
+
+from ..local import commands
+from ..local.command_store import PreLoadContext, SafeCommandStore
+from ..local.status import Status
+from ..primitives.timestamp import Ballot, TxnId
+from .base import MessageType, Request
+
+
+class Propagate(Request):
+    """(ref: messages/Propagate.java)."""
+
+    type = MessageType.PROPAGATE_OTHER_MSG
+
+    def __init__(self, txn_id: TxnId, participants, ok):
+        self.txn_id = txn_id
+        self.participants = participants
+        self.ok = ok                       # merged CheckStatusOk
+
+    def process(self, node, from_id: int, reply_context) -> None:
+        from ..coordinate.fetch_data import _propagate_min_epoch
+        ok = self.ok
+        txn_id = self.txn_id
+        status = ok.save_status.status
+
+        def apply_fn(safe: SafeCommandStore):
+            if status is Status.Invalidated:
+                commands.commit_invalidate(safe, txn_id)
+                return
+            if ok.route is None or ok.partial_txn is None:
+                return
+            # Sync points extend one epoch below: a dropped donor fetching a
+            # bootstrap fence's outcome must be able to apply it over its
+            # old ranges.  Data txns do NOT — processing them over lost
+            # ranges would create gap-divergent stale copies (the fan-out no
+            # longer includes this node for those ranges).
+            owned = safe.store.ranges_for_epoch.all_between(
+                _propagate_min_epoch(txn_id), txn_id.epoch())
+            partial_txn = ok.partial_txn.slice(owned, True)
+            if status >= Status.PreApplied and ok.writes is not None \
+                    and ok.execute_at is not None:
+                deps = (ok.partial_deps.slice(owned)
+                        if ok.partial_deps is not None else None)
+                commands.apply(safe, txn_id, ok.route, ok.execute_at, deps,
+                               partial_txn, ok.writes, ok.result)
+                return
+            if status >= Status.Committed and ok.execute_at is not None \
+                    and ok.partial_deps is not None \
+                    and _deps_cover(ok.partial_deps, ok.route, owned):
+                commands.commit(safe, txn_id, status >= Status.Stable,
+                                Ballot.MAX, ok.route, partial_txn,
+                                ok.execute_at, ok.partial_deps.slice(owned))
+                return
+            if status >= Status.PreCommitted and ok.execute_at is not None:
+                commands.precommit(safe, txn_id, ok.execute_at)
+
+        node.for_each_local(PreLoadContext.for_txn(txn_id), self.participants,
+                            _propagate_min_epoch(txn_id), txn_id.epoch(),
+                            apply_fn)
+
+    def __repr__(self):
+        return f"Propagate({self.txn_id}, {self.ok.save_status.name})"
+
+
+def _deps_cover(partial_deps, route, owned) -> bool:
+    """Committing locally with deps that do not cover this store's owned
+    slice of the route could let the txn execute before dependencies it
+    should wait for (a single replica's CheckStatus reply need not cover our
+    ranges).  Verify coverage; otherwise fall back to precommit and let the
+    progress log fetch more."""
+    from ..primitives.keys import Ranges
+    p = route.participants
+    if isinstance(p, Ranges):
+        return partial_deps.covers(p.intersecting(owned))
+    needed = [t for t in p.tokens() if owned.contains_token(t)]
+    return all(partial_deps.covering.contains_token(t) for t in needed)

@@ -146,8 +146,8 @@ class NodeSink(api.MessageSink):
         self.cluster.queue.add(self.cluster.queue.now + timeout, on_timeout)
 
     def reply(self, to: int, reply_context, reply) -> None:
-        if self.dead:
-            return
+        if self.dead or reply_context is None:
+            return   # local requests (Propagate) have no reply path
         self.cluster.route_reply(self.node_id, to, reply_context, reply)
 
     # -- inbound (called by cluster on delivery) ----------------------------
