@@ -12,11 +12,9 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..local import commands
 from ..messages.check_status import CheckStatusOk, IncludeInfo
 from ..primitives.timestamp import TxnId
 from ..utils import async_chain
-from .errors import Timeout
 
 
 def fetch_data(node, txn_id: TxnId, participants, epoch: int
@@ -49,6 +47,3 @@ def propagate(node, txn_id: TxnId, participants, ok: CheckStatusOk) -> None:
     from ..messages.propagate import Propagate
     node._process(Propagate(txn_id, participants, ok), node.node_id, None)
 
-
-def _propagate_min_epoch(txn_id: TxnId) -> int:
-    return commands.apply_window_epochs(txn_id, None)[0]

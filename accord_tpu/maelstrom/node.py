@@ -107,12 +107,16 @@ class MaelstromSink(api.MessageSink):
                         "payload": wire.encode(request)})
 
     def reply(self, to: int, reply_context, reply) -> None:
+        if reply_context is None:
+            return   # local requests (Propagate) have no reply path
         self._emit(to, {"type": "accord_rsp", "msg_id": self._msg_id(),
                         "in_reply_to": reply_context,
                         "payload": wire.encode(reply)})
 
     def reply_with_unknown_failure(self, to: int, reply_context,
                                    failure: BaseException) -> None:
+        if reply_context is None:
+            return   # local requests (Propagate) have no reply path
         self._emit(to, {"type": "accord_fail", "msg_id": self._msg_id(),
                         "in_reply_to": reply_context,
                         "error": repr(failure)})

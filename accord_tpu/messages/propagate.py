@@ -18,6 +18,12 @@ from ..primitives.timestamp import Ballot, TxnId
 from .base import MessageType, Request
 
 
+def _propagate_min_epoch(txn_id: TxnId) -> int:
+    """Sync points reach one epoch below their id (the dual-quorum
+    handoff leg — see commands.apply_window_epochs)."""
+    return commands.apply_window_epochs(txn_id, None)[0]
+
+
 class Propagate(Request):
     """(ref: messages/Propagate.java)."""
 
@@ -29,7 +35,6 @@ class Propagate(Request):
         self.ok = ok                       # merged CheckStatusOk
 
     def process(self, node, from_id: int, reply_context) -> None:
-        from ..coordinate.fetch_data import _propagate_min_epoch
         ok = self.ok
         txn_id = self.txn_id
         status = ok.save_status.status

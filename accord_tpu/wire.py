@@ -271,12 +271,22 @@ def _register_messages() -> None:
     register_fields(fetch_snapshot.FetchSnapshotOk, ["snapshot", "covered"])
     register_fields(fetch_snapshot.FetchSnapshotNack, [])
 
+    from .messages import ephemeral as eph
+    register_fields(eph.GetEphemeralReadDeps,
+                    ["txn_id", "route", "keys", "execution_epoch"])
+    register_fields(eph.GetEphemeralReadDepsOk, ["deps", "latest_epoch"])
+    register_fields(eph.ReadEphemeralTxnData,
+                    ["txn_id", "read", "keys", "deps", "execution_epoch"])
+
 
 def _register_kv_workload() -> None:
     from .sim import kvstore
     register(kvstore.KVRead, "KVRead",
              lambda r: {"v": encode(r._keys)},
              lambda d: kvstore.KVRead(decode(d["v"])))
+    register(kvstore.KVRangeRead, "KVRangeRead",
+             lambda r: {"v": encode(r._ranges)},
+             lambda d: kvstore.KVRangeRead(decode(d["v"])))
     register_fields(kvstore.KVWrite, ["appends"])
     register_fields(kvstore.KVUpdate, ["appends"])
     register_fields(kvstore.KVData, ["values"])

@@ -212,3 +212,46 @@ def test_paged_store_reloads_from_journal():
         reloaded = store.page_in(missing[0])
         assert reloaded is not None
     assert cluster.failures == []
+
+
+def test_restart_mid_bootstrap_rebootstraps():
+    """Crash a joiner WHILE its bootstrap fetch is in flight: the journal's
+    incomplete-bootstrap record must re-run the bootstrap (rebased to the
+    current epoch) and the node must end up serving correct data."""
+    cluster = make_cluster(seed=41)
+    for i in range(6):
+        out = submit(cluster, 1 + i % 3, kv_txn([700_000 + i],
+                                                {700_000 + i: (f"b{i}",)}))
+        cluster.run_until_quiescent()
+        assert out[0][1] is None
+    # epoch 2: node 4 joins and must bootstrap everything it now owns
+    cluster.add_topology(build_topology(2, (1, 2, 3, 4), 3, 4))
+
+    def mid_bootstrap():
+        node4 = cluster.nodes.get(4)
+        if node4 is None:
+            return False
+        return any(not s.bootstrapping.is_empty()
+                   for s in node4.command_stores.unsafe_all_stores())
+
+    # step the sim until the joiner is mid-bootstrap, then crash it
+    for _ in range(100_000):
+        if mid_bootstrap():
+            break
+        fn = cluster.queue.pop()
+        assert fn is not None, "bootstrap never began"
+        fn()
+    assert mid_bootstrap(), "did not catch the bootstrap window"
+    cluster.restart_node(4)
+    cluster.run_until_quiescent(max_micros=120_000_000)
+    node4 = cluster.nodes[4]
+    assert all(s.bootstrapping.is_empty()
+               for s in node4.command_stores.unsafe_all_stores()), \
+        "re-run bootstrap never completed"
+    # the re-bootstrapped joiner serves the pre-join history
+    out = submit(cluster, 4, kv_txn([700_000, 700_001, 700_002], {}))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+    assert out[0][0].reads == {700_000: ("b0",), 700_001: ("b1",),
+                               700_002: ("b2",)}
+    assert cluster.failures == []

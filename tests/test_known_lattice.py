@@ -1,0 +1,77 @@
+"""Known-lattice algebra: randomized law checks.
+
+The CheckStatus reply merge hinges on Known.at_least being a lattice join
+and Known.reduce a meet-like combiner (ref: Status.java:124-790 Known;
+merged at messages/CheckStatus reduce).  Violations corrupt recovery's view
+of what a quorum collectively knows, so the laws are pinned exhaustively
+per dimension and randomized over the product.
+"""
+
+import itertools
+import random
+
+import pytest
+
+from accord_tpu.local.status import (Definition, Known, KnownDeps,
+                                     KnownExecuteAt, KnownRoute, Outcome,
+                                     SaveStatus)
+
+_DIMS = (KnownRoute, Definition, KnownExecuteAt, KnownDeps, Outcome)
+
+
+@pytest.mark.parametrize("dim", _DIMS)
+def test_at_least_is_a_join_per_dimension(dim):
+    """Exhaustive per dimension: commutative, idempotent, associative, and
+    an upper bound of both arguments under itself."""
+    vals = list(dim)
+    for a, b in itertools.product(vals, vals):
+        ab = a.at_least(b)
+        assert ab == b.at_least(a), (a, b)
+        for c in vals:
+            assert a.at_least(b).at_least(c) == a.at_least(b.at_least(c))
+        # join is an upper bound: joining either operand back is a no-op
+        assert ab.at_least(a) == ab
+        assert ab.at_least(b) == ab
+    for a in vals:
+        assert a.at_least(a) == a
+
+
+@pytest.mark.parametrize("dim", _DIMS)
+def test_reduce_laws_per_dimension(dim):
+    vals = list(dim)
+    for a, b in itertools.product(vals, vals):
+        assert a.reduce(b) == b.reduce(a), (a, b)
+        for c in vals:
+            assert a.reduce(b).reduce(c) == a.reduce(b.reduce(c))
+    for a in vals:
+        assert a.reduce(a) == a
+
+
+def _random_known(rng):
+    return Known(rng.choice(list(KnownRoute)),
+                 rng.choice(list(Definition)),
+                 rng.choice(list(KnownExecuteAt)),
+                 rng.choice(list(KnownDeps)),
+                 rng.choice(list(Outcome)))
+
+
+def test_known_join_laws_randomized():
+    rng = random.Random(5)
+    for _ in range(500):
+        a, b, c = (_random_known(rng) for _ in range(3))
+        assert a.at_least(b) == b.at_least(a)
+        assert a.at_least(b).at_least(c) == a.at_least(b.at_least(c))
+        assert a.at_least(a) == a
+        ab = a.at_least(b)
+        assert ab.at_least(a) == ab and ab.at_least(b) == ab
+
+
+def test_save_status_known_monotone_with_status_order():
+    """Later protocol phases must never know LESS: for save statuses on the
+    decided/applied spine, Known only grows along the ladder."""
+    spine = [SaveStatus.PreAccepted, SaveStatus.Committed, SaveStatus.Stable,
+             SaveStatus.PreApplied, SaveStatus.Applied]
+    for lo, hi in zip(spine, spine[1:]):
+        joined = hi.known.at_least(lo.known)
+        assert joined == hi.known, \
+            f"{hi.name} lost knowledge vs {lo.name}: {joined}"

@@ -52,7 +52,23 @@ def test_wire_round_trips_live_protocol_traffic(monkeypatch):
             kv_txn([i * 10, (i + 1) * 10], {i * 10: (f"v{i}",)})).begin(
             lambda r, f: out.append((r, f)))
     cluster.run_until_quiescent()
+    # exercise the ephemeral-read and range-read verbs too
+    from accord_tpu.coordinate.barrier import barrier
+    from accord_tpu.primitives.keys import Range, Ranges
+    from accord_tpu.sim.kvstore import kv_ephemeral_read, kv_range_read
+    cluster.nodes[2].coordinate(kv_ephemeral_read([10])).begin(
+        lambda r, f: out.append((r, f)))
+    cluster.nodes[3].coordinate(
+        kv_range_read(Ranges.of(Range(0, 100)))).begin(
+        lambda r, f: out.append((r, f)))
+    barrier(cluster.nodes[1], Ranges.of(Range(0, 1_000_000)),
+            global_=True).begin(lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
     assert cluster.failures == []
+    assert all(f is None for _r, f in out), out
+    names = {type(m).__name__ for m in seen}
+    assert {"GetEphemeralReadDeps", "ReadEphemeralTxnData",
+            "WaitUntilApplied"} <= names, names
     assert len(seen) > 50
     for msg in seen:
         doc = json.loads(json.dumps(wire.encode(msg)))
