@@ -116,7 +116,11 @@ class MaelstromSink(api.MessageSink):
     def reply_with_unknown_failure(self, to: int, reply_context,
                                    failure: BaseException) -> None:
         if reply_context is None:
-            return   # local requests (Propagate) have no reply path
+            # local requests (Propagate) have no reply path, but the
+            # failure must not vanish: stderr is maelstrom's log channel
+            import sys
+            print(f"local request failed: {failure!r}", file=sys.stderr)
+            return
         self._emit(to, {"type": "accord_fail", "msg_id": self._msg_id(),
                         "in_reply_to": reply_context,
                         "error": repr(failure)})

@@ -182,11 +182,15 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
             # membership: add one node
             members = sorted(members + [top.pick(
                 [n for n in all_ids if n not in members])])
-        elif roll == 1 and len(members) > 3:
+        elif roll == 1 and len(members) > max(3, rf):
             # membership: drop one node
             members = [n for n in members if n != top.pick(members)]
         # else: keep members, reshard only
-        new_rf = min(3, len(members))
+        # keep the run's replication degree through churn (ref: the
+        # TopologyRandomizer varies rf 2..9, BurnTest.java:600-609) — capping
+        # at 3 silently collapsed every big-cluster run's geometry at the
+        # first epoch change
+        new_rf = min(rf, len(members))
         prev_shards = len(current.shards)
         new_shards = max(2, min(5, prev_shards + top.next_int(3) - 1))
         cluster.add_topology(build_topology(current.epoch + 1, members,

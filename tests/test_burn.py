@@ -45,3 +45,14 @@ def test_burn_seed7_30ops_epoch_turnover():
     (ExclusiveSyncPoint fences lower TxnIds) + executeAt-gated apply."""
     result = run_burn(7, n_ops=30)
     assert result.ops_unresolved == 0
+
+
+@pytest.mark.parametrize("seed", [201, 202])
+def test_burn_big_cluster(seed):
+    """Quorum geometry beyond rf=3 (ref: BurnTest rf 2..9): 7 nodes, rf 5,
+    with churn preserving the replication degree."""
+    result = run_burn(seed, n_ops=120, node_ids=(1, 2, 3, 4, 5, 6, 7),
+                      rf=5, shards=6)
+    assert result.ops_unresolved == 0, (
+        f"seed {seed}: {result} (repro: rf=5 nodes=7)")
+    assert result.ops_ok >= 2 * result.ops_failed, f"seed {seed}: {result}"
