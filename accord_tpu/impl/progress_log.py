@@ -37,6 +37,18 @@ class _Progress(enum.IntEnum):
     Investigating = 2
 
 
+# Fruitless-retry backoff caps, in scan periods (~0.5-0.9s of sim time
+# each).  Blocked (fetch) entries pile up by the dozen behind a wedged
+# dependency — at the old shared cap of 16 their refetches compounded into a
+# CheckStatus storm that stalled the simulation, so they back WAY off;
+# liveness only needs eventual retry.  Home (recovery) entries stay on a
+# shorter leash: recovery drives op completion, and a cap that can exceed
+# the burn's post-heal drain window turns one preemption into an
+# unresolved-op flake.
+_HOME_BACKOFF_CAP = 32
+_BLOCKED_BACKOFF_CAP = 128
+
+
 class _HomeEntry:
     __slots__ = ("txn_id", "route", "progress", "token", "countdown", "backoff")
 
@@ -55,7 +67,7 @@ class _HomeEntry:
 
     def no_progress(self) -> None:
         self.progress = _Progress.NoProgress
-        self.backoff = min(self.backoff * 2, 16)
+        self.backoff = min(self.backoff * 2, _HOME_BACKOFF_CAP)
         self.countdown = self.backoff
 
 
@@ -73,7 +85,7 @@ class _BlockedEntry:
 
     def no_progress(self) -> None:
         self.progress = _Progress.NoProgress
-        self.backoff = min(self.backoff * 2, 16)
+        self.backoff = min(self.backoff * 2, _BLOCKED_BACKOFF_CAP)
         self.countdown = self.backoff
 
 

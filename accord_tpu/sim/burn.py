@@ -174,6 +174,16 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         any serving replica."""
         if cluster.queue.now > workload_micros:
             return
+        # don't stack reconfigurations: churning while the previous epoch's
+        # data is still migrating compounds bootstrap fences across nodes
+        # into dependency cycles (no operator/controller reconfigures a
+        # cluster mid-rebalance; the reference randomizer's 1s cadence is
+        # effectively gated the same way by its instant in-memory fetches)
+        if any(not s.bootstrapping.is_empty()
+               for node in cluster.nodes.values()
+               for s in node.command_stores.unsafe_all_stores()):
+            cluster.queue.add(cluster.queue.now + 2_000_000, churn_once)
+            return
         current = cluster.topologies[-1]
         all_ids = list(node_ids)
         members = sorted(current.nodes())
@@ -192,7 +202,11 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         # first epoch change
         new_rf = min(rf, len(members))
         prev_shards = len(current.shards)
-        new_shards = max(2, min(5, prev_shards + top.next_int(3) - 1))
+        # the shard-count cap follows the run's configuration (same defect
+        # class as the old rf<=3 cap: a shards=6 run must keep exercising
+        # 6-shard geometry through churn, not collapse to 5 at epoch 2)
+        new_shards = max(2, min(max(5, shards),
+                                prev_shards + top.next_int(3) - 1))
         cluster.add_topology(build_topology(current.epoch + 1, members,
                                             new_rf, new_shards))
         result.epochs += 1

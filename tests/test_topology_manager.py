@@ -1,0 +1,92 @@
+"""TopologyManager: epoch ledger, per-shard sync quorums, dual-quorum
+windows (ref: accord-core test TopologyManagerTest + the epoch-handoff
+invariant: an epoch only counts as synced once a QUORUM OF EACH OF ITS OWN
+SHARDS acked — trivial acks from nodes owning nothing must not retire the
+prior-epoch quorum, or capture fences collapse to single-epoch quorums and
+in-flight prior-epoch txns are lost across the handoff)."""
+
+import pytest
+
+from accord_tpu.primitives.keys import Range, Ranges
+from accord_tpu.topology.manager import TopologyManager
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topology
+
+
+def topo(epoch, assignments):
+    return Topology(epoch, [Shard(Range(s, e), nodes)
+                            for (s, e, nodes) in assignments])
+
+
+FULL = Ranges.of(Range(0, 100))
+
+
+def test_first_epoch_needs_no_sync():
+    m = TopologyManager(1)
+    m.on_topology_update(topo(1, [(0, 100, [1, 2, 3])]))
+    assert m.is_sync_complete(1)
+
+
+def test_sync_requires_quorum_of_each_new_shard():
+    m = TopologyManager(1)
+    m.on_topology_update(topo(1, [(0, 100, [1, 2, 3])]))
+    m.on_topology_update(topo(2, [(0, 50, [1, 2, 3]), (50, 100, [3, 4, 5])]))
+    assert not m.is_sync_complete(2)
+    # acks from nodes OUTSIDE a shard's membership must not advance it
+    m.on_epoch_sync_complete(1, 2)
+    m.on_epoch_sync_complete(2, 2)
+    assert not m.is_sync_complete(2)   # shard [50,100) has no acks yet
+    m.on_epoch_sync_complete(4, 2)
+    assert not m.is_sync_complete(2)   # 1 of {3,4,5}: below quorum
+    m.on_epoch_sync_complete(5, 2)
+    assert m.is_sync_complete(2)       # {4,5} >= quorum; {1,2} covers shard 1
+
+
+def test_with_unsynced_epochs_extends_backwards():
+    m = TopologyManager(1)
+    m.on_topology_update(topo(1, [(0, 100, [1, 2, 3])]))
+    m.on_topology_update(topo(2, [(0, 100, [3, 4, 5])]))
+    ts = m.with_unsynced_epochs(FULL, 2, 2)
+    assert [t.epoch for t in ts] == [2, 1], \
+        "unsynced epoch must pull in the prior epoch (dual quorum)"
+    for n in (3, 4, 5):
+        m.on_epoch_sync_complete(n, 2)
+    ts = m.with_unsynced_epochs(FULL, 2, 2)
+    assert [t.epoch for t in ts] == [2], \
+        "synced epoch needs no prior-epoch quorum"
+
+
+def test_synced_for_is_selection_scoped():
+    m = TopologyManager(1)
+    m.on_topology_update(topo(1, [(0, 100, [1, 2, 3])]))
+    m.on_topology_update(topo(2, [(0, 50, [1, 2, 3]), (50, 100, [4, 5, 6])]))
+    for n in (1, 2):
+        m.on_epoch_sync_complete(n, 2)
+    left, right = Ranges.of(Range(0, 50)), Ranges.of(Range(50, 100))
+    assert len(list(m.with_unsynced_epochs(left, 2, 2))) == 1
+    assert len(list(m.with_unsynced_epochs(right, 2, 2))) == 2
+
+
+def test_sync_acks_buffered_before_topology_arrives():
+    m = TopologyManager(1)
+    m.on_topology_update(topo(1, [(0, 100, [1, 2, 3])]))
+    # acks for epoch 2 arrive before epoch 2's topology
+    m.on_epoch_sync_complete(1, 2)
+    m.on_epoch_sync_complete(2, 2)
+    m.on_topology_update(topo(2, [(0, 100, [1, 2, 3])]))
+    assert m.is_sync_complete(2)
+
+
+def test_await_epoch_resolves_on_arrival():
+    m = TopologyManager(1)
+    m.on_topology_update(topo(1, [(0, 100, [1, 2, 3])]))
+    got = []
+    m.await_epoch(2).begin(lambda t, f: got.append((t, f)))
+    assert not got
+    t2 = topo(2, [(0, 100, [1, 2, 3])])
+    m.on_topology_update(t2)
+    assert got and got[0][0] is t2 and got[0][1] is None
+    # already-known epochs resolve immediately
+    done = []
+    m.await_epoch(1).begin(lambda t, f: done.append(t))
+    assert done and done[0].epoch == 1
