@@ -53,8 +53,25 @@ class Propagate(Request):
             owned = safe.store.ranges_for_epoch.all_between(
                 _propagate_min_epoch(txn_id), txn_id.epoch())
             partial_txn = ok.partial_txn.slice(owned, True)
-            if status >= Status.PreApplied and ok.writes is not None \
-                    and ok.execute_at is not None:
+            # Sync points (and plain reads) legitimately carry NO writes:
+            # their apply must still run locally or a replica that lost the
+            # Apply fan-out holds the fence at ReadyToExecute forever, and
+            # every txn fenced behind it wedges with it (each fetch would
+            # re-commit but never apply).  For WRITE txns a missing outcome
+            # must NOT apply — marking Applied without the payload loses
+            # the write; those keep waiting for a reply that carries it.
+            # Either way the merged deps must COVER our owned slice (the
+            # awaits-only-deps watermark invariant — an applied fence proves
+            # everything below it applied — dies if a fence applies over an
+            # under-covering frontier); uncovered falls through to the
+            # commit/precommit upgrades below.
+            no_outcome_kind = txn_id.is_sync_point() or txn_id.is_read()
+            can_apply = (ok.writes is not None
+                         or (no_outcome_kind and ok.partial_deps is not None
+                             and _deps_cover(ok.partial_deps, ok.route,
+                                             owned)))
+            if status >= Status.PreApplied and ok.execute_at is not None \
+                    and can_apply:
                 deps = (ok.partial_deps.slice(owned)
                         if ok.partial_deps is not None else None)
                 commands.apply(safe, txn_id, ok.route, ok.execute_at, deps,
