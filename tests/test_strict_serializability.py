@@ -110,3 +110,139 @@ def test_verifier_detects_non_prefix_read():
     v.set_final(5, ("a", "b"))
     with pytest.raises(HistoryViolation):
         v.verify()
+
+
+def test_verifier_detects_phantom_read_beyond_final():
+    """A read observing past the authoritative quorum final is a dirty/
+    phantom read — the final must never be silently extended by it."""
+    v = StrictSerializabilityVerifier()
+    op = v.begin()
+    v.on_result(op, 0, 10, {5: ("a", "b", "x")}, {})
+    v.set_final(5, ("a", "b"))
+    with pytest.raises(HistoryViolation):
+        v.verify()
+
+
+def test_partial_final_tolerates_unread_append():
+    """When the final quorum read failed (no set_final), a committed append
+    that no later read observed must NOT be reported missing — the
+    synthesized final is partial, not complete."""
+    v = StrictSerializabilityVerifier()
+    t0 = v.begin()
+    v.on_result(t0, 0, 10, {5: ()}, {5: ("w0",)})
+    t1 = v.begin()
+    v.on_result(t1, 20, 30, {5: ("w0",)}, {})
+    t2 = v.begin()
+    v.on_result(t2, 40, 50, {5: ("w0",)}, {5: ("w1",)})  # never read back
+    v.verify()
+
+
+def _write_skew_history():
+    """The classic cross-key anomaly a broken (snapshot-isolation-style)
+    scheduler produces: T1 reads a=[] and writes b; T2 reads b=[] and
+    writes a; both commit.  Neither serial order explains both reads, but
+    every PER-KEY property holds (all reads are prefixes, ops overlap in
+    real time, own-writes land right after their reads)."""
+    v = StrictSerializabilityVerifier()
+    t1 = v.begin()
+    v.on_result(t1, 0, 100, {10: (), 20: ()}, {20: ("t1w",)})
+    t2 = v.begin()
+    v.on_result(t2, 0, 100, {10: (), 20: ()}, {10: ("t2w",)})
+    v.set_final(10, ("t2w",))
+    v.set_final(20, ("t1w",))
+    return v
+
+
+def test_cross_key_cycle_detected():
+    """ref verify/StrictSerializabilityVerifier.java:58 — the max-predecessor
+    propagation must catch a cross-key cycle."""
+    v = _write_skew_history()
+    with pytest.raises(HistoryViolation, match="cross-key cycle"):
+        v.verify()
+
+
+def test_cross_key_cycle_passes_per_key_checks():
+    """The same history sails through every per-key check — proving the
+    cross-key pass adds real power (this was the round-3 verifier's gap)."""
+    v = _write_skew_history()
+    v._effective_finals = v._compute_effective_finals()
+    v._check_prefixes()
+    v._check_realtime()
+    v._check_own_writes()
+    with pytest.raises(HistoryViolation):
+        v._check_cross_key()
+
+
+def test_cross_key_three_txn_cycle():
+    """A longer cycle: T1 sees a's state-0 and produces b1; T2 sees b's
+    state-0 and produces c1; T3 sees c's state-0 and produces a1.  Each
+    pairwise order is fine; the triangle is not."""
+    v = StrictSerializabilityVerifier()
+    t1 = v.begin()
+    v.on_result(t1, 0, 100, {1: (), 2: ()}, {2: ("w1",)})
+    t2 = v.begin()
+    v.on_result(t2, 0, 100, {2: (), 3: ()}, {3: ("w2",)})
+    t3 = v.begin()
+    v.on_result(t3, 0, 100, {3: (), 1: ()}, {1: ("w3",)})
+    v.set_final(1, ("w3",))
+    v.set_final(2, ("w1",))
+    v.set_final(3, ("w2",))
+    with pytest.raises(HistoryViolation, match="cross-key cycle"):
+        v.verify()
+
+
+def test_cross_key_serializable_history_passes():
+    """A genuinely serializable interleaving over the same shape must NOT
+    trip the cycle detector: T1 reads a=[],b=[] writes b; T2 reads
+    a=[], b=[t1w] writes a — order T1 < T2 explains everything."""
+    v = StrictSerializabilityVerifier()
+    t1 = v.begin()
+    v.on_result(t1, 0, 100, {10: (), 20: ()}, {20: ("t1w",)})
+    t2 = v.begin()
+    v.on_result(t2, 50, 150, {10: (), 20: ("t1w",)}, {10: ("t2w",)})
+    v.set_final(10, ("t2w",))
+    v.set_final(20, ("t1w",))
+    v.verify()
+
+
+def test_cross_key_realtime_inversion():
+    """T1 wrote a-step1 and completed by t=10; T2 starts at t=20 and reads
+    a=[].  The per-key read-monotonicity check is blind to it (both READS
+    observed prefix 0 — T1's own write is excluded from its read), but the
+    step real-time windows aren't: a-step1 was witnessed complete by t=10,
+    yet witnessing a-step0 at t=20 forces a-step1's write after t=20
+    (ref propagateToDirectSuccessor: successor.writtenAfter >=
+    predecessor.witnessedUntil)."""
+    v = StrictSerializabilityVerifier()
+    t1 = v.begin()
+    v.on_result(t1, 0, 10, {100: ()}, {100: ("w1",)})      # writes a-step1
+    t2 = v.begin()
+    v.on_result(t2, 20, 30, {100: (), 200: ()}, {200: ("w2",)})  # stale a read
+    v.set_final(100, ("w1",))
+    v.set_final(200, ("w2",))
+    with pytest.raises(HistoryViolation):
+        v.verify()
+
+
+def test_blind_write_resolved_by_final_position():
+    """A write with no coincident read (ref FutureWrites/UnknownStepHolder)
+    is pinned by its position in the final order and participates in the
+    graph: T2 blind-writes b while reading a=[], but b's final position
+    puts it after a write T3 that witnessed a-step1 — cycle through the
+    resolved step."""
+    v = StrictSerializabilityVerifier()
+    t1 = v.begin()
+    v.on_result(t1, 0, 100, {1: ()}, {1: ("a1",)})          # a-step1
+    t2 = v.begin()
+    # blind write on key 2 (no read of 2), reads a=[]: T2 < T1 (stale a),
+    # and final position pins T2's write as b-step1
+    v.on_result(t2, 0, 100, {1: ()}, {2: ("b1",)})
+    t3 = v.begin()
+    # read-only: witnessed a-step1 with b-step0 => b-step1 comes after
+    # a-step1, i.e. T1 < T3 < T2 — but T2 < T1.  Cycle through the
+    # final-position-resolved blind-write step.
+    v.on_result(t3, 0, 100, {1: ("a1",), 2: ()}, {})
+    v.set_final(1, ("a1",))
+    v.set_final(2, ("b1",))
+    with pytest.raises(HistoryViolation, match="cross-key cycle"):
+        v.verify()
