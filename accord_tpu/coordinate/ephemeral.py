@@ -90,16 +90,24 @@ class _EphemeralRead(api.Callback):
 
     def _on_deps(self) -> None:
         latest = max(ok.latest_epoch for ok in self.oks)
-        if latest > self.execution_epoch \
-                and self.attempt < self.MAX_EPOCH_RETRIES:
-            # a replica is in a later epoch: our quorum may no longer be an
-            # active one there — re-establish deps at that epoch
-            # (ref: CoordinateEphemeralRead's executeAtEpoch retry)
-            nxt = _EphemeralRead(self.node, self.txn_id, self.txn, self.route,
-                                 latest, self.attempt + 1)
-            self.node.with_epoch(
-                latest, lambda: nxt._start().begin(self.result.settle))
-            self.done = True
+        if latest > self.execution_epoch:
+            if self.attempt < self.MAX_EPOCH_RETRIES:
+                # a replica is in a later epoch: our quorum may no longer be
+                # an active one there — re-establish deps at that epoch
+                # (ref: CoordinateEphemeralRead's executeAtEpoch retry)
+                nxt = _EphemeralRead(self.node, self.txn_id, self.txn,
+                                     self.route, latest, self.attempt + 1)
+                self.node.with_epoch(
+                    latest, lambda: nxt._start().begin(self.result.settle))
+                self.done = True
+                return
+            # Retries exhausted with the topology still moving: executing at
+            # the stale epoch could miss writes committed under the newer
+            # one (the deps quorum may not be an active quorum there), which
+            # breaks per-key linearizability.  The reference never executes
+            # at a known-stale epoch; the documented contract is that the
+            # caller simply retries the ephemeral read.
+            self._fail(Exhausted(self.txn_id))
             return
         merged = self.oks[0].deps
         for ok in self.oks[1:]:

@@ -169,11 +169,31 @@ class SimpleProgressLog(api.ProgressLog):
         maybe_recover(node, txn_id, entry.route, entry.token).begin(on_done)
 
     # -- blocked-dependency fetch -------------------------------------------
+    def _local_knowledge_maximal(self, txn_id: TxnId) -> bool:
+        """True when a fetch could teach this store nothing: the local copy
+        already has the outcome (PreApplied+) or is terminal.  What remains
+        is local execution of the blocker's OWN dependency frontier, which
+        the drain completes as those deps' own blocked entries resolve —
+        refetching the blocker meanwhile is pure noise, and with dozens of
+        dependents re-registering on every scan it compounds into a
+        CheckStatus storm behind wedged fences (the seed-3 122k-message
+        grind; ref SimpleProgressLog waits for HasOutcome, then stands
+        down to local execution)."""
+        from ..local.status import Status
+        cmd = self.store.commands.get(txn_id)
+        return cmd is not None and (
+            cmd.save_status.status >= Status.PreApplied
+            or cmd.is_invalidated() or cmd.is_truncated())
+
     def _fetch(self, entry: _BlockedEntry) -> None:
         from ..coordinate.fetch_data import fetch_data
         from ..local.status import Status
         node = self.store.node
         txn_id = entry.txn_id
+
+        if self._local_knowledge_maximal(txn_id):
+            self.blocked.pop(txn_id, None)
+            return
 
         if entry.participants is None or entry.participants.is_empty():
             # we know the id but not where it lives: discover a route first
@@ -307,6 +327,8 @@ class SimpleProgressLog(api.ProgressLog):
                 participants) -> None:
         if participants is None or blocked_by in self.blocked:
             return
+        if self._local_knowledge_maximal(blocked_by):
+            return   # nothing fetchable: local drain owns its completion
         self.blocked[blocked_by] = _BlockedEntry(blocked_by, participants)
         self._arm()
 

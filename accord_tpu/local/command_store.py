@@ -261,7 +261,7 @@ class CommandStore:
         for tid in evictable:
             if excess <= 0:
                 break
-            rc = journal.reconstruct(self, tid)
+            rc = journal.reconstruct(self, tid, probe=True)
             if rc is None or rc.save_status is not \
                     self.commands[tid].save_status:
                 continue   # not faithfully reloadable: keep it in memory

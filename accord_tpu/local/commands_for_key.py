@@ -135,7 +135,13 @@ class CommandsForKey:
         else:
             prev = info.status
             info.status = max(info.status, status)   # never regress
-            if execute_at is not None and status.has_execute_at():
+            # the executeAt may only advance with the status grade: a late
+            # ACCEPTED-grade update carrying a *proposed* executeAt must not
+            # regress the decided executeAt of a COMMITTED+ entry (it would
+            # skew the elision pivot and recovery scans) — guard here rather
+            # than relying on every caller's ordering guards
+            if execute_at is not None and status.has_execute_at() \
+                    and (status >= prev or prev < InternalStatus.COMMITTED):
                 info.execute_at = execute_at
             if info.status is InternalStatus.INVALIDATED \
                     and InternalStatus.COMMITTED <= prev <= InternalStatus.APPLIED \

@@ -98,6 +98,11 @@ class Journal:
         self._bs_done: Dict[int, Ranges] = {}
         self._bs_marks: Dict[int, List[Tuple[Ranges, TxnId]]] = {}
         self.max_hlc = 0
+        # flush-before-issue HLC reservation: a true upper bound on every
+        # timestamp this node's past incarnations may have ISSUED (max_hlc
+        # only bounds what got journaled somewhere — a coordinator whose
+        # PreAccepts were all dropped could otherwise reissue a TxnId)
+        self.hlc_reserved = 0
         self.restoring = False
         # diagnostics: reconstructions that had to degrade status for lack
         # of a message body (should stay 0 in healthy runs)
@@ -201,6 +206,13 @@ class Journal:
         self._bs_done[store_id] = self._bs_done.get(
             store_id, Ranges.empty()).with_(ranges)
 
+    def reserve_hlc(self, bound: int) -> None:
+        """Batched id reservation: the node persists ``hlc + K`` before
+        handing out ids up to that bound, so a restart restores a true
+        upper bound on issued timestamps instead of a heuristic slack."""
+        if bound > self.hlc_reserved:
+            self.hlc_reserved = bound
+
     def _note_hlc(self, ts) -> None:
         h = ts.hlc()
         if h > self.max_hlc:
@@ -222,12 +234,16 @@ class Journal:
         if not any(txn_id in r for r in self._registers.values()):
             self._bodies.pop(txn_id, None)
 
-    def reconstruct(self, store, txn_id: TxnId) -> Optional[Command]:
+    def reconstruct(self, store, txn_id: TxnId,
+                    probe: bool = False) -> Optional[Command]:
         """Rebuild one command from registers + message bodies
         (ref: SerializerSupport.reconstruct).  WaitingOn is NOT built here —
         callers recompute it from the deps against current store state (the
         reference's waitingOnProvider), which also re-clears already-applied
-        dependencies."""
+        dependencies.  ``probe=True`` marks a fidelity check (page-out
+        eligibility) rather than a real restore: a degraded probe keeps the
+        command in memory and loses nothing, so it must not pollute the
+        ``degraded`` diagnostic that healthy runs assert stays 0."""
         reg = self._registers.get(store.store_id, {}).get(txn_id)
         if reg is None:
             return None
@@ -268,14 +284,16 @@ class Journal:
             if partial_deps is None:
                 # commit body lost (should not happen): degrade to
                 # PreCommitted and let the progress log re-fetch
-                self.degraded += 1
+                if not probe:
+                    self.degraded += 1
                 ss = SaveStatus.PreCommitted
         elif ss >= SaveStatus.Accepted and ss != SaveStatus.AcceptedInvalidate \
                 and ss != SaveStatus.AcceptedInvalidateWithDefinition:
             partial_deps = self._accept_deps(b, reg.accepted, owned)
         if ss >= SaveStatus.PreAccepted and partial_txn is None \
                 and ss.known.is_definition_known():
-            self.degraded += 1
+            if not probe:
+                self.degraded += 1
             return Command(txn_id, save_status=SaveStatus.NotDefined,
                            promised=reg.promised, durability=reg.durability,
                            route=route)
@@ -284,7 +302,8 @@ class Journal:
             writes, result = self._outcome(b)
             if writes is None and result is None \
                     and not txn_id.kind().is_sync_point():
-                self.degraded += 1
+                if not probe:
+                    self.degraded += 1
                 ss = SaveStatus.Stable if partial_deps is not None \
                     else SaveStatus.PreCommitted
         waiting_on = WaitingOn.none() if ss is SaveStatus.Applied else None

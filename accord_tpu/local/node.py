@@ -73,25 +73,39 @@ class Node:
         self.journal = journal
         self.alive = True
         self._hlc = 0
-        if journal is not None and journal.max_hlc:
+        self._hlc_reserved = 0
+        if journal is not None:
             # a restarted incarnation must never reissue a timestamp the
             # previous one used: the journal's high-water mark bounds every
-            # id this node witnessed OR issued-and-recorded; ids issued but
-            # never journaled anywhere are covered by the slack (ids per
-            # microsecond << 1000 in any workload we run)
-            self._hlc = journal.max_hlc + 1000
+            # id this node WITNESSED, and the flush-before-issue reservation
+            # (reserve_hlc) bounds every id a past incarnation ISSUED — even
+            # one whose PreAccepts were all dropped in a partition
+            self._hlc = max(journal.max_hlc + 1, journal.hlc_reserved)
+            self._hlc_reserved = journal.hlc_reserved
         self._coordinating: Dict[TxnId, object] = {}  # active coordinations
         self._pending_topologies: Dict[int, Topology] = {}  # out-of-order epochs
 
     # -- time (ref: Node.java:341-366) --------------------------------------
+    HLC_RESERVE_BATCH = 1 << 20   # ids per journal reservation write
+
+    def _reserve_hlc(self) -> None:
+        """Flush-before-issue, batched: before handing out an id at or past
+        the journaled reservation, persist a new bound ``hlc + K`` — one
+        journal write per ~million ids buys an exact restart floor."""
+        if self.journal is not None and self._hlc >= self._hlc_reserved:
+            self._hlc_reserved = self._hlc + self.HLC_RESERVE_BATCH
+            self.journal.reserve_hlc(self._hlc_reserved)
+
     def unique_now(self) -> Timestamp:
         now = self.now_micros()
         self._hlc = max(self._hlc + 1, now)
+        self._reserve_hlc()
         return Timestamp.from_values(self.epoch(), self._hlc, self.node_id)
 
     def unique_now_at_least(self, at_least: Timestamp) -> Timestamp:
         now = self.now_micros()
         self._hlc = max(self._hlc + 1, now, at_least.hlc() + 1)
+        self._reserve_hlc()
         epoch = max(self.epoch(), at_least.epoch())
         return Timestamp.from_values(epoch, self._hlc, self.node_id)
 

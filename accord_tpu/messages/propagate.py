@@ -38,6 +38,24 @@ class Propagate(Request):
         ok = self.ok
         txn_id = self.txn_id
         status = ok.save_status.status
+        # The store-selection window must reach the EXECUTION epoch: a store
+        # that witnessed the txn only through a later executeAt-epoch window
+        # (its Commit/Apply fan-outs span [txnId.epoch, executeAt.epoch])
+        # would otherwise never be selected here — fetched knowledge can't
+        # land, and the progress log re-fetches forever (a CheckStatus storm
+        # that wedged wide re-bootstraps).  Ref: Propagate.java:175-196
+        # extends toEpoch to executeAt.epoch() once the executeAt is decided.
+        to_epoch = txn_id.epoch()
+        if ok.execute_at is not None and ok.execute_at.epoch() > to_epoch:
+            if not node.topology().has_epoch(ok.execute_at.epoch()):
+                # don't silently narrow the window while this node's
+                # topology lags — defer until the execution epoch is known
+                # (ref: Propagate.java runs under withEpoch(toEpoch))
+                node.with_epoch(
+                    ok.execute_at.epoch(),
+                    lambda: self.process(node, from_id, reply_context))
+                return
+            to_epoch = ok.execute_at.epoch()
 
         def apply_fn(safe: SafeCommandStore):
             if status is Status.Invalidated:
@@ -51,7 +69,7 @@ class Propagate(Request):
             # ranges would create gap-divergent stale copies (the fan-out no
             # longer includes this node for those ranges).
             owned = safe.store.ranges_for_epoch.all_between(
-                _propagate_min_epoch(txn_id), txn_id.epoch())
+                _propagate_min_epoch(txn_id), to_epoch)
             partial_txn = ok.partial_txn.slice(owned, True)
             # Sync points (and plain reads) legitimately carry NO writes:
             # their apply must still run locally or a replica that lost the
@@ -88,7 +106,7 @@ class Propagate(Request):
                 commands.precommit(safe, txn_id, ok.execute_at)
 
         node.for_each_local(PreLoadContext.for_txn(txn_id), self.participants,
-                            _propagate_min_epoch(txn_id), txn_id.epoch(),
+                            _propagate_min_epoch(txn_id), to_epoch,
                             apply_fn)
 
     def __repr__(self):

@@ -57,7 +57,8 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
              node_ids=(1, 2, 3, 4, 5), rf: int = 3, shards: int = 4,
              workload_micros: int = 20_000_000,
              chaos: bool = True, churn: bool = True, restarts: bool = True,
-             drain_micros: int = 120_000_000) -> BurnResult:
+             drain_micros: int = 120_000_000,
+             probe=None, probe_micros: int = 0) -> BurnResult:
     rs = RandomSource(seed)
     topology = build_topology(1, node_ids, rf, shards)
     cluster = Cluster(topology=topology, seed=rs.next_int(1 << 30),
@@ -163,6 +164,10 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
 
     if chaos:
         cluster.queue.add(2_000_000, shake)
+
+    if probe is not None:
+        # diagnostics hook: inspect live cluster state at a fixed sim time
+        cluster.queue.add(probe_micros, lambda: probe(cluster))
 
     # topology churn: a few epochs during the workload
     def churn_once():

@@ -47,6 +47,26 @@ def test_burn_seed7_30ops_epoch_turnover():
     assert result.ops_unresolved == 0
 
 
+@pytest.mark.parametrize("seed", [3, 8, 15])
+def test_burn_endurance(seed):
+    """Endurance gate: 500 ops across a 60s workload window with chaos,
+    churn and restarts all on.  This is exactly the horizon where the
+    round-3 wedge lived (re-bootstrap fences stuck at ReadyToExecute behind
+    a CheckStatus refetch storm — seed 3 ground ~4 minutes wall); the
+    progress log standing down once local knowledge is maximal keeps the
+    fetch traffic bounded and the run converging promptly."""
+    result = run_burn(seed, n_ops=500, workload_micros=60_000_000)
+    assert result.ops_unresolved == 0, (
+        f"seed {seed}: {result.ops_unresolved} ops never resolved")
+    assert result.ops_ok >= 4 * result.ops_failed, f"seed {seed}: {result}"
+    # the refetch storm must stay dead: the healthy ceiling is a few
+    # CheckStatus per blocked txn, orders of magnitude below the 122k
+    # the wedge produced at this op count
+    assert result.stats.get("CheckStatus", 0) < 40_000, (
+        f"seed {seed}: CheckStatus storm is back: "
+        f"{result.stats.get('CheckStatus')}")
+
+
 @pytest.mark.parametrize("seed", [201, 202])
 def test_burn_big_cluster(seed):
     """Quorum geometry beyond rf=3 (ref: BurnTest rf 2..9): 7 nodes, rf 5,

@@ -208,3 +208,20 @@ def test_hot_key_dep_sets_stay_bounded():
     cfk.set_prune_before(tid(2_000))
     cfk.prune()
     assert cfk.size() <= 110
+
+
+def test_late_accepted_update_keeps_decided_execute_at():
+    """A stale ACCEPTED-grade update carrying a *proposed* executeAt must not
+    regress the decided executeAt of a COMMITTED+ entry (the elision pivot
+    and recovery scans key off it) — the guard lives in CFK.update itself,
+    not in its callers' ordering."""
+    cfk = CommandsForKey(7)
+    t = tid(100)
+    decided = ts(150)
+    cfk.update(t, InternalStatus.COMMITTED, execute_at=decided)
+    cfk.update(t, InternalStatus.ACCEPTED, execute_at=ts(999))
+    assert cfk._infos[t].execute_at == decided
+    assert cfk._infos[t].status is InternalStatus.COMMITTED
+    # a genuine later decision still advances it
+    cfk.update(t, InternalStatus.STABLE, execute_at=decided)
+    assert cfk._infos[t].status is InternalStatus.STABLE

@@ -171,3 +171,26 @@ def test_find_some_route_unknown_txn_returns_none():
     cluster.run_until_quiescent()
     assert out and out[0][1] is None
     assert out[0][0] is None
+
+def test_ephemeral_read_fails_rather_than_execute_stale_epoch():
+    """When epoch-bump retries are exhausted and a replica still reports a
+    later epoch, the read must FAIL (caller retries) — executing at the
+    known-stale epoch could miss writes committed under the newer topology
+    (ref: CoordinateEphemeralRead always executes at the latest reported
+    epoch, never a known-stale one)."""
+    from types import SimpleNamespace
+    from accord_tpu.coordinate.ephemeral import _EphemeralRead
+    from accord_tpu.coordinate.errors import Exhausted
+    from accord_tpu.utils import async_chain
+
+    er = _EphemeralRead.__new__(_EphemeralRead)
+    er.oks = [SimpleNamespace(latest_epoch=7)]
+    er.execution_epoch = 3
+    er.attempt = _EphemeralRead.MAX_EPOCH_RETRIES
+    er.done = False
+    er.txn_id = None
+    er.result = async_chain.AsyncResult()
+    out = []
+    er.result.begin(lambda r, f: out.append((r, f)))
+    er._on_deps()
+    assert out and isinstance(out[0][1], Exhausted)

@@ -255,3 +255,20 @@ def test_restart_mid_bootstrap_rebootstraps():
     assert out[0][0].reads == {700_000: ("b0",), 700_001: ("b1",),
                                700_002: ("b2",)}
     assert cluster.failures == []
+
+def test_restart_hlc_floor_covers_unjournaled_issues():
+    """A coordinator that issued TxnIds whose every message was dropped
+    (partition) must not reissue a duplicate id after restart: the journal's
+    flush-before-issue reservation (Journal.reserve_hlc) bounds ISSUED ids,
+    not just witnessed ones — the old max_hlc+slack heuristic broke once the
+    HLC ran further past the journal high-water than the slack."""
+    from accord_tpu.primitives.timestamp import Domain, TxnKind
+    cluster = make_cluster(seed=21)
+    run_workload(cluster, n=2)
+    node = cluster.nodes[1]
+    # issue far more ids than the old +1000 slack, journaling none of them
+    issued = [node.next_txn_id(TxnKind.Write, Domain.Key) for _ in range(5000)]
+    high = max(t.hlc() for t in issued)
+    cluster.restart_node(1)
+    fresh = cluster.nodes[1].next_txn_id(TxnKind.Write, Domain.Key)
+    assert fresh.hlc() > high, (fresh.hlc(), high)
