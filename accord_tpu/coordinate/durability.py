@@ -18,7 +18,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from ..api import Callback
-from ..messages.durability import (DurableBeforeReply, QueryDurableBefore,
+from ..messages.durability import (ApplyThenWaitUntilApplied,
+                                   DurableBeforeReply, QueryDurableBefore,
                                    SetGloballyDurable, SetShardDurable,
                                    WaitUntilApplied)
 from ..primitives.keys import Ranges
@@ -59,8 +60,17 @@ def coordinate_shard_durable(node, ranges: Ranges) -> async_chain.AsyncResult:
                     result.set_failure(failure)
 
         cb = WaitCallback()
+        if sync_point.execute_at is not None and sync_point.route is not None:
+            # fused leg (ref: ExecuteSyncPoint sends ApplyThenWaitUntilApplied):
+            # a replica that missed the Apply fan-out gets the decided
+            # executeAt+deps with the wait, instead of wedging until a fetch
+            request = ApplyThenWaitUntilApplied(
+                sync_id, sync_point.route, sync_point.execute_at,
+                sync_point.deps)
+        else:
+            request = WaitUntilApplied(sync_id, ranges)
         for to in sorted(tracker.nodes()):
-            node.send(to, WaitUntilApplied(sync_id, ranges), cb)
+            node.send(to, request, cb)
 
     coordinate_sync_point(node, ranges, exclusive=True).begin(on_sync_point)
     return result

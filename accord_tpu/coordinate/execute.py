@@ -159,8 +159,16 @@ class _ExecuteTxn(api.Callback):
                   if self.txn.query is not None else None)
         persist(self.node, self.txn_id, self.txn, self.route, self.execute_at,
                 self.deps, writes, result)
-        # client is answered at persist-start (ref: CoordinationAdapter:189-194)
-        self.result.set_success(result)
+        # client is answered at persist-start (ref: CoordinationAdapter:189-194).
+        # Sync points settle with their coordination handle so callers (the
+        # durability rounds, bootstrap) can hand the decided executeAt+deps
+        # to the fused ApplyThenWaitUntilApplied leg (ref: SyncPoint.java).
+        if result is None and self.txn_id.kind().is_sync_point():
+            from ..primitives.writes import SyncPoint
+            self.result.set_success(SyncPoint(self.txn_id, self.deps,
+                                              self.route, self.execute_at))
+        else:
+            self.result.set_success(result)
 
     def _fail(self, exc: BaseException) -> None:
         if not self.done:

@@ -209,17 +209,26 @@ class Recover(api.Callback):
                 self.result.set_success(("invalidated", None))
                 return
             if status in (Status.Applied, Status.PreApplied):
-                deps = _merge_committed_deps(self.oks)
                 node.with_epoch(max_ok.execute_at.epoch(), lambda: (
-                    _repersist(node, txn_id, self.txn, self.route,
-                               max_ok, deps, self.result)))
+                    _merge_committed_deps(
+                        node, txn_id, self.txn, self.route, self.oks,
+                        max_ok.execute_at,
+                        lambda deps, fail:
+                        self.result.set_failure(fail) if fail is not None
+                        else _repersist(node, txn_id, self.txn, self.route,
+                                        max_ok, deps, self.result))))
                 return
             if status in (Status.Stable, Status.Committed, Status.PreCommitted):
-                deps = _merge_committed_deps(self.oks)
                 node.with_epoch(max_ok.execute_at.epoch(), lambda: (
-                    Adapters.recovery.execute(node, txn_id, self.txn, self.route,
+                    _merge_committed_deps(
+                        node, txn_id, self.txn, self.route, self.oks,
+                        max_ok.execute_at,
+                        lambda deps, fail:
+                        self.result.set_failure(fail) if fail is not None
+                        else Adapters.recovery.execute(
+                            node, txn_id, self.txn, self.route,
                             max_ok.execute_at, deps, ballot=self.ballot)
-                    .begin(self._executed)))
+                        .begin(self._executed))))
                 return
             if status is Status.Accepted:
                 deps = _merge_proposal_deps(self.oks)
@@ -304,24 +313,52 @@ def _max_accepted_or_later(oks: List[RecoverOk]) -> Optional[RecoverOk]:
     return best
 
 
-def _merge_committed_deps(oks: List[RecoverOk]) -> Deps:
-    """LatestDeps.mergeCommit: decided deps win for the ranges they cover;
-    ranges with no decided coverage anywhere in the quorum fall back to the
-    union of proposals (a safe superset) — never silently empty."""
+def _merge_committed_deps(node, txn_id: TxnId, txn, route,
+                          oks: List[RecoverOk], execute_at,
+                          cont) -> None:
+    """LatestDeps.mergeCommit (ref: LatestDeps.java:40 + Recover.java:339-360):
+    the ballot-aware per-range merge, then CollectDeps for any range the
+    quorum's knowledge is NOT sufficient for (possible when executeAt moved
+    past txnId and no reply holds decided deps for a shard) — local scans
+    are only commit-equivalent when executeAt == txnId."""
+    from ..primitives.latest_deps import LatestDeps
+    merged = LatestDeps.merge_all([ok.latest_deps for ok in oks])
+    deps, sufficient = merged.merge_commit(accept_local=(execute_at == txn_id))
+    required = _required_ranges(route)
+    missing = required.without(sufficient)
+    if missing.is_empty():
+        cont(deps, None)
+        return
+    from .collect_deps import collect_deps
+    keys = txn.keys.slice(missing)
+
+    def on_collected(extra, failure):
+        if failure is not None:
+            cont(None, failure)
+            return
+        extra_deps = (Deps(extra.key_deps, extra.range_deps)
+                      if extra is not None else Deps.none())
+        cont(deps.with_(extra_deps), None)
+
+    collect_deps(node, txn_id, route, keys, execute_at).begin(on_collected)
+
+
+def _required_ranges(route: Route):
+    """The token coverage recovery's deps must span: the route participants
+    as canonical ranges."""
     from ..primitives.keys import Ranges
-    decided = Deps.merge([ok.decided_deps for ok in oks])
-    covering = Ranges.empty()
-    for ok in oks:
-        covering = covering.with_(ok.decided_covering)
-    proposals = Deps.merge([ok.proposed_deps for ok in oks])
-    return decided.with_(proposals.without_covered(covering))
+    p = route.participants
+    return p if isinstance(p, Ranges) else p.to_ranges()
 
 
 def _merge_proposal_deps(oks: List[RecoverOk]) -> Deps:
-    """LatestDeps.mergeProposal approximation: union of every proposal and
-    decided slice — a safe superset."""
-    return Deps.merge([ok.proposed_deps for ok in oks]
-                      + [ok.decided_deps for ok in oks])
+    """LatestDeps.mergeProposal (ref: LatestDeps.java:40): per range the
+    highest-ballot proposal wins outright; local witness scans fill only
+    unproposed ranges.  (The round-3 union-superset approximation could
+    over-constrain execution order after recovery under contention.)"""
+    from ..primitives.latest_deps import LatestDeps
+    return LatestDeps.merge_all(
+        [ok.latest_deps for ok in oks]).merge_proposal()
 
 
 def _repersist(node, txn_id, txn, route, max_ok: RecoverOk, deps: Deps,

@@ -40,10 +40,15 @@ def coordinate_sync_point(node, ranges: Ranges,
     if txn_id is None:
         txn_id = node.next_txn_id(kind, Domain.Range)
 
-    def on_done(_value, failure):
+    def on_done(value, failure):
         if failure is not None:
             result.set_failure(failure)
+        elif isinstance(value, SyncPoint):
+            result.set_success(value)
         else:
+            # recovery completed the coordination on our behalf: the handle
+            # carries no deps/executeAt (callers fall back to the plain
+            # wait-until-applied leg)
             result.set_success(SyncPoint(txn_id, None, None))
 
     node.coordinate(txn, txn_id=txn_id).begin(on_done)

@@ -240,6 +240,14 @@ class SimpleProgressLog(api.ProgressLog):
                     or merged.save_status.status is Status.Invalidated):
                 # outcome propagated locally: no longer blocked
                 self.blocked.pop(txn_id, None)
+                # remotely-established durability the home shard may have
+                # missed: tell it directly so its progress log stands down
+                # (ref: messages/InformHomeDurable.java)
+                from ..local.status import Durability
+                if merged.route is not None \
+                        and merged.route.home_key is not None \
+                        and merged.durability >= Durability.Majority:
+                    self._inform_home_durable(txn_id, merged)
             else:
                 # known but undecided: recovery is the home shard's job —
                 # kick it (ref: InformHomeOfTxn) and keep fetching until the
@@ -262,6 +270,19 @@ class SimpleProgressLog(api.ProgressLog):
 
         fetch_data(node, txn_id, entry.participants, txn_id.epoch()) \
             .begin(on_done)
+
+    def _inform_home_durable(self, txn_id: TxnId, merged) -> None:
+        from ..messages.inform import InformHomeDurable
+        from ..primitives.keys import Ranges
+        node = self.store.node
+        route = merged.route
+        request = InformHomeDurable(txn_id, route, merged.execute_at,
+                                    merged.durability)
+        topology = node.topology_manager.current()
+        home = Ranges.of(route.home_as_range())
+        for shard in topology.for_selection(home):
+            for to in shard.nodes:
+                node.send(to, request)
 
     def _inform_home(self, txn_id: TxnId, route) -> None:
         """Tell the home shard's replicas to track (and so recover) the txn

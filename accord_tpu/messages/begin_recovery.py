@@ -28,6 +28,7 @@ from ..local.command_store import PreLoadContext, SafeCommandStore
 from ..local.status import Status
 from ..primitives.deps import Deps, DepsBuilder, PartialDeps
 from ..primitives.keys import Range, Ranges, Route
+from ..primitives.latest_deps import DECIDED, LOCAL, PROPOSED, LatestDeps
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
 from .base import MessageType, Reply, TxnRequest
@@ -48,17 +49,16 @@ class RecoverNack(Reply):
 
 
 class RecoverOk(Reply):
-    """Recovery vote.  Deps are reported in two tiers so the coordinator can
-    merge per-range (ref: LatestDeps): ``decided_deps`` are committed deps
-    for the ranges in ``decided_covering``; ``proposed_deps`` are
-    preaccept/accept-stage proposals for everything else."""
+    """Recovery vote.  Deps are reported as a per-range LatestDeps map
+    (ref: LatestDeps.java) so the coordinator's merge is ballot-aware per
+    range segment: decided ranges carry the agreed set; accepted ranges the
+    proposal under its ballot; the rest the replica's local witness scan."""
 
     type = MessageType.BEGIN_RECOVER_RSP
 
     def __init__(self, txn_id: TxnId, status: Status, accepted: Ballot,
                  execute_at: Optional[Timestamp],
-                 decided_deps: Deps, decided_covering: Ranges,
-                 proposed_deps: Deps,
+                 latest_deps,
                  earlier_committed_witness: Deps,
                  earlier_accepted_no_witness: Deps,
                  rejects_fast_path: bool, writes, result):
@@ -66,9 +66,7 @@ class RecoverOk(Reply):
         self.status = status
         self.accepted = accepted
         self.execute_at = execute_at
-        self.decided_deps = decided_deps
-        self.decided_covering = decided_covering
-        self.proposed_deps = proposed_deps
+        self.latest_deps = latest_deps
         self.earlier_committed_witness = earlier_committed_witness
         self.earlier_accepted_no_witness = earlier_accepted_no_witness
         self.rejects_fast_path = rejects_fast_path
@@ -173,7 +171,7 @@ class BeginRecovery(TxnRequest):
                 # rejects_fast_path here could invalidate a transaction
                 # that fast-committed at a quorum that excludes us.
                 return RecoverOk(txn_id, Status.NotDefined, Ballot.ZERO, None,
-                                 Deps.none(), Ranges.empty(), Deps.none(),
+                                 LatestDeps.none(),
                                  Deps.none(), Deps.none(), False, None, None)
 
             cmd = safe.get(txn_id)
@@ -184,16 +182,29 @@ class BeginRecovery(TxnRequest):
             if deps_decided:
                 decided = Deps(cmd.partial_deps.key_deps,
                                cmd.partial_deps.range_deps)
-                covering = owned
-                proposed = Deps.none()
+                latest = LatestDeps.create(owned, DECIDED, Ballot.ZERO,
+                                           decided, None)
             else:
                 local = calculate_partial_deps(safe, txn_id, partial_txn.keys,
                                                txn_id, owned)
+                local_deps = Deps(local.key_deps, local.range_deps)
                 prior = cmd.partial_deps
-                merged = (local if prior is None else local.with_partial(prior))
-                decided = Deps.none()
-                covering = Ranges.empty()
-                proposed = Deps(merged.key_deps, merged.range_deps)
+                # ONLY a live Accept proposal ranks as PROPOSED:
+                # AcceptedInvalidate retains the pre-invalidate partial_deps
+                # but carries NO deps knowledge (Known.Nothing) — reporting
+                # them under the (higher) invalidation ballot would let a
+                # stale superseded proposal outrank a genuine Accept that
+                # may have committed on a quorum excluding this replica
+                if cmd.status is Status.Accepted and prior is not None:
+                    # an Accept-phase proposal under cmd.accepted: the
+                    # coordinator's per-range merge takes the HIGHEST ballot
+                    # proposal, not the union (ref: DepsProposed entries)
+                    latest = LatestDeps.create(
+                        owned, PROPOSED, cmd.accepted,
+                        Deps(prior.key_deps, prior.range_deps), local_deps)
+                else:
+                    latest = LatestDeps.create(owned, LOCAL, Ballot.ZERO,
+                                               None, local_deps)
 
             if cmd.has_been(Status.PreCommitted):
                 rejects, ecw, eanw = False, Deps.none(), Deps.none()
@@ -201,7 +212,7 @@ class BeginRecovery(TxnRequest):
                 rejects, ecw, eanw = _recovery_scans(safe, txn_id,
                                                      partial_txn.keys)
             return RecoverOk(txn_id, cmd.status, cmd.accepted, cmd.execute_at,
-                             decided, covering, proposed, ecw, eanw, rejects,
+                             latest, ecw, eanw, rejects,
                              cmd.writes, cmd.result)
 
         def reduce_fn(a, b):
@@ -226,9 +237,7 @@ class BeginRecovery(TxnRequest):
                     and (execute_at is None or lo.execute_at > execute_at):
                 execute_at = lo.execute_at
             return RecoverOk(txn_id, hi.status, hi.accepted, execute_at,
-                             hi.decided_deps.with_(lo.decided_deps),
-                             hi.decided_covering.with_(lo.decided_covering),
-                             hi.proposed_deps.with_(lo.proposed_deps),
+                             hi.latest_deps.merge(lo.latest_deps),
                              ecw, eanw,
                              hi.rejects_fast_path or lo.rejects_fast_path,
                              hi.writes or lo.writes, hi.result or lo.result)

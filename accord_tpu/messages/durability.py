@@ -40,6 +40,7 @@ class WaitUntilApplied(TxnRequest):
         super().__init__(txn_id, Route(None, participants, is_full=False),
                          txn_id.epoch())
         self.participants = participants
+        self.max_epoch = txn_id.epoch()   # widened by the fused subclass
 
     def process(self, node, from_id: int, reply_context) -> None:
         txn_id = self.txn_id
@@ -80,7 +81,52 @@ class WaitUntilApplied(TxnRequest):
 
         node.map_reduce_consume_local(
             PreLoadContext.for_txn(txn_id), self.participants,
-            txn_id.epoch(), txn_id.epoch(), map_fn, lambda a, b: None, consume)
+            txn_id.epoch(), self.max_epoch, map_fn, lambda a, b: None, consume)
+
+
+class ApplyThenWaitUntilApplied(WaitUntilApplied):
+    """The fused sync-point execution leg (ref: messages/
+    ApplyThenWaitUntilApplied.java, sent by ExecuteSyncPoint): deliver the
+    sync point's Apply and reply once it has applied on every intersecting
+    local store.  A replica that missed earlier rounds gets the decided
+    executeAt + deps directly instead of needing a fetch to unwedge the
+    wait leg."""
+
+    type = MessageType.APPLY_THEN_WAIT_UNTIL_APPLIED_REQ
+
+    def __init__(self, txn_id: TxnId, route, execute_at, deps):
+        TxnRequest.__init__(self, txn_id, route, execute_at.epoch())
+        self.participants = route.participants
+        self.max_epoch = max(txn_id.epoch(), execute_at.epoch())
+        # mirror Apply's journaled-body surface (journal._outcome and
+        # reconstruction read these fields from _APPLY_TYPES messages)
+        self.kind = "minimal"
+        self.execute_at = execute_at
+        self.deps = deps
+        self.writes = None
+        self.result = None
+        self.txn = None
+        self.min_epoch = txn_id.epoch()
+
+    def process(self, node, from_id: int, reply_context) -> None:
+        from ..local import commands
+        min_epoch, max_epoch = self.min_epoch, self.max_epoch
+
+        def apply_fn(safe: SafeCommandStore):
+            owned = safe.store.ranges_for_epoch.all_between(min_epoch,
+                                                            max_epoch)
+            partial_deps = (self.deps.slice(owned)
+                            if self.deps is not None else None)
+            # Insufficient (store lacks the definition) is fine here: the
+            # wait leg below keeps listening and the progress log fetches
+            commands.apply(safe, self.txn_id, self.route, self.execute_at,
+                           partial_deps, None, None, None)
+
+        node.for_each_local(
+            PreLoadContext.for_txn(self.txn_id), self.participants,
+            min_epoch, max_epoch, apply_fn).begin(
+                lambda _r, _f: WaitUntilApplied.process(
+                    self, node, from_id, reply_context))
 
 
 class SetShardDurable(TxnRequest):

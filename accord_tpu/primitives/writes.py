@@ -46,14 +46,16 @@ class Writes:
 
 class SyncPoint:
     """Handle for a coordinated (exclusive) sync point over some ranges
-    (ref: SyncPoint.java): txnId + agreed deps + route."""
+    (ref: SyncPoint.java): txnId + agreed deps + route + decided
+    executeAt."""
 
-    __slots__ = ("sync_id", "deps", "route")
+    __slots__ = ("sync_id", "deps", "route", "execute_at")
 
-    def __init__(self, sync_id: TxnId, deps, route: Route):
+    def __init__(self, sync_id: TxnId, deps, route: Route, execute_at=None):
         self.sync_id = sync_id
         self.deps = deps
         self.route = route
+        self.execute_at = execute_at
 
     def __repr__(self):
         return f"SyncPoint({self.sync_id})"

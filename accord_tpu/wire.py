@@ -177,6 +177,23 @@ register(RangeDeps, "RD", _enc_range_deps, _dec_range_deps)
 register_fields(Deps, ["key_deps", "range_deps"])
 register_fields(PartialDeps, ["covering", "key_deps", "range_deps"])
 
+def _register_latest_deps() -> None:
+    from .primitives.latest_deps import LatestDeps, LatestEntry
+    from .utils.interval_map import ReducingRangeMap
+    register(LatestEntry, "LDE",
+             lambda e: {"k": e.known, "b": encode(e.ballot),
+                        "c": encode(e.coordinated), "l": encode(e.local)},
+             lambda d: LatestEntry(d["k"], decode(d["b"]), decode(d["c"]),
+                                   decode(d["l"])))
+    register(LatestDeps, "LD",
+             lambda ld: {"b": list(ld.map.boundaries),
+                         "v": [encode(v) for v in ld.map.values]},
+             lambda d: LatestDeps(ReducingRangeMap(
+                 d["b"], [decode(v) for v in d["v"]])))
+
+
+_register_latest_deps()
+
 register_fields(Txn, ["kind", "keys", "read", "update", "query"])
 register_fields(PartialTxn,
                 ["covering", "kind", "keys", "read", "update", "query"])
@@ -237,8 +254,7 @@ def _register_messages() -> None:
                     ["txn_id", "txn", "route", "ballot"])
     register_fields(begin_recovery.RecoverOk,
                     ["txn_id", "status", "accepted", "execute_at",
-                     "decided_deps", "decided_covering", "proposed_deps",
-                     "earlier_committed_witness",
+                     "latest_deps", "earlier_committed_witness",
                      "earlier_accepted_no_witness", "rejects_fast_path",
                      "writes", "result"])
     register_fields(begin_recovery.RecoverNack, ["superseded_by"])
@@ -255,12 +271,22 @@ def _register_messages() -> None:
     register_fields(check_status.CheckStatusNack, [])
 
     register_fields(inform.InformDurable, ["txn_id", "route", "durability"])
+    register_fields(inform.InformHomeDurable,
+                    ["txn_id", "route", "execute_at", "durability"])
     register_fields(inform.InformOfTxnId, ["txn_id", "route"])
+
+    from .messages import get_deps as gd
+    register_fields(gd.GetDeps, ["txn_id", "route", "keys", "execute_at"])
+    register_fields(gd.GetDepsOk, ["deps"])
+    register_fields(gd.GetMaxConflict, ["participants", "execution_epoch"])
+    register_fields(gd.GetMaxConflictOk, ["max_conflict", "latest_epoch"])
 
     from .messages import durability as dur
     register_fields(dur.WaitUntilApplied, [("txn_id", "txn_id"),
                                            "participants"])
     register_fields(dur.WaitUntilAppliedOk, [])
+    register_fields(dur.ApplyThenWaitUntilApplied,
+                    ["txn_id", "route", "execute_at", "deps"])
     register_fields(dur.SetShardDurable, [("txn_id", "sync_id"), "ranges"])
     register_fields(dur.QueryDurableBefore, ["epoch"])
     register_fields(dur.DurableBeforeReply, ["entries"])

@@ -203,3 +203,54 @@ def test_burn_bounded_state(device_mode, n_ops, monkeypatch):
         # bounded, hence the slack in the bound.
         assert cmds < n_ops * 8 // 5, f"node {nid}: {cmds} command records"
         assert cfks < n_ops * 2, f"node {nid}: {cfks} CFK entries retained"
+
+
+def test_get_deps_probe_witnesses_committed_writes():
+    """collect_deps (ref: CollectDeps.withDeps -> GetDeps.java) must return
+    deps including an applied conflicting write for the probed keys."""
+    from accord_tpu.coordinate.collect_deps import collect_deps
+    from accord_tpu.primitives.timestamp import Domain, TxnKind
+    cluster = make_cluster(seed=31)
+    out = []
+    cluster.nodes[1].coordinate(kv_txn([10], {10: ("w",)})).begin(
+        lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+    node = cluster.nodes[2]
+    probe_id = node.next_txn_id(TxnKind.Read, Domain.Key)
+    txn = kv_txn([10], {})
+    route = node.compute_route(probe_id, txn.keys)
+    got = []
+    collect_deps(node, probe_id, route, txn.keys, node.unique_now()).begin(
+        lambda deps, f: got.append((deps, f)))
+    cluster.run_until_quiescent()
+    deps, failure = got[0]
+    assert failure is None
+    assert any(d.kind() is TxnKind.Write
+               for d in deps.key_deps.txn_ids_for(10)), deps.key_deps.txn_ids
+
+def test_fetch_max_conflict_covers_applied_write():
+    """fetch_max_conflict (ref: FetchMaxConflict.java -> GetMaxConflict.java)
+    must report a timestamp at or above the executeAt of an applied write in
+    the probed ranges."""
+    from accord_tpu.coordinate.collect_deps import fetch_max_conflict
+    from accord_tpu.primitives.timestamp import Timestamp
+    cluster = make_cluster(seed=32)
+    out = []
+    cluster.nodes[1].coordinate(kv_txn([10], {10: ("w",)})).begin(
+        lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+    got = []
+    fetch_max_conflict(cluster.nodes[3], Ranges.of(Range(0, 100))).begin(
+        lambda ts, f: got.append((ts, f)))
+    cluster.run_until_quiescent()
+    ts, failure = got[0]
+    assert failure is None
+    assert ts > Timestamp.NONE
+    # at least as high as the applied write's executeAt on any replica
+    hi = max(cmd.execute_at for n in cluster.nodes.values()
+             for s in n.command_stores.unsafe_all_stores()
+             for cmd in s.commands.values()
+             if cmd.execute_at is not None and cmd.txn_id.kind().is_write())
+    assert ts >= hi, (ts, hi)

@@ -64,11 +64,36 @@ def test_wire_round_trips_live_protocol_traffic(monkeypatch):
     barrier(cluster.nodes[1], Ranges.of(Range(0, 1_000_000)),
             global_=True).begin(lambda r, f: out.append((r, f)))
     cluster.run_until_quiescent()
+    # the deps/conflict probes and the fused shard-durable round
+    # (ref: GetDeps.java, GetMaxConflict.java, ApplyThenWaitUntilApplied.java)
+    from accord_tpu.coordinate.collect_deps import (collect_deps,
+                                                    fetch_max_conflict)
+    from accord_tpu.coordinate.durability import coordinate_shard_durable
+    from accord_tpu.primitives.timestamp import Domain, TxnKind
+    node1 = cluster.nodes[1]
+    probe_id = node1.next_txn_id(TxnKind.Read, Domain.Key)
+    probe_route = node1.compute_route(probe_id, kv_txn([10, 20], {}).keys)
+    collect_deps(node1, probe_id, probe_route, kv_txn([10, 20], {}).keys,
+                 node1.unique_now()).begin(lambda r, f: out.append((r, f)))
+    fetch_max_conflict(node1, Ranges.of(Range(0, 100))).begin(
+        lambda r, f: out.append((r, f)))
+    coordinate_shard_durable(node1, Ranges.of(Range(0, 1_000_000))).begin(
+        lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    # home-durability gossip (ref: InformHomeDurable.java)
+    from accord_tpu.local.status import Durability
+    from accord_tpu.messages.inform import InformHomeDurable
+    wtxn = next(m for m in seen if type(m).__name__ == "Apply")
+    cluster.nodes[2].send(1, InformHomeDurable(
+        wtxn.txn_id, wtxn.route, wtxn.execute_at, Durability.Majority))
+    cluster.run_until_quiescent()
     assert cluster.failures == []
     assert all(f is None for _r, f in out), out
     names = {type(m).__name__ for m in seen}
     assert {"GetEphemeralReadDeps", "ReadEphemeralTxnData",
-            "WaitUntilApplied"} <= names, names
+            "WaitUntilApplied", "GetDeps", "GetDepsOk", "GetMaxConflict",
+            "GetMaxConflictOk", "ApplyThenWaitUntilApplied",
+            "InformHomeDurable", "SetShardDurable"} <= names, names
     assert len(seen) > 50
     for msg in seen:
         doc = json.loads(json.dumps(wire.encode(msg)))

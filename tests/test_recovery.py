@@ -245,34 +245,136 @@ def test_recovery_rank_ballot_tie_break():
     assert _max_accepted_or_later([pre]) is None
 
 
-def test_merge_committed_deps_fills_uncovered_ranges():
-    """Decided deps win only for the ranges they cover; proposals must
-    survive for uncovered shards (two-shard txn, Commit reached one shard)."""
-    from accord_tpu.coordinate.recover import _merge_committed_deps
+def _tid(hlc, node=2):
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    return TxnId.create(1, hlc, TxnKind.Write, Domain.Key, node)
+
+
+def _ballot(n):
+    from accord_tpu.primitives.timestamp import Ballot
+    return Ballot(0, n, 1)
+
+
+def test_latest_deps_merge_commit_fills_uncovered_ranges():
+    """Decided deps win for the segments they cover; a shard with only
+    local knowledge survives via the fallback when executeAt == txnId
+    (accept_local), and is reported NOT sufficient otherwise
+    (ref: LatestDeps.forCommit)."""
+    from accord_tpu.primitives.deps import DepsBuilder
+    from accord_tpu.primitives.keys import Range, Ranges
+    from accord_tpu.primitives.latest_deps import (DECIDED, LOCAL,
+                                                   LatestDeps)
+    from accord_tpu.primitives.timestamp import Ballot
+
+    dep_a, dep_b = _tid(50), _tid(60, 3)
+    decided = DepsBuilder().add_key(5, dep_a).build()
+    local = DepsBuilder().add_key(5, dep_a).add_key(15, dep_b).build()
+    merged = LatestDeps.merge_all([
+        LatestDeps.create(Ranges.single(0, 10), DECIDED, Ballot.ZERO,
+                          decided, None),
+        LatestDeps.create(Ranges.of(Range(0, 10), Range(10, 20)), LOCAL,
+                          Ballot.ZERO, None, local)])
+    deps, sufficient = merged.merge_commit(accept_local=True)
+    assert deps.contains(dep_a)
+    assert deps.contains(dep_b), "uncovered shard's local scan was lost"
+    assert deps.key_deps.txn_ids_for(5) == [dep_a]
+    assert sufficient.contains_token(5) and sufficient.contains_token(15)
+    # executeAt != txnId: the local-only shard is NOT commit-sufficient —
+    # recovery must CollectDeps it (ref: Recover.java:353)
+    deps2, sufficient2 = merged.merge_commit(accept_local=False)
+    assert sufficient2.contains_token(5)
+    assert not sufficient2.contains_token(15)
+    assert not deps2.contains(dep_b)
+
+
+def test_latest_deps_ballot_aware_proposal_differs_from_union():
+    """The VERDICT-pinned case: two Accept-phase proposals for one range
+    under different ballots.  The union approximation keeps both deps; the
+    ballot-aware merge keeps ONLY the higher ballot's proposal
+    (ref: LatestDeps.java DepsProposed tie-break)."""
     from accord_tpu.primitives.deps import Deps, DepsBuilder
-    from accord_tpu.primitives.keys import Ranges, Range
-    from accord_tpu.primitives.timestamp import Ballot, Domain, TxnId, TxnKind
+    from accord_tpu.primitives.keys import Ranges
+    from accord_tpu.primitives.latest_deps import PROPOSED, LatestDeps
 
-    dep_a = TxnId.create(1, 50, TxnKind.Write, Domain.Key, 2)
-    dep_b = TxnId.create(1, 60, TxnKind.Write, Domain.Key, 3)
-    decided = DepsBuilder().add_key(5, dep_a).build()     # shard A: tokens 0-10
-    proposed = DepsBuilder().add_key(5, dep_a).add_key(15, dep_b).build()
+    dep_lo, dep_hi = _tid(50), _tid(60, 3)
+    prop_lo = DepsBuilder().add_key(5, dep_lo).build()
+    prop_hi = DepsBuilder().add_key(5, dep_hi).build()
+    r = Ranges.single(0, 10)
+    merged = LatestDeps.merge_all([
+        LatestDeps.create(r, PROPOSED, _ballot(1), prop_lo, None),
+        LatestDeps.create(r, PROPOSED, _ballot(2), prop_hi, None)])
+    got = merged.merge_proposal()
+    union = Deps.merge([prop_lo, prop_hi])
+    assert union.contains(dep_lo) and union.contains(dep_hi)
+    assert got.contains(dep_hi)
+    assert not got.contains(dep_lo), (
+        "superseded lower-ballot proposal leaked into the recovery proposal")
+    # merge is commutative
+    swapped = LatestDeps.merge_all([
+        LatestDeps.create(r, PROPOSED, _ballot(2), prop_hi, None),
+        LatestDeps.create(r, PROPOSED, _ballot(1), prop_lo, None)])
+    assert swapped.merge_proposal().contains(dep_hi)
+    assert not swapped.merge_proposal().contains(dep_lo)
 
-    class Ok:
-        def __init__(self, dd, cov, pd):
-            self.decided_deps = dd
-            self.decided_covering = cov
-            self.proposed_deps = pd
 
-    oks = [Ok(decided, Ranges.single(0, 10), Deps.none()),
-           Ok(Deps.none(), Ranges.empty(), proposed)]
-    merged = _merge_committed_deps(oks)
-    # decided entry kept; shard-B proposal (token 15, dep_b) NOT dropped
-    assert merged.contains(dep_a)
-    assert merged.contains(dep_b), "uncovered shard's proposal was lost"
-    # but the proposal duplicate inside covered ranges doesn't resurrect
-    # anything beyond the decided set for token 5
-    assert merged.key_deps.txn_ids_for(5) == [dep_a]
+def test_latest_deps_randomized_vs_model():
+    """Randomized reconciliation of the interval merge against a
+    brute-force per-token model (the reference's ReducingRangeMap merge
+    semantics evaluated pointwise)."""
+    import random as _random
+    from accord_tpu.primitives.deps import Deps, DepsBuilder
+    from accord_tpu.primitives.keys import Range, Ranges
+    from accord_tpu.primitives.latest_deps import (DECIDED, LOCAL, PROPOSED,
+                                                   LatestDeps)
+
+    rng = _random.Random(42)
+    TOKENS = list(range(0, 40))
+    for trial in range(60):
+        entries = []
+        for _ in range(rng.randint(1, 5)):
+            lo = rng.randrange(0, 38)
+            hi = rng.randrange(lo + 1, 41)
+            grade = rng.choice([LOCAL, PROPOSED, DECIDED])
+            ballot = _ballot(rng.randint(1, 4))
+            dep = _tid(10 + rng.randrange(90), 1 + rng.randrange(4))
+            deps = DepsBuilder().add_key(rng.choice(TOKENS), dep).build()
+            coord = deps if grade >= PROPOSED else None
+            local = deps if grade <= PROPOSED else None
+            entries.append((Ranges.single(lo, hi), grade, ballot, coord,
+                            local))
+        merged = LatestDeps.merge_all([
+            LatestDeps.create(*e) for e in entries])
+        # pointwise model: per token, winner = max (grade, ballot-if-proposed)
+        for token in TOKENS:
+            covering = [e for e in entries if e[0].contains_token(token)]
+            got = merged.map.get(token)
+            if not covering:
+                assert got is None
+                continue
+            def rank(e):
+                return (e[1], e[2] if e[1] is PROPOSED else _ballot(0))
+
+            win_rank = max(rank(e) for e in covering)
+            winners = [e for e in covering if rank(e) == win_rank]
+            assert got.known == win_rank[0], (trial, token)
+            if win_rank[0] is PROPOSED:
+                assert got.ballot == win_rank[1], (trial, token)
+            # the kept coordinated deps at this token must be exactly SOME
+            # max-rank entry's (ties broken arbitrarily but never unioned)
+            have_coord = set(got.coordinated.key_deps.txn_ids_for(token)
+                             if got.coordinated is not None else [])
+            want_options = [set(e[3].key_deps.txn_ids_for(token))
+                            if e[3] is not None else set() for e in winners]
+            assert have_coord in want_options, (trial, token)
+            # below DECIDED, locals union across every covering entry
+            if got.known < DECIDED:
+                model_local = set()
+                for e in covering:
+                    if e[4] is not None:
+                        model_local |= set(e[4].key_deps.txn_ids_for(token))
+                have_local = set(got.local.key_deps.txn_ids_for(token)
+                                 if got.local is not None else [])
+                assert have_local == model_local, (trial, token)
 
 
 def test_recovery_determinism():
