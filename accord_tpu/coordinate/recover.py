@@ -340,7 +340,12 @@ def _merge_committed_deps(node, txn_id: TxnId, txn, route,
                       if extra is not None else Deps.none())
         cont(deps.with_(extra_deps), None)
 
-    collect_deps(node, txn_id, route, keys, execute_at).begin(on_collected)
+    # slice the route to the missing ranges: only their shards owe a
+    # quorum (an unrelated shard without one must not fail the recovery,
+    # and its replicas need not be asked at all — ref CollectDeps scopes
+    # to the uncovered ranges)
+    collect_deps(node, txn_id, route.slice(missing), keys,
+                 execute_at).begin(on_collected)
 
 
 def _required_ranges(route: Route):

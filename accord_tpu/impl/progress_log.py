@@ -278,7 +278,14 @@ class SimpleProgressLog(api.ProgressLog):
         route = merged.route
         request = InformHomeDurable(txn_id, route, merged.execute_at,
                                     merged.durability)
-        topology = node.topology_manager.current()
+        # resolve home-shard owners AT the txn's epoch — the receiver
+        # applies over stores owning the home range at txn_id.epoch(), so
+        # targeting current-epoch owners would no-op after the home range
+        # moves (and the real home would never hear)
+        manager = node.topology_manager
+        if not manager.has_epoch(txn_id.epoch()):
+            return
+        topology = manager.get_topology_for_epoch(txn_id.epoch())
         home = Ranges.of(route.home_as_range())
         for shard in topology.for_selection(home):
             for to in shard.nodes:

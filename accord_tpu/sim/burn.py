@@ -121,23 +121,48 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
               "done": False, "writes": writes, "keys": keys, "node": node_id}
         outstanding.append(op)
 
-        def on_done(res, failure):
-            if op["done"]:
-                return   # already counted lost (coordinator restarted)
-            op["done"] = True
-            if failure is not None:
-                result.ops_failed += 1
-                return
-            result.ops_ok += 1
-            reads = res.reads
-            if window is not None:
-                # a range read observing nothing on a window key observed
-                # the empty prefix — record it so real-time checks bite
-                reads = {t: res.reads.get(t, ()) for t in window}
-            verifier.on_result(op["id"], op["start"], cluster.queue.now,
-                               reads, res.appends)
+        def attempt(attempt_no: int, txn, node_id: int):
+            op["node"] = node_id
 
-        cluster.nodes[node_id].coordinate(txn).begin(on_done)
+            def on_done(res, failure):
+                if op["done"]:
+                    return   # already counted lost (coordinator restarted)
+                if failure is not None:
+                    # a real client retries a failed op (fresh txn, fresh
+                    # value tags — the failed attempt's write may still land
+                    # as its own committed txn, which the verifier's prefix
+                    # checks accommodate).  Bounded: reported-failure
+                    # windows (DELIVER_WITH_FAILURE) otherwise surface
+                    # most of a window's ops as client failures.
+                    if attempt_no < 3 and cluster.queue.now < \
+                            workload_micros + drain_micros // 2:
+                        if writes:
+                            retag = {k: (f"s{op_seed}a{attempt_no}k{k}",)
+                                     for k in writes}
+                            retry_txn = kv_txn(keys, retag)
+                        else:
+                            retry_txn = txn   # reads retry verbatim
+                        nxt = sorted(cluster.nodes)[
+                            wl.next_int(len(cluster.nodes))]
+                        attempt(attempt_no + 1, retry_txn, nxt)
+                        return
+                    op["done"] = True
+                    result.ops_failed += 1
+                    return
+                op["done"] = True
+                result.ops_ok += 1
+                reads = res.reads
+                if window is not None:
+                    # a range read observing nothing on a window key
+                    # observed the empty prefix — record it so real-time
+                    # checks bite
+                    reads = {t: res.reads.get(t, ()) for t in window}
+                verifier.on_result(op["id"], op["start"], cluster.queue.now,
+                                   reads, res.appends)
+
+            cluster.nodes[node_id].coordinate(txn).begin(on_done)
+
+        attempt(0, txn, node_id)
 
     # schedule the workload across the window
     for i in range(n_ops):
@@ -149,9 +174,13 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         if cluster.queue.now > workload_micros:
             cluster.heal()
             cluster.drop_probability = 0.0
+            cluster.deliver_with_failure_probability = 0.0
+            cluster.failure_probability = 0.0
             return
         cluster.heal()
         cluster.drop_probability = 0.0
+        cluster.deliver_with_failure_probability = 0.0
+        cluster.failure_probability = 0.0
         roll = net.next_int(10)
         nodes = sorted(cluster.nodes)
         if roll < 3 and len(nodes) >= 3:
@@ -160,6 +189,13 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
                 cluster.partition(a, b)
         elif roll < 5:
             cluster.drop_probability = 0.05 + 0.1 * net.next_float()
+        elif roll < 7:
+            # delivered-but-reported-failed + fast-failure windows: the
+            # duplicate-coordination trigger (ref: NodeSink.java:46
+            # DELIVER_WITH_FAILURE / FAILURE)
+            cluster.deliver_with_failure_probability = \
+                0.02 + 0.04 * net.next_float()
+            cluster.failure_probability = 0.01 + 0.03 * net.next_float()
         cluster.queue.add(cluster.queue.now + 2_000_000, shake)
 
     if chaos:

@@ -134,21 +134,31 @@ class NodeSink(api.MessageSink):
         if getattr(request, "is_slow_read", False):
             timeout *= 10
 
-        def on_timeout():
-            if self.dead:
-                return
-            cb = self._callbacks.pop(cid, None)
-            if cb is not None:
-                from ..coordinate.errors import Timeout as TimeoutError_
-                self.cluster.schedule_at_node(
-                    self.node_id,
-                    lambda: cb.on_failure(to, TimeoutError_(msg=f"timeout to {to}")))
-        self.cluster.queue.add(self.cluster.queue.now + timeout, on_timeout)
+        self.cluster.queue.add(self.cluster.queue.now + timeout,
+                               lambda: self._fail_pending(
+                                   cid, to, f"timeout to {to}"))
 
     def reply(self, to: int, reply_context, reply) -> None:
         if self.dead or reply_context is None:
             return   # local requests (Propagate) have no reply path
         self.cluster.route_reply(self.node_id, to, reply_context, reply)
+
+    def fail_callback(self, cid: int, from_id: int) -> None:
+        """The network told us the request failed (Action.FAILURE /
+        DELIVER_WITH_FAILURE) — fail the pending callback now; a late real
+        reply for the same cid is ignored (already popped), exactly like a
+        reply racing a timeout."""
+        self._fail_pending(cid, from_id, f"reported-failed to {from_id}")
+
+    def _fail_pending(self, cid: int, from_id: int, msg: str) -> None:
+        if self.dead:
+            return
+        cb = self._callbacks.pop(cid, None)
+        if cb is not None:
+            from ..coordinate.errors import Timeout as TimeoutError_
+            self.cluster.schedule_at_node(
+                self.node_id,
+                lambda: cb.on_failure(from_id, TimeoutError_(msg=msg)))
 
     # -- inbound (called by cluster on delivery) ----------------------------
     def deliver_reply(self, from_id: int, reply_context: _ReplyContext, reply) -> None:
@@ -164,12 +174,6 @@ class NodeSink(api.MessageSink):
             cb.on_failure(from_id, reply.failure)
         else:
             cb.on_success(from_id, reply)
-
-    def fail_callback(self, cid: int, from_id: int, failure: BaseException) -> None:
-        cb = self._callbacks.pop(cid, None)
-        if cb is not None:
-            cb.on_failure(from_id, failure)
-
 
 class SimConfigService(api.ConfigurationService):
     """Static/epoch-list configuration service
@@ -254,6 +258,8 @@ class Cluster:
         self._num_stores = num_stores
         self.partitioned: Set[frozenset] = set()  # pairs that cannot talk
         self.drop_probability = 0.0
+        self.deliver_with_failure_probability = 0.0
+        self.failure_probability = 0.0
         # per-node clock drift: node_id -> (num, den, offset_micros); a
         # node's local clock reads queue.now * num // den + offset
         # (ref: BurnTest.java:330-340 FrequentLargeRange clock drift).
@@ -320,6 +326,18 @@ class Cluster:
                 return Action.DROP
             if self.drop_probability and self.random.decide(self.drop_probability):
                 return Action.DROP
+            # delivered-but-reported-failed: the classic duplicate-
+            # coordination trigger — the sender believes the request died
+            # and retries/recovers while it actually took effect
+            # (ref: NodeSink.java:46 DELIVER_WITH_FAILURE)
+            if self.deliver_with_failure_probability and self.random.decide(
+                    self.deliver_with_failure_probability):
+                return Action.DELIVER_WITH_FAILURE
+            # fast-failure: not delivered AND the sender is told so
+            # immediately, instead of waiting out the timeout (ref: FAILURE)
+            if self.failure_probability and self.random.decide(
+                    self.failure_probability):
+                return Action.FAILURE
         return Action.DELIVER
 
     def _deliver_at(self, src: int, dst: int) -> int:
@@ -332,14 +350,18 @@ class Cluster:
     def route_request(self, src: int, dst: int, request, callback_id: int) -> None:
         self.stats[type(request).__name__] = self.stats.get(type(request).__name__, 0) + 1
         action = self._action(src, dst)
-        filtered = (action is not Action.DROP and self.message_filter is not None
-                    and self.message_filter(src, dst, request))
+        filtered = (action in (Action.DROP, Action.FAILURE)
+                    or (self.message_filter is not None
+                        and self.message_filter(src, dst, request)))
         if self.trace is not None:
-            delivered = action is Action.DELIVER and not filtered
             self.trace.record(self.queue.now,
-                              "SEND" if delivered else "DROP",
+                              "SEND" if not filtered else "DROP",
                               src, dst, repr(request))
-        if action is Action.DROP or filtered:
+        if action in (Action.DELIVER_WITH_FAILURE, Action.FAILURE) \
+                and callback_id:
+            self.queue.add(self._deliver_at(src, dst), lambda: (
+                self.sinks[src].fail_callback(callback_id, dst)))
+        if filtered:
             return
         ctx = _ReplyContext(src, callback_id)
         self.queue.add(self._deliver_at(src, dst),
@@ -348,11 +370,15 @@ class Cluster:
     def route_reply(self, src: int, dst: int, ctx: _ReplyContext, reply) -> None:
         self.stats[type(reply).__name__] = self.stats.get(type(reply).__name__, 0) + 1
         action = self._action(src, dst)
+        # a reply has no callback of its own: FAILURE degrades to a plain
+        # loss; DELIVER_WITH_FAILURE degrades to a plain delivery
         if self.trace is not None:
+            delivered = action in (Action.DELIVER,
+                                   Action.DELIVER_WITH_FAILURE)
             self.trace.record(self.queue.now,
-                              "REPLY" if action is Action.DELIVER
-                              else "DROP_REPLY", src, dst, repr(reply))
-        if action is Action.DROP:
+                              "REPLY" if delivered else "DROP_REPLY",
+                              src, dst, repr(reply))
+        if action in (Action.DROP, Action.FAILURE):
             return
         self.queue.add(self._deliver_at(src, dst),
                        lambda: self.sinks[dst].deliver_reply(src, ctx, reply))

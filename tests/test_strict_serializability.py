@@ -246,3 +246,84 @@ def test_blind_write_resolved_by_final_position():
     v.set_final(2, ("b1",))
     with pytest.raises(HistoryViolation, match="cross-key cycle"):
         v.verify()
+
+
+def test_deliver_with_failure_idempotent_recoordination():
+    """Action.DELIVER_WITH_FAILURE (ref NodeSink.java:46): the sender is
+    told the request failed while it actually took effect — the classic
+    duplicate-coordination trigger.  Re-coordinating the SAME TxnId after a
+    reported failure must not double-apply the write."""
+    from accord_tpu.primitives.timestamp import Domain, TxnKind
+    topology = build_topology(1, (1, 2, 3), 3, 4)
+    cluster = Cluster(topology=topology, seed=77,
+                      data_store_factory=KVDataStore)
+    node = cluster.nodes[1]
+    txn = kv_txn([10], {10: ("once",)})
+    txn_id = node.next_txn_id(TxnKind.Write, Domain.Key)
+
+    cluster.deliver_with_failure_probability = 1.0
+    out = []
+    node.coordinate(txn, txn_id=txn_id).begin(lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    # every round was reported failed to the coordinator...
+    cluster.deliver_with_failure_probability = 0.0
+    # ...so the client retries the same id; replicas that DID process the
+    # earlier rounds must converge without double-applying
+    retries = 0
+    while (not out or out[-1][1] is not None) and retries < 5:
+        retries += 1
+        node.coordinate(txn, txn_id=txn_id).begin(
+            lambda r, f: out.append((r, f)))
+        cluster.run_until_quiescent()
+    assert out and out[-1][1] is None, out[-1:]
+    check = []
+    cluster.nodes[2].coordinate(kv_txn([10], {})).begin(
+        lambda r, f: check.append((r, f)))
+    cluster.run_until_quiescent()
+    vals = check[0][0].reads[10]
+    assert list(vals).count("once") == 1, vals
+
+
+@pytest.mark.parametrize("seed", [301, 302, 303])
+def test_random_workload_with_failure_actions(seed):
+    """Strict serializability holds with the failure actions on: requests
+    randomly delivered-but-reported-failed or failed-fast."""
+    from accord_tpu.sim.topology_factory import build_topology as _bt
+    topology = _bt(1, (1, 2, 3), 3, 4)
+    cluster = Cluster(topology=topology, seed=seed,
+                      data_store_factory=KVDataStore)
+    cluster.deliver_with_failure_probability = 0.08
+    cluster.failure_probability = 0.04
+    rng = RandomSource(seed * 17 + 3)
+    verifier = StrictSerializabilityVerifier()
+    keys = [1000 + 2000 * i for i in range(4)]
+    done = [0]
+    for i in range(30):
+        op = verifier.begin()
+        read_keys = rng.sample(keys, 1 + rng.next_int(2))
+        appends = {t: (f"op{op}.{t}",) for t in read_keys
+                   if rng.decide(0.6)}
+        start = cluster.queue.now
+
+        def on_done(result, failure, op=op, start=start):
+            done[0] += 1
+            if failure is None:
+                verifier.on_result(op, start, cluster.queue.now,
+                                   result.reads, result.appends)
+
+        cluster.nodes[rng.pick(sorted(cluster.nodes))].coordinate(
+            kv_txn(read_keys, appends)).begin(on_done)
+        cluster.run_until_quiescent(max_micros=600_000_000)
+    cluster.deliver_with_failure_probability = 0.0
+    cluster.failure_probability = 0.0
+    cluster.run_until_quiescent()
+    assert cluster.failures == []
+    for t in keys:
+        out = []
+        cluster.nodes[1].coordinate(kv_txn([t], {})).begin(
+            lambda r, f, tok=t: out.append((tok, r, f)))
+        cluster.run_until_quiescent()
+        tok, r, f = out[0]
+        if f is None:
+            verifier.set_final(tok, r.reads[tok])
+    verifier.verify()
