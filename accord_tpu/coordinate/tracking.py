@@ -63,10 +63,16 @@ class AbstractTracker:
         if self._status is not RequestStatus.NoChange:
             return RequestStatus.NoChange  # already terminal; report once only
         for t in self.trackers:
-            if not t.shard.contains_node(node) or t.done:
+            if not t.shard.contains_node(node):
                 continue
+            # NB: decided shards still TALLY (ref AbstractTracker applies
+            # the function unconditionally; exactly-once completion is the
+            # done flag below) — RecoveryTracker's fast-path-reject count
+            # must keep growing from replies landing after the shard's
+            # quorum, or superseding_rejects() under-counts and recovery
+            # completes a fast path that provably never happened
             outcome = fn(t, node)
-            if outcome is RequestStatus.Failed:
+            if outcome is RequestStatus.Failed and not t.done:
                 self._status = RequestStatus.Failed
                 return self._status
             if outcome is RequestStatus.Success and not t.done:

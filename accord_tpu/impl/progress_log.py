@@ -284,6 +284,10 @@ class SimpleProgressLog(api.ProgressLog):
         # moves (and the real home would never hear)
         manager = node.topology_manager
         if not manager.has_epoch(txn_id.epoch()):
+            # the blocked entry is already popped, so a silent drop would
+            # lose the stand-down signal for good — wait for the epoch
+            node.with_epoch(txn_id.epoch(),
+                            lambda: self._inform_home_durable(txn_id, merged))
             return
         topology = manager.get_topology_for_epoch(txn_id.epoch())
         home = Ranges.of(route.home_as_range())

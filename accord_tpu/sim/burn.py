@@ -33,7 +33,7 @@ from ..topology.topology import Topology
 from ..utils.random_source import RandomSource
 from .cluster import Cluster
 from .kvstore import (KVDataStore, kv_ephemeral_read, kv_range_read, kv_txn)
-from .topology_factory import build_topology
+from .topology_factory import build_topology, mutate_electorates
 from .verifier import StrictSerializabilityVerifier
 
 
@@ -171,16 +171,12 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
 
     # chaos: re-randomize partitions / drops every 2s of sim time
     def shake():
-        if cluster.queue.now > workload_micros:
-            cluster.heal()
-            cluster.drop_probability = 0.0
-            cluster.deliver_with_failure_probability = 0.0
-            cluster.failure_probability = 0.0
-            return
         cluster.heal()
         cluster.drop_probability = 0.0
         cluster.deliver_with_failure_probability = 0.0
         cluster.failure_probability = 0.0
+        if cluster.queue.now > workload_micros:
+            return
         roll = net.next_int(10)
         nodes = sorted(cluster.nodes)
         if roll < 3 and len(nodes) >= 3:
@@ -228,7 +224,7 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         current = cluster.topologies[-1]
         all_ids = list(node_ids)
         members = sorted(current.nodes())
-        roll = top.next_int(3)
+        roll = top.next_int(4)
         if roll == 0 and len(members) < len(all_ids):
             # membership: add one node
             members = sorted(members + [top.pick(
@@ -236,7 +232,7 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         elif roll == 1 and len(members) > max(3, rf):
             # membership: drop one node
             members = [n for n in members if n != top.pick(members)]
-        # else: keep members, reshard only
+        # roll 2: keep members, reshard only; roll 3: FASTPATH (below)
         # keep the run's replication degree through churn (ref: the
         # TopologyRandomizer varies rf 2..9, BurnTest.java:600-609) — capping
         # at 3 silently collapsed every big-cluster run's geometry at the
@@ -248,8 +244,14 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         # 6-shard geometry through churn, not collapse to 5 at epoch 2)
         new_shards = max(2, min(max(5, shards),
                                 prev_shards + top.next_int(3) - 1))
-        cluster.add_topology(build_topology(current.epoch + 1, members,
-                                            new_rf, new_shards))
+        topo = build_topology(current.epoch + 1, members, new_rf, new_shards)
+        if roll == 3:
+            # mutate the fast-path electorate (ref: TopologyRandomizer
+            # FASTPATH action): shrink electorates within legal bounds so
+            # fast-path quorum math is exercised off the everyone-votes
+            # default through the rest of the run
+            topo = mutate_electorates(topo, top)
+        cluster.add_topology(topo)
         result.epochs += 1
         cluster.queue.add(cluster.queue.now + 4_000_000 + top.next_int(4_000_000),
                           churn_once)

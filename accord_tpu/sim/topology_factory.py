@@ -29,3 +29,20 @@ def build_topology(epoch: int, node_ids: Sequence[int], rf: int,
         electorate = frozenset(replicas) if fast_path_all else frozenset()
         shards.append(Shard(Range(start, end), replicas, electorate))
     return Topology(epoch, shards)
+
+
+def mutate_electorates(topology: Topology, rng) -> Topology:
+    """Randomize each shard's fast-path electorate within the legal bounds
+    (ref: topology/TopologyRandomizer.java updateFastPath): any subset of
+    the replicas with at least ``rf - max_failures`` members keeps the
+    fast/slow quorum intersection sound (Shard asserts it)."""
+    shards: List[Shard] = []
+    for s in topology.shards:
+        lo = len(s.nodes) - s.max_failures
+        size = lo + rng.next_int(len(s.nodes) - lo + 1)
+        chosen: List[int] = list(s.nodes)
+        while len(chosen) > size:
+            chosen.pop(rng.next_int(len(chosen)))
+        shards.append(Shard(s.range, list(s.nodes), frozenset(chosen),
+                            joining=s.joining))
+    return Topology(topology.epoch, shards)

@@ -67,6 +67,19 @@ def test_burn_endurance(seed):
         f"{result.stats.get('CheckStatus')}")
 
 
+@pytest.mark.parametrize("rf", [2, 3, 4, 5, 6, 7, 8, 9])
+def test_burn_rf_sweep(rf):
+    """Quorum geometry sweep rf 2..9 with node count up to 3*rf and churn
+    (incl. FASTPATH electorate mutation) on
+    (ref: BurnTest.java:600-609 + TopologyRandomizer FASTPATH)."""
+    n = 3 * rf if rf <= 6 else 2 * rf + rf // 2
+    result = run_burn(700 + rf, n_ops=60,
+                      node_ids=tuple(range(1, n + 1)), rf=rf,
+                      shards=min(6, max(4, rf)))
+    assert result.ops_unresolved == 0, f"rf={rf}: {result}"
+    assert result.ops_ok >= 2 * result.ops_failed, f"rf={rf}: {result}"
+
+
 @pytest.mark.parametrize("seed", [201, 202])
 def test_burn_big_cluster(seed):
     """Quorum geometry beyond rf=3 (ref: BurnTest rf 2..9): 7 nodes, rf 5,

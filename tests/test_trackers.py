@@ -154,3 +154,293 @@ def test_random_reconciliation_against_model():
                 else:
                     assert any(len(model_fail[i]) > tr.shard.max_failures
                                for i, tr in enumerate(tracker.trackers))
+
+
+# ---------------------------------------------------------------------------
+# Reconcilers: every tracker subclass against an independent per-shard model
+# (ref: test/.../coordinate/tracking/TrackerReconciler.java and the five
+# *TrackerReconciler subclasses), sweeping rf 2..9 with node counts up to
+# 3*rf and one- or two-epoch topology windows.  Each node responds exactly
+# once per request — the reconciler's (and the protocol's) invariant.
+# ---------------------------------------------------------------------------
+
+from accord_tpu.coordinate.tracking import AllTracker, AppliedTracker
+
+
+def _random_topologies(rng, epochs: int = 1):
+    from accord_tpu.sim.topology_factory import mutate_electorates
+    rf = 2 + rng.next_int(8)                 # 2..9
+    n = rf + rng.next_int(2 * rf + 1)        # rf..3rf
+    nodes = tuple(range(1, n + 1))
+    shards = 1 + rng.next_int(4)
+    newest = build_topology(epochs, nodes, rf, shards)
+    if rng.decide(0.5):
+        # exercise shrunken fast-path electorates, not just everyone-votes
+        # (ref: TopologyRandomizer FASTPATH)
+        newest = mutate_electorates(newest, rng)
+    if epochs == 1:
+        return Topologies.single(newest)
+    prev_rf = max(2, min(n, rf + rng.next_int(3) - 1))
+    older = build_topology(1, nodes, prev_rf, max(1, shards - 1))
+    if rng.decide(0.5):
+        older = mutate_electorates(older, rng)
+    return Topologies((newest, older))
+
+
+class _ShardModel:
+    """Independent bookkeeping for one shard: raw response sets plus the
+    shard's published quorum arithmetic — no tracker internals."""
+
+    def __init__(self, shard):
+        self.shard = shard
+        self.succ = set()
+        self.fail = set()
+        self.fp_accepts = set()
+        self.fp_rejects = set()
+
+    def record(self, node, ok, fp_vote=None):
+        if not self.shard.contains_node(node):
+            return
+        (self.succ if ok else self.fail).add(node)
+        if node in self.shard.fast_path_electorate:
+            if ok and fp_vote:
+                self.fp_accepts.add(node)
+            elif fp_vote is not None or not ok:
+                self.fp_rejects.add(node)
+
+    def quorum(self):
+        return len(self.succ) >= self.shard.slow_path_quorum_size
+
+    def failed(self):
+        return len(self.fail) > self.shard.max_failures
+
+    def fast_met(self):
+        return len(self.fp_accepts) >= self.shard.fast_path_quorum_size
+
+    def fast_rejected(self):
+        return self.shard.rejects_fast_path(len(self.fp_rejects))
+
+
+def _drive(tracker, models, events, decided_fn, failed_fn=None):
+    """Feed one event per node; the tracker must report the model's
+    terminal status exactly at the first event where the model becomes
+    terminal, and NoChange before and after (exactly-once reporting)."""
+    failed_fn = failed_fn or _ShardModel.failed
+    terminal = None
+    for apply_tracker, apply_model in events:
+        status = apply_tracker()
+        apply_model()
+        if terminal is None:
+            if any(failed_fn(m) for m in models):
+                terminal = RequestStatus.Failed
+                assert status is RequestStatus.Failed, status
+            elif all(decided_fn(m) for m in models):
+                terminal = RequestStatus.Success
+                assert status is RequestStatus.Success, status
+            else:
+                assert status is RequestStatus.NoChange, status
+        else:
+            assert status is RequestStatus.NoChange, (
+                "terminal status must be reported exactly once")
+    return terminal
+
+
+def _one_event_per_node(rng, nodes):
+    return rng.shuffle(list(nodes))
+
+
+@pytest.mark.parametrize("epochs", [1, 2])
+def test_reconcile_quorum_tracker(epochs):
+    rng = RandomSource(100 + epochs)
+    for trial in range(200):
+        top = _random_topologies(rng.fork(), epochs)
+        tracker = QuorumTracker(top)
+        models = [_ShardModel(t.shard) for t in tracker.trackers]
+        events = []
+        for node in _one_event_per_node(rng, sorted(top.nodes())):
+            ok = rng.decide(0.7)
+            events.append((
+                (lambda n=node: tracker.record_success(n)) if ok
+                else (lambda n=node: tracker.record_failure(n)),
+                lambda n=node, ok=ok: [m.record(n, ok) for m in models]))
+        _drive(tracker, models, events, _ShardModel.quorum)
+
+
+@pytest.mark.parametrize("epochs", [1, 2])
+def test_reconcile_fast_path_tracker(epochs):
+    def decided(m):
+        return m.fast_met() or (m.fast_rejected() and m.quorum())
+
+    rng = RandomSource(200 + epochs)
+    for trial in range(200):
+        top = _random_topologies(rng.fork(), epochs)
+        tracker = FastPathTracker(top)
+        models = [_ShardModel(t.shard) for t in tracker.trackers]
+        events = []
+        for node in _one_event_per_node(rng, sorted(top.nodes())):
+            ok = rng.decide(0.75)
+            vote = rng.decide(0.7)
+            if ok:
+                events.append((
+                    lambda n=node, v=vote:
+                    tracker.record_success(n, fast_path_vote=v),
+                    lambda n=node, v=vote:
+                    [m.record(n, True, fp_vote=v) for m in models]))
+            else:
+                events.append((
+                    lambda n=node: tracker.record_failure(n),
+                    lambda n=node:
+                    [m.record(n, False) for m in models]))
+        _drive(tracker, models, events, decided)
+
+
+@pytest.mark.parametrize("epochs", [1, 2])
+def test_reconcile_recovery_tracker(epochs):
+    rng = RandomSource(300 + epochs)
+    for trial in range(200):
+        top = _random_topologies(rng.fork(), epochs)
+        tracker = RecoveryTracker(top)
+        models = [_ShardModel(t.shard) for t in tracker.trackers]
+        events = []
+        for node in _one_event_per_node(rng, sorted(top.nodes())):
+            ok = rng.decide(0.8)
+            rejects = rng.decide(0.4)
+            if ok:
+                events.append((
+                    lambda n=node, r=rejects:
+                    tracker.record_success(n, rejects_fast_path=r),
+                    lambda n=node, r=rejects:
+                    [m.record(n, True, fp_vote=(False if r else None))
+                     for m in models]))
+            else:
+                events.append((
+                    lambda n=node: tracker.record_failure(n),
+                    lambda n=node:
+                    [m.record(n, False, fp_vote=None) or
+                     m.fp_rejects.discard(n) for m in models]))
+        # inline drive: superseding_rejects() is consulted by Recover at
+        # the instant the tracker reports Success, so reconcile the model
+        # at exactly that point.  Reject votes landing after a SHARD's
+        # quorum (but before the global quorum) must still count
+        # (ref RecoveryTracker tallies past shard completion).
+        terminal = None
+        for apply_tracker, apply_model in events:
+            status = apply_tracker()
+            if terminal is None:
+                apply_model()
+            if terminal is None and status is not RequestStatus.NoChange:
+                terminal = status
+                model_super = any(m.fast_rejected() for m in models)
+                assert tracker.superseding_rejects() == model_super, trial
+        if terminal is None:
+            assert not any(m.failed() for m in models)
+            assert not all(m.quorum() for m in models)
+
+
+@pytest.mark.parametrize("epochs", [1, 2])
+def test_reconcile_applied_tracker(epochs):
+    rng = RandomSource(400 + epochs)
+    for trial in range(150):
+        top = _random_topologies(rng.fork(), epochs)
+        tracker = AppliedTracker(top)
+        models = [_ShardModel(t.shard) for t in tracker.trackers]
+        events = []
+        for node in _one_event_per_node(rng, sorted(top.nodes())):
+            ok = rng.decide(0.8)
+            events.append((
+                (lambda n=node: tracker.record_success(n)) if ok
+                else (lambda n=node: tracker.record_failure(n)),
+                lambda n=node, ok=ok: [m.record(n, ok) for m in models]))
+        _drive(tracker, models, events, _ShardModel.quorum)
+
+
+@pytest.mark.parametrize("epochs", [1, 2])
+def test_reconcile_all_tracker(epochs):
+    """AllTracker: success only when EVERY replica of every shard
+    responded ok; any failure is immediately terminal."""
+    rng = RandomSource(500 + epochs)
+    for trial in range(150):
+        top = _random_topologies(rng.fork(), epochs)
+        tracker = AllTracker(top)
+        models = [_ShardModel(t.shard) for t in tracker.trackers]
+        events = []
+        for node in _one_event_per_node(rng, sorted(top.nodes())):
+            ok = rng.decide(0.9)
+            events.append((
+                (lambda n=node: tracker.record_success(n)) if ok
+                else (lambda n=node: tracker.record_failure(n)),
+                lambda n=node, ok=ok: [m.record(n, ok) for m in models]))
+        _drive(tracker, models, events,
+               decided_fn=lambda m: len(m.succ) >= len(m.shard.nodes),
+               failed_fn=lambda m: bool(m.fail))
+
+
+def test_reconcile_read_tracker():
+    """ReadTracker: one data success per shard with alternatives on
+    failure (ref: ReadTrackerReconciler) — the model tracks
+    contacted/inflight/data per shard independently."""
+    rng = RandomSource(600)
+    for trial in range(200):
+        top = _random_topologies(rng.fork(), 1)
+        tracker = ReadTracker(top)
+        shard_nodes = [set(t.shard.nodes) for t in tracker.trackers]
+        data = [False] * len(shard_nodes)
+        contacted = set()
+        inflight = set()
+        for sn in shard_nodes:
+            pick = sorted(sn)[rng.next_int(len(sn))]
+            if pick not in inflight:
+                tracker.record_in_flight(pick)
+                inflight.add(pick)
+                contacted.add(pick)
+        guard = 0
+        while inflight and guard < 300:
+            guard += 1
+            node = sorted(inflight)[rng.next_int(len(inflight))]
+            inflight.discard(node)
+            if rng.decide(0.6):
+                status = tracker.record_read_success(node)
+                for i, sn in enumerate(shard_nodes):
+                    if node in sn:
+                        data[i] = True
+                to_contact = []
+            else:
+                status, to_contact = tracker.record_read_failure(node)
+            model_done = all(data)
+            def shard_dead(i):
+                sn = shard_nodes[i]
+                return (not data[i] and not (sn & inflight)
+                        and not (sn - contacted))
+            if status is RequestStatus.Success:
+                assert model_done
+                break
+            if status is RequestStatus.Failed:
+                # the tracker may report exhaustion before the model sees
+                # the replacement contacts (to_contact empty by definition)
+                assert not to_contact
+                assert any(shard_dead(i) for i in range(len(shard_nodes)))
+                break
+            for n in to_contact:
+                assert n not in contacted, "tracker re-contacted a node"
+                tracker.record_in_flight(n)
+                inflight.add(n)
+                contacted.add(n)
+
+
+def test_mutate_electorates_legal_and_nontrivial():
+    """Electorate mutation keeps Shard's quorum-intersection invariant
+    (size >= rf - max_failures) and actually shrinks some electorates."""
+    from accord_tpu.sim.topology_factory import mutate_electorates
+    rng = RandomSource(9)
+    shrunk = 0
+    for trial in range(50):
+        rf = 2 + rng.next_int(8)
+        n = rf + rng.next_int(2 * rf + 1)
+        t = build_topology(1, tuple(range(1, n + 1)), rf, 1 + rng.next_int(4))
+        m = mutate_electorates(t, rng)
+        for s in m.shards:
+            assert len(s.fast_path_electorate) >= len(s.nodes) - s.max_failures
+            assert s.fast_path_electorate <= set(s.nodes)
+            if len(s.fast_path_electorate) < len(s.nodes):
+                shrunk += 1
+    assert shrunk > 20
