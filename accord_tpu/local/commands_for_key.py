@@ -99,7 +99,7 @@ class CommandsForKey:
     TxnId, with a parallel executeAt-ordered view of committed txns."""
 
     __slots__ = ("token", "_ids", "_infos", "prune_before",
-                 "_committed_write_execs")
+                 "_committed_write_execs", "_n_unwitnessable")
 
     def __init__(self, token: int):
         self.token = token
@@ -112,6 +112,11 @@ class CommandsForKey:
         # pivot lookup must not rescan the whole history on the hot path
         # (ref: the committed[] executeAt-ordered array, CommandsForKey.java)
         self._committed_write_execs: List[Timestamp] = []
+        # count of TRANSITIVELY_KNOWN/INVALIDATED entries: when 0 AND no
+        # committed-write pivot exists below the bound, NOTHING on this key
+        # can elide — the batched device attribution skips per-dep elision
+        # lookups wholesale (see can_elide)
+        self._n_unwitnessable = 0
 
     # -- update path --------------------------------------------------------
     def update(self, txn_id: TxnId, status: InternalStatus,
@@ -129,12 +134,21 @@ class CommandsForKey:
             self._infos[txn_id] = info
             bisect.insort(self._ids, txn_id)
             self._on_inserted(txn_id, status)
+            if status in (InternalStatus.TRANSITIVELY_KNOWN,
+                          InternalStatus.INVALIDATED):
+                self._n_unwitnessable += 1
             if InternalStatus.COMMITTED <= status <= InternalStatus.APPLIED \
                     and txn_id.kind().is_write():
                 bisect.insort(self._committed_write_execs, info.execute_at)
         else:
             prev = info.status
             info.status = max(info.status, status)   # never regress
+            was_un = prev in (InternalStatus.TRANSITIVELY_KNOWN,
+                              InternalStatus.INVALIDATED)
+            now_un = info.status in (InternalStatus.TRANSITIVELY_KNOWN,
+                                     InternalStatus.INVALIDATED)
+            if was_un != now_un:
+                self._n_unwitnessable += 1 if now_un else -1
             # the executeAt may only advance with the status grade: a late
             # ACCEPTED-grade update carrying a *proposed* executeAt must not
             # regress the decided executeAt of a COMMITTED+ entry (it would
@@ -232,9 +246,14 @@ class CommandsForKey:
                                           InternalStatus.TRANSITIVELY_KNOWN)
             bisect.insort(self._ids, txn_id)
             self._on_inserted(txn_id, InternalStatus.TRANSITIVELY_KNOWN)
+            self._n_unwitnessable += 1
 
     def remove(self, txn_id: TxnId) -> None:
-        if txn_id in self._infos:
+        info = self._infos.get(txn_id)
+        if info is not None:
+            if info.status in (InternalStatus.TRANSITIVELY_KNOWN,
+                               InternalStatus.INVALIDATED):
+                self._n_unwitnessable -= 1
             del self._infos[txn_id]
             i = bisect.bisect_left(self._ids, txn_id)
             if i < len(self._ids) and self._ids[i] == txn_id:
@@ -267,7 +286,21 @@ class CommandsForKey:
             info.execute_at for info in self._infos.values()
             if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED
             and info.txn_id.kind().is_write())
+        self._n_unwitnessable = sum(
+            1 for info in self._infos.values()
+            if info.status in (InternalStatus.TRANSITIVELY_KNOWN,
+                               InternalStatus.INVALIDATED))
         return cut
+
+    def can_elide(self, bound: Timestamp):
+        """Batch fast-path for the device attribution: returns None when NO
+        entry on this key can be elided for ``bound`` (no unwitnessable
+        entries and no committed-write pivot below the bound), else the
+        pivot to pass to is_elided."""
+        pivot = self.max_committed_write_before(bound)
+        if pivot is None and self._n_unwitnessable == 0:
+            return None
+        return pivot if pivot is not None else Timestamp.NONE
 
     # -- scan API -----------------------------------------------------------
     def max_committed_write_before(self, bound: Timestamp) -> Optional[Timestamp]:

@@ -86,10 +86,12 @@ class _DepsMirror:
         self.lsb = np.zeros(capacity, np.int64)
         self.node = np.zeros(capacity, np.int32)
         self.kind = np.zeros(capacity, np.int32)
+        self.domain = np.zeros(capacity, np.int8)   # Domain enum value
         self.status = np.full(capacity, dk.SLOT_FREE, np.int32)
         self.lo = np.full((capacity, max_intervals), dk.PAD_LO, np.int64)
         self.hi = np.full((capacity, max_intervals), dk.PAD_HI, np.int64)
         self.slot_of: Dict[TxnId, int] = {}
+        self.id_of: Dict[int, TxnId] = {}
         self.free_slots: List[int] = list(range(capacity - 1, -1, -1))
         self._dirty: Set[int] = set()
         self._device: Optional[dk.DepsTable] = None
@@ -103,10 +105,12 @@ class _DepsMirror:
             self._grow_capacity()
         slot = self.free_slots.pop()
         self.slot_of[txn_id] = slot
+        self.id_of[slot] = txn_id
         self.msb[slot] = to_i64(txn_id.msb)
         self.lsb[slot] = to_i64(txn_id.lsb)
         self.node[slot] = txn_id.node
         self.kind[slot] = int(txn_id.kind())
+        self.domain[slot] = int(txn_id.domain())
         self.status[slot] = dk.SLOT_TRANSITIVE
         self.lo[slot] = dk.PAD_LO
         self.hi[slot] = dk.PAD_HI
@@ -117,6 +121,7 @@ class _DepsMirror:
         slot = self.slot_of.pop(txn_id, None)
         if slot is None:
             return
+        self.id_of.pop(slot, None)
         self.status[slot] = dk.SLOT_FREE
         self.lo[slot] = dk.PAD_LO
         self.hi[slot] = dk.PAD_HI
@@ -130,6 +135,7 @@ class _DepsMirror:
         self.lsb = _grow(self.lsb, new, 0)
         self.node = _grow(self.node, new, 0)
         self.kind = _grow(self.kind, new, 0)
+        self.domain = _grow(self.domain, new, 0)
         self.status = _grow(self.status, new, dk.SLOT_FREE)
         self.lo = _grow(self.lo, new, dk.PAD_LO)
         self.hi = _grow(self.hi, new, dk.PAD_HI)
@@ -324,6 +330,8 @@ class DeviceState:
         # learned compaction width for batched queries (sticky across
         # batches; see deps_query_batch)
         self._batch_k = 64
+        # learned flat-compaction capacity (coarse pairs per batch)
+        self._batch_flat = 4096
         # counters surfaced through sim stats / bench
         self.n_queries = 0
         self.n_ticks = 0
@@ -383,90 +391,193 @@ class DeviceState:
         fold the result into ``builder`` with the same per-key semantics as
         the host CommandsForKey path (full ownership history, matching
         SafeCommandStore.map_reduce_active — a dual-quorum scan at a
-        dropped prior-epoch owner must still see its old-range witnesses)."""
+        dropped prior-epoch owner must still see its old-range witnesses).
+
+        This is the batch path with B=1: the per-message and batched code
+        are ONE path (same kernel dispatch, same floors/elision/attribution)
+        so the benched path is exactly the path the protocol runs."""
+        query = self.build_query(safe, txn_id, keys, started_before,
+                                 witnesses)
+        if query is None:
+            return
+        handle = self.deps_query_batch_begin([query], immediate=True)
+        self.deps_query_batch_end_attributed(safe, handle, [builder])
+
+    def build_query(self, safe, txn_id: TxnId, keys,
+                    started_before: Timestamp, witnesses: Kinds):
+        """Slice a scan's keys to the store's full ownership history and
+        package them as one batch-query tuple (None if nothing owned)."""
         owned = safe.store.ranges_for_epoch.all()
         if isinstance(keys, Ranges):
             q_toks: List[int] = []
             q_rngs = list(keys.slice(owned))
         else:
-            q_toks = [k.token() for k in keys if owned.contains_token(k.token())]
+            q_toks = [k.token() for k in keys
+                      if owned.contains_token(k.token())]
             q_rngs = []
         if not q_toks and not q_rngs:
-            return
+            return None
+        return (txn_id, started_before, witnesses, q_toks, q_rngs)
 
-        self.n_queries += 1
-        table = self.deps.device_table()
-        # query interval width is independent of the table's (the kernel
-        # broadcasts [B,1,Mq,1] x [1,N,1,Mt]); pad to a power of two so jit
-        # caches one compilation per width bucket
-        q_m = _pow2_at_least(len(q_toks) + len(q_rngs))
-        query = dk.build_query(
-            [(started_before, witnesses, q_toks, q_rngs, txn_id)], q_m)
-        dep_mask, _ = dk.calculate_deps(table, query)
-        dep_slots = np.nonzero(np.asarray(dep_mask)[0])[0]
-        self.n_kernel_deps += len(dep_slots)
-        if len(dep_slots) == 0:
-            return
+    def _resolve_id(self, j: int, ids) -> TxnId:
+        """Slot -> TxnId via the live reverse map when it still matches the
+        batch snapshot (no object allocation on the hot path); fall back to
+        unpacking from the snapshot columns when the slot was recycled
+        between begin and end."""
+        msb, lsb, node = ids
+        cand = self.deps.id_of.get(j)
+        if cand is not None and to_i64(cand.msb) == msb[j] \
+                and to_i64(cand.lsb) == lsb[j] and cand.node == node[j]:
+            return cand
+        return unpack_txn_id(msb[j], lsb[j], node[j])
 
+    def _attribute_batch(self, safe, b_idx, j_idx, overlap, ids, ivs, qnp,
+                         queries, builders) -> None:
+        """Fold a whole batch's kernel answer into the builders with the
+        floors, elision and key/range attribution of the host path: the
+        kernel answers "who", the mirror snapshot answers "where",
+        RedundantBefore floors and the CFK elision rule decide "whether".
+
+        The geometry runs ONCE, vectorized over all (pair, dep-interval,
+        query-interval) triples — no per-query Python overhead.  The
+        unification that makes this possible: a key-domain dep's footprint
+        is a point, so its emitted key is its own token whether the query
+        interval was a key or a range; a range-domain dep emits the
+        dep∩query interval clip, which for a point query degenerates to the
+        width-1 range.  Python touches only the deduplicated surviving
+        emits."""
+        if len(j_idx) == 0:
+            return
+        lo, hi, dom = ivs
         rb = safe.redundant_before()
-        m = self.deps
+        _MISSING = object()
+        floors: Dict[int, TxnId] = {}
+        cfks: Dict[int, object] = {}
+        id_cache: Dict[int, TxnId] = {}
 
-        def elide(t: int, dep_id: TxnId) -> bool:
-            # the SAME skip rule as the host CommandsForKey.map_reduce_active
-            # (one shared predicate — the device path must not drift)
+        def resolve(j: int) -> TxnId:
+            d = id_cache.get(j)
+            if d is None:
+                d = id_cache[j] = self._resolve_id(j, ids)
+            return d
+
+        def floor_of(t: int) -> TxnId:
+            f = floors.get(t)
+            if f is None:
+                f = floors[t] = rb.deps_floor(t)
+            return f
+
+        def elide_ctx(t: int, bound):
+            """(cfk, pivot) when elision is possible on this key for this
+            bound, else None — ONE lookup per (token, bound) instead of one
+            per (dep, token) pair (the common key has nothing elidable)."""
+            key = (t, bound)
+            ctx = cfks.get(key, _MISSING)
+            if ctx is not _MISSING:
+                return ctx
             cfk = self.store.commands_for_key.get(t)
-            if cfk is None:
-                return False
-            info = cfk.get(dep_id)
-            if info is None:
-                return False
-            return cfk.is_elided(info, started_before)
+            ctx = None
+            if cfk is not None:
+                pivot = cfk.can_elide(bound)
+                if pivot is not None:
+                    ctx = (cfk, pivot)
+            cfks[key] = ctx
+            return ctx
 
-        # attribute each dep to the query keys/ranges its footprint overlaps
-        # (the kernel answers "who", the mirror answers "where")
-        for j in dep_slots:
-            dep_id = unpack_txn_id(m.msb[j], m.lsb[j], m.node[j])
-            slo, shi = m.lo[j], m.hi[j]
-            used = slo <= shi
-            if dep_id.domain() is Domain.Key:
-                for t in q_toks:
-                    if np.any(used & (slo <= t) & (t <= shi)) and \
-                            dep_id >= rb.deps_floor(t) and not elide(t, dep_id):
-                        builder.add_key(t, dep_id)
-                for r in q_rngs:
-                    sel = used & (slo <= r.end - 1) & (r.start <= shi)
-                    for mm in np.nonzero(sel)[0]:
-                        t = int(slo[mm])   # key-domain footprints are points
-                        if dep_id >= rb.deps_floor(t) and not elide(t, dep_id):
-                            builder.add_key(t, dep_id)
-            else:
-                for t in q_toks:
-                    if np.any(used & (slo <= t) & (t <= shi)):
-                        builder.add_range(Range(t, t + 1), dep_id)
-                for r in q_rngs:
-                    sel = used & (slo <= r.end - 1) & (r.start <= shi)
-                    for mm in np.nonzero(sel)[0]:
-                        ilo = max(int(slo[mm]), r.start)
-                        ihi = min(int(shi[mm]), r.end - 1)
-                        builder.add_range(Range(ilo, ihi + 1), dep_id)
+        q_m = (qnp.shape[1] - 7) // 2
+        lo_p = lo[j_idx]                               # [P, M]
+        hi_p = hi[j_idx]
+        qlo_p = qnp[b_idx, 7:7 + q_m]                  # [P, Q]
+        qhi_p = qnp[b_idx, 7 + q_m:7 + 2 * q_m]
+        # overlap [P, M, Q] arrives precomputed from the collect pass
+        p_i, m_i, q_i = np.nonzero(overlap)
+        key_dep = (dom[j_idx] == int(Domain.Key))[p_i]
+
+        # key-domain deps: emitted at the dep's own footprint point,
+        # deduped per (pair, token); floors + elision decide survival
+        kp, km = p_i[key_dep], m_i[key_dep]
+        if len(kp):
+            key_pairs = np.unique(
+                np.stack([kp, lo_p[kp, km]], axis=1), axis=0)
+            pp, tt = key_pairs[:, 0], key_pairs[:, 1]
+            jj, bb = j_idx[pp], b_idx[pp]
+            # vectorized RedundantBefore floor: dep >= floor(token),
+            # lexicographic over the packed (msb, lsb, node) triples (the
+            # same int64 ordering the kernel's ts_lt assumes)
+            msb_a, lsb_a, node_a = ids
+            uniq_t, inv = np.unique(tt, return_inverse=True)
+            f_objs = [floor_of(int(t)) for t in uniq_t]
+            fmsb = np.array([to_i64(f.msb) for f in f_objs], np.int64)[inv]
+            flsb = np.array([to_i64(f.lsb) for f in f_objs], np.int64)[inv]
+            fnode = np.array([f.node for f in f_objs], np.int64)[inv]
+            dmsb, dlsb, dnode = msb_a[jj], lsb_a[jj], node_a[jj]
+            keep = ((dmsb > fmsb)
+                    | ((dmsb == fmsb)
+                       & ((dlsb > flsb)
+                          | ((dlsb == flsb) & (dnode >= fnode)))))
+            # object resolution via one unique pass + C-level take
+            jj_k = jj[keep]
+            uq_j, inv_j = np.unique(jj_k, return_inverse=True)
+            objs = np.empty(len(uq_j), object)
+            for i, j in enumerate(uq_j.tolist()):
+                objs[i] = resolve(j)
+            deps_k = objs[inv_j]
+            # keys with ANYTHING elidable get the per-dep check; the common
+            # key skips it entirely (one can_elide per token+bound)
+            for b, t, dep_id in zip(bb[keep].tolist(), tt[keep].tolist(),
+                                    deps_k):
+                ctx = elide_ctx(t, queries[b][1])
+                if ctx is not None:
+                    info = ctx[0].get(dep_id)
+                    if info is not None and \
+                            ctx[0].is_elided(info, queries[b][1], ctx[1]):
+                        continue
+                builders[b].add_key(t, dep_id)
+
+        # range-domain deps: emit the dep∩query interval clip per pair
+        rp, rm, rq = p_i[~key_dep], m_i[~key_dep], q_i[~key_dep]
+        if len(rp):
+            ilo = np.maximum(lo_p[rp, rm], qlo_p[rp, rq])
+            ihi = np.minimum(hi_p[rp, rm], qhi_p[rp, rq]) + 1
+            range_pairs = np.unique(
+                np.stack([rp, ilo, ihi], axis=1), axis=0)
+            rpp = range_pairs[:, 0]
+            uq_j, inv_j = np.unique(j_idx[rpp], return_inverse=True)
+            objs = np.empty(len(uq_j), object)
+            for i, j in enumerate(uq_j.tolist()):
+                objs[i] = resolve(j)
+            deps_r = objs[inv_j]
+            bb_r = b_idx[rpp].tolist()
+            for b, lo_v, hi_v, dep_id in zip(
+                    bb_r, range_pairs[:, 1].tolist(),
+                    range_pairs[:, 2].tolist(), deps_r):
+                builders[b].add_range(Range(lo_v, hi_v), dep_id)
 
     def deps_query_batch(self, queries):
         """Batched deps scan: ONE kernel call for B concurrent queries (the
-        server-side batching a pipelined deployment uses; the sim's
-        message-at-a-time path calls deps_query per message instead).
+        server-side batching a pipelined deployment uses).
 
         ``queries`` = [(txn_id, started_before, witnesses, tokens, ranges)].
         Returns the dep sets in the device-native packed-CSR layout —
         ``(row_ptr int64[B+1], msb int64[D], lsb int64[D], node int32[D])``
         — the same encoding KeyDeps/RangeDeps use (ref: KeyDeps.java:150-156
-        CSR layout); consumers materialise TxnId objects lazily.  Floors and
-        key attribution are layered on top by the per-message path."""
+        CSR layout); consumers materialise TxnId objects lazily."""
         if not queries:
             return (np.zeros(1, np.int64), np.zeros(0, np.int64),
                     np.zeros(0, np.int64), np.zeros(0, np.int32))
         return self.deps_query_batch_end(self.deps_query_batch_begin(queries))
 
-    def deps_query_batch_begin(self, queries):
+    def deps_query_batch_attributed(self, safe, queries, builders):
+        """The correctness-complete batched scan: one kernel dispatch for B
+        queries, then the full host-path semantics (floors, elision,
+        key/range attribution) folded into each query's builder.  This is
+        the exact code deps_query runs (B=1) — and what the bench times."""
+        if not queries:
+            return
+        handle = self.deps_query_batch_begin(queries)
+        self.deps_query_batch_end_attributed(safe, handle, builders)
+
+    def deps_query_batch_begin(self, queries, immediate: bool = False):
         """Dispatch a batched deps scan WITHOUT waiting: one fused query
         upload + kernel enqueue; returns an opaque handle for
         deps_query_batch_end.  Callers overlap the next batch's dispatch
@@ -478,51 +589,131 @@ class DeviceState:
                   for (tid, sb, wit, toks, rngs) in queries]
         table = self.deps.device_table()
         n = table.capacity
-        qmat = jnp.asarray(dk.pack_query_matrix(packed, q_m))  # ONE upload
-        # adaptive + STICKY compaction width: per-query dep sets are
-        # O(active), so a small k gives an 8x smaller download; an overflow
-        # escalates (counts ride in the same download, so detection is free)
-        # and the learned k persists so steady state stays one round trip
+        qnp = dk.pack_query_matrix(packed, q_m)
+        qmat = jnp.asarray(qnp)                               # ONE upload
+        # adaptive + STICKY flat-compaction capacity: the coarse pair list
+        # is sparse, so the download stays ~100KB; an overflow escalates
+        # (the true count rides in the same download, so detection is free)
+        # and the learned capacity persists so steady state stays one
+        # round trip
+        s = min(self._batch_flat, len(queries) * n)
         k = min(self._batch_k, n)
-        out_dev = dk.calculate_deps_indices_fused(table, qmat, q_m, k)
-        # snapshot the mirror's id columns: the mirror mutates in place, and
-        # a slot freed+reallocated between begin and end would otherwise
-        # resolve this batch's indices to the WRONG TxnId
+        out_dev = dk.calculate_deps_flat(table, qmat, q_m, s, k)
+        box: Dict[str, object] = {"dev": out_dev}
+        if immediate:
+            # synchronous caller (deps_query, B=1): collect follows on the
+            # next line with no interleaved mutation, so skip the snapshot
+            # copies and the prefetch thread — the live mirror IS the
+            # snapshot
+            th = None
+            ids = (self.deps.msb, self.deps.lsb, self.deps.node)
+            ivs = (self.deps.lo, self.deps.hi, self.deps.domain)
+            return (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k,
+                    n, list(queries))
+        # prefetch the result on a worker thread: np.asarray blocks on the
+        # (tunneled) transfer with the GIL released, so a pipelined caller
+        # attributes batch i while batch i+1 computes AND downloads
+
+        def _fetch():
+            try:
+                box["out"] = np.asarray(out_dev)
+            except BaseException as e:     # surfaced after join
+                box["err"] = e
+
+        import threading
+        th = threading.Thread(target=_fetch, daemon=True)
+        th.start()
+        # snapshot the mirror's id + interval columns: the mirror mutates in
+        # place, and a slot freed+reallocated between begin and end would
+        # otherwise resolve this batch's indices to the WRONG TxnId (or
+        # footprint)
         ids = (self.deps.msb.copy(), self.deps.lsb.copy(),
                self.deps.node.copy())
-        return (out_dev, table, ids, qmat, packed, q_m, k, n, len(queries))
+        ivs = (self.deps.lo.copy(), self.deps.hi.copy(),
+               self.deps.domain.copy())
+        return (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k, n,
+                list(queries))
+
+    def _batch_collect(self, handle):
+        """Collect a dispatched batch: ONE sparse download (plus a re-run
+        when the learned flat capacity overflowed), then the host-side
+        EXACT geometry pass over the coarse pairs — the kernel's bounding-
+        box mask admits a query sitting inside a slot's interval gap; the
+        vectorized overlap here drops those and hands the surviving
+        (pair, dep-interval, query-interval) triples to attribution.  The
+        re-run uses the table snapshot captured at begin — registrations
+        interleaved between begin and end must not shift the queried
+        snapshot."""
+        (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k, n,
+         queries) = handle
+        nq = len(queries)
+
+        def parse(out, s, k):
+            total, maxc = int(out[0]), int(out[1])
+            if total > s or maxc > k:
+                return None
+            row_end = out[2:2 + nq].astype(np.int64)
+            counts = np.diff(row_end, prepend=0)
+            b_idx = np.repeat(np.arange(nq), counts)
+            j_idx = out[2 + nq:2 + nq + total].astype(np.int64)
+            return b_idx, j_idx
+
+        if th is not None:
+            th.join()
+            err = box.get("err")
+            if err is not None:
+                raise err           # the real device/transfer failure
+            out = box["out"]
+        else:
+            out = np.asarray(box["dev"])
+        parsed = parse(out, s, k)
+        if parsed is None:
+            # size the flat capacity to the observed total (+25% headroom,
+            # 16k granularity) — pow2 rounding doubled the download
+            total = int(out[0])
+            s = min(-(-int(total * 1.25) // 16384) * 16384, nq * n)
+            k = min(_pow2_at_least(int(out[1])), n)
+            self._batch_flat = max(self._batch_flat, s)
+            self._batch_k = max(self._batch_k, k)
+            out = np.asarray(dk.calculate_deps_flat(table, qmat, q_m, s, k))
+            parsed = parse(out, s, k)
+        b_idx, j_idx = parsed
+        # exact geometry on the sparse pair list
+        lo, hi, _dom = ivs
+        lo_p, hi_p = lo[j_idx], hi[j_idx]                       # [P, M]
+        used = lo_p <= hi_p
+        qlo_p = qnp[b_idx, 7:7 + q_m]                           # [P, Q]
+        qhi_p = qnp[b_idx, 7 + q_m:7 + 2 * q_m]
+        overlap = (used[:, :, None]
+                   & (lo_p[:, :, None] <= qhi_p[:, None, :])
+                   & (qlo_p[:, None, :] <= hi_p[:, :, None]))   # [P, M, Q]
+        keep = overlap.any(axis=(1, 2))
+        b_idx, j_idx, overlap = b_idx[keep], j_idx[keep], overlap[keep]
+        self.n_queries += len(queries)
+        self.n_kernel_deps += len(j_idx)
+        return b_idx, j_idx, overlap, ids, ivs, qnp, queries
 
     def deps_query_batch_end(self, handle):
-        """Collect a dispatched batch: ONE download (plus a re-run when the
-        learned compaction width overflowed).  The re-run and fallback use
-        the table snapshot captured at begin — registrations interleaved
-        between begin and end must not shift the queried snapshot (nor
-        desync the capacity the bit-unpack count is sized to)."""
-        out_dev, table, ids, qmat, packed, q_m, k, n, n_queries = handle
-        out = np.asarray(out_dev)
-        if out[:, 0].max(initial=0) > k and n > k:
-            k = min(_pow2_at_least(int(out[:, 0].max())), n)
-            self._batch_k = k
-            out = np.asarray(dk.calculate_deps_indices_fused(table, qmat,
-                                                             q_m, k))
-        if out[:, 0].max(initial=0) > k:
-            # still overflowing a huge row: bit-packed full mask fallback
-            query = dk.build_query(packed, q_m)
-            packed_mask, _ = dk.calculate_deps_packed(table, query)
-            mask = np.unpackbits(np.asarray(packed_mask), axis=1,
-                                 count=n).astype(bool)
-            b_idx, j_idx = np.nonzero(mask)
-        else:
-            rows = out[:, 1:]
-            b_idx, kk = np.nonzero(rows >= 0)
-            j_idx = rows[b_idx, kk]
-        self.n_queries += n_queries
-        self.n_kernel_deps += len(j_idx)
-        counts = np.bincount(b_idx, minlength=n_queries)
-        row_ptr = np.zeros(n_queries + 1, np.int64)
+        """Raw packed-CSR collection (no floors/attribution) — the transport
+        layout replicas exchange; deps_query_batch_end_attributed is the
+        protocol-complete variant."""
+        b_idx, j_idx, _ov, ids, _ivs, _qnp, queries = \
+            self._batch_collect(handle)
+        order = np.argsort(b_idx, kind="stable")
+        b_idx, j_idx = b_idx[order], j_idx[order]
+        counts = np.bincount(b_idx, minlength=len(queries))
+        row_ptr = np.zeros(len(queries) + 1, np.int64)
         np.cumsum(counts, out=row_ptr[1:])
         msb, lsb, node = ids
         return (row_ptr, msb[j_idx], lsb[j_idx], node[j_idx])
+
+    def deps_query_batch_end_attributed(self, safe, handle, builders) -> None:
+        """Collect a dispatched batch and fold each query's deps into its
+        builder with full host-path semantics (floors/elision/attribution)."""
+        b_idx, j_idx, overlap, ids, ivs, qnp, queries = \
+            self._batch_collect(handle)
+        self._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs, qnp,
+                              queries, builders)
 
     # ------------------------------------------------------------------
     # the drain (device replacement of listener fan-out)

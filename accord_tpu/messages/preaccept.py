@@ -49,10 +49,16 @@ def calculate_partial_deps(safe: SafeCommandStore, txn_id: TxnId, keys,
 
         safe.map_reduce_active(keys, started_before, witnesses, fold, builder)
 
-    # collectDeps boundary (ref: RedundantBefore.collectDeps consumed at
-    # PreAccept.java:245-264): where the floor pruned history, depend on the
-    # floor itself — the bootstrap fence RX, a real txn whose deps cover
-    # everything pruned — so merged deps never silently lose coverage.
+    add_boundary_deps(safe, txn_id, keys, started_before, builder)
+    return builder.build_partial(covering)
+
+
+def add_boundary_deps(safe: SafeCommandStore, txn_id: TxnId, keys,
+                      started_before: Timestamp, builder) -> None:
+    """collectDeps boundary (ref: RedundantBefore.collectDeps consumed at
+    PreAccept.java:245-264): where the floor pruned history, depend on the
+    floor itself — the bootstrap fence RX, a real txn whose deps cover
+    everything pruned — so merged deps never silently lose coverage."""
     rb = safe.redundant_before()
     if isinstance(keys, Ranges):
         for rng, boundary in rb.boundary_deps_in(keys):
@@ -64,7 +70,6 @@ def calculate_partial_deps(safe: SafeCommandStore, txn_id: TxnId, keys,
             if boundary is not None and boundary != txn_id \
                     and boundary < started_before:
                 builder.add_key(key.token(), boundary)
-    return builder.build_partial(covering)
 
 
 class PreAcceptOk(Reply):

@@ -108,11 +108,14 @@ class HostIndexedBaseline:
         self.r_node = np.array([k[2] for k in r_key], np.int64)
         self.r_kind = np.array(r_kind, np.int64)
 
-    def query(self, bound, witnesses, toks, rngs) -> int:
+    def query(self, bound, witnesses, toks, rngs):
+        """Materializes (key, dep) pairs like the reference's builder fill
+        (a count-only scan would flatter the baseline vs the device path,
+        which builds real DepsBuilder results)."""
         import bisect
         bkey = (bound.msb, bound.lsb, bound.node)
         wmask = witnesses.mask()
-        found = 0
+        out = []
         # point keys: bisect the per-key sorted lists (CommandsForKey scan)
         for t in toks:
             lst = self.per_key.get(t)
@@ -120,7 +123,7 @@ class HostIndexedBaseline:
                 hi = bisect.bisect_left(lst, (bkey, 0))
                 for i in range(hi):
                     if (wmask >> lst[i][1]) & 1:
-                        found += 1
+                        out.append((t, lst[i][0]))
         # ranges and range-entries: vectorized stab over the range table
         sel = np.zeros(len(self.r_lo), bool)
         for t in toks:
@@ -134,7 +137,10 @@ class HostIndexedBaseline:
                  ((self.r_lsb == np.uint64(bound.lsb)) &
                   (self.r_node < bound.node))))
             witnessed = (wmask >> self.r_kind) & 1 > 0
-            found += int(np.sum(sel & earlier & witnessed))
+            for i in np.nonzero(sel & earlier & witnessed)[0]:
+                out.append((int(self.r_lo[i]),
+                            (int(self.r_msb[i]), int(self.r_lsb[i]),
+                             int(self.r_node[i]))))
         # per-key entries hit via query RANGES: slice the sorted token array
         # (the reference's AbstractKeys range slicing) then walk each key's
         # sorted list
@@ -146,8 +152,8 @@ class HostIndexedBaseline:
                 hi = bisect.bisect_left(lst, (bkey, 0))
                 for i in range(hi):
                     if (wmask >> lst[i][1]) & 1:
-                        found += 1
-        return found
+                        out.append((t, lst[i][0]))
+        return out
 
 
 def main():
@@ -171,23 +177,63 @@ def main():
     entries = build_workload(rng, N, KEYSPACE, M)
 
     # -- the live protocol store: same registration path the sim's
-    #    PreAccept/Commit transitions drive (device_index.DeviceState) ------
-    class _NullStore:     # DeviceState only touches .node for drain ticks
-        class node:       # (none fire here: no stable() transitions)
+    #    PreAccept/Commit transitions drive (device_index.DeviceState),
+    #    with REAL RedundantBefore floors and CommandsForKey state so the
+    #    timed path is the protocol-complete one (floors + elision +
+    #    attribution), not a stripped kernel ----------------------------
+    from accord_tpu.local.commands_for_key import CommandsForKey
+    from accord_tpu.local.redundant import RedundantBefore
+    from accord_tpu.primitives.keys import Range
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    class _BenchStore:    # the store surface attribution touches
+        def __init__(self):
+            self.commands_for_key = {}
+            self.redundant_before = RedundantBefore()
+
+        class node:       # DeviceState touches .node for drain ticks only
             scheduler = None
-    dev = DeviceState(_NullStore())
+
+    class _BenchSafe:
+        def __init__(self, store):
+            self.store = store
+
+        def redundant_before(self):
+            return self.store.redundant_before
+
+    store = _BenchStore()
+    # non-trivial floors over a slice of the keyspace (shard-durable
+    # watermarks in a live deployment)
+    floor_id = TxnId.create(1, 500_000, TxnKind.ExclusiveSyncPoint,
+                            Domain.Range, 1)
+    store.redundant_before.add_redundant(
+        Ranges.of(*(Range(s, s + 50_000)
+                    for s in range(0, KEYSPACE // 2, 100_000))), floor_id)
+    dev = DeviceState(store)
+    safe = _BenchSafe(store)
     t0 = time.time()
     for tid, toks, rngs in entries:
         keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
         dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+        for t in toks:
+            cfk = store.commands_for_key.get(t)
+            if cfk is None:
+                cfk = store.commands_for_key[t] = CommandsForKey(t)
+            cfk.update(tid, InternalStatus.PREACCEPTED)
     build_s = time.time() - t0
     build_rate = N / build_s
 
-    # -- timed query phase: >=10k queries per rep, 5 reps, median ------------
+    # -- timed query phase: >=10k queries per rep, 5 reps, median.
+    #    The timed path is deps_query_batch_begin/end_attributed — the
+    #    EXACT code the protocol's deps_query runs (kernel dispatch +
+    #    RedundantBefore floors + CFK elision + key/range attribution into
+    #    a DepsBuilder), batched and double-buffered -----------------------
+    from accord_tpu.primitives.deps import DepsBuilder
     batches = [[(q[0], q[0], q[1], q[2], q[3])
                 for q in make_queries(1000 + i, B, KEYSPACE, M)]
                for i in range(BATCHES)]
-    dev.deps_query_batch(batches[0])   # warmup/compile (+ learn k)
+    dev.deps_query_batch_attributed(   # warmup/compile (+ learn k)
+        safe, batches[0], [DepsBuilder() for _ in batches[0]])
     rates = []
     for rep in range(REPS):
         t0 = time.time()
@@ -196,15 +242,20 @@ def main():
         # the server-side pipelining a deployment uses (full protocol
         # results are still materialized for every query)
         pending = []
+
+        def collect(handle, batch):
+            builders = [DepsBuilder() for _ in batch]
+            dev.deps_query_batch_end_attributed(safe, handle, builders)
+            return sum(sum(len(s) for s in b.key._map.values())
+                       + sum(len(s) for s in b.range._map.values())
+                       for b in builders)
+
         for batch in batches:
-            pending.append(dev.deps_query_batch_begin(batch))
+            pending.append((dev.deps_query_batch_begin(batch), batch))
             if len(pending) >= PIPELINE:
-                row_ptr, msb, lsb, node = dev.deps_query_batch_end(
-                    pending.pop(0))
-                n_deps += len(msb)
+                n_deps += collect(*pending.pop(0))
         while pending:
-            row_ptr, msb, lsb, node = dev.deps_query_batch_end(pending.pop(0))
-            n_deps += len(msb)
+            n_deps += collect(*pending.pop(0))
         dt = time.time() - t0
         rates.append(B * BATCHES / dt)
     dev_med = statistics.median(rates)
@@ -218,7 +269,8 @@ def main():
         for tid, toks, rngs in extra[i * B:(i + 1) * B]:
             keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
             dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
-        dev.deps_query_batch(batch)
+        dev.deps_query_batch_attributed(safe, batch,
+                                        [DepsBuilder() for _ in batch])
         i += 1
     live_s = time.time() - t0
     live_rate = (B * 8 * 2) / live_s   # one insert + one query per txn

@@ -125,3 +125,65 @@ def test_device_and_host_runs_same_results(seed):
             reads.append(out[0][0].reads)
         results.append(reads)
     assert results[0] == results[1]
+
+
+@pytest.mark.parametrize("seed", [6, 23])
+def test_batched_attributed_equals_host(seed):
+    """The BATCHED device scan (deps_query_batch_attributed — what the bench
+    times) must match the host fold exactly, including RedundantBefore
+    floors, CFK elision and the collectDeps boundary: one kernel dispatch
+    for B probes, each equal to the host's per-query calculate_partial_deps."""
+    from accord_tpu.messages.preaccept import add_boundary_deps
+    from accord_tpu.primitives.deps import DepsBuilder
+    cluster = make_cluster(seed=seed)
+    rs = RandomSource(seed * 11 + 5)
+    run_workload(cluster, rs, n_ops=30)
+    # advance durability so RedundantBefore floors are non-trivial
+    for nid in sorted(cluster.nodes):
+        sched = cluster.durability.get(nid)
+        if sched is not None:
+            sched.shard_tick()
+    cluster.run_until_quiescent()
+
+    checked = 0
+    for node in cluster.nodes.values():
+        for store in node.command_stores.stores:
+            owned = store.owned_current()
+            if owned.is_empty() or not store.commands_for_key:
+                continue
+            tokens = sorted(store.commands_for_key)
+            safe = SafeCommandStore(store, PreLoadContext.empty())
+            probes = []
+            for k in range(min(5, len(tokens))):
+                probe_keys = tokens[: k + 1]
+                txn = kv_txn(probe_keys, {probe_keys[0]: ("p",)})
+                txn_id = node.next_txn_id(TxnKind.Write, Domain.Key)
+                probes.append((txn_id, txn.keys))
+            queries, keysets, hosts = [], [], []
+            for txn_id, keys in probes:
+                q = store.device.build_query(safe, txn_id, keys, txn_id,
+                                             txn_id.kind().witnesses())
+                if q is None:
+                    continue
+                queries.append(q)
+                keysets.append((txn_id, keys))
+                device, store.device = store.device, None
+                try:
+                    hosts.append(calculate_partial_deps(
+                        safe, txn_id, keys, txn_id, owned))
+                finally:
+                    store.device = device
+            if not queries:
+                continue
+            builders = [DepsBuilder() for _ in queries]
+            store.device.deps_query_batch_attributed(safe, queries, builders)
+            for (txn_id, keys), b, host in zip(keysets, builders, hosts):
+                add_boundary_deps(safe, txn_id, keys, txn_id, b)
+                dev_deps = b.build_partial(owned)
+                assert _key_map(dev_deps) == _key_map(host), \
+                    f"batched key deps diverge on {store}"
+                assert _range_map(dev_deps) == _range_map(host), \
+                    f"batched range deps diverge on {store}"
+                checked += 1
+            safe.complete()
+    assert checked >= 3
