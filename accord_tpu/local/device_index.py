@@ -185,6 +185,25 @@ class _DepsMirror:
             self._dirty.add(slot)
 
     # -- device sync --------------------------------------------------------
+    def device_table_sharded(self, mesh) -> dk.DepsTable:
+        """Mesh placement: the slot dimension sharded across the mesh.  Any
+        dirt triggers a full sharded re-upload (the incremental scatter path
+        is single-device; on the virtual CPU mesh correctness is the point,
+        and a real multi-chip deployment would shard the scatter too)."""
+        if self._device is None or self._dirty:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.sharded import STORE_AXIS
+            s1 = NamedSharding(mesh, P(STORE_AXIS))
+            s2 = NamedSharding(mesh, P(STORE_AXIS, None))
+            self._device = dk.DepsTable(
+                jax.device_put(self.msb, s1), jax.device_put(self.lsb, s1),
+                jax.device_put(self.node, s1), jax.device_put(self.kind, s1),
+                jax.device_put(self.status, s1), jax.device_put(self.lo, s2),
+                jax.device_put(self.hi, s2))
+            self._dirty.clear()
+        return self._device
+
     def device_table(self) -> dk.DepsTable:
         if self._device is None:
             self._device = dk.DepsTable(
@@ -327,6 +346,20 @@ class DeviceState:
         self.deps = _DepsMirror()
         self.drain = _DrainMirror()
         self._tick_scheduled = False
+        # mesh mode: with >1 jax device (the virtual 8-device CPU test mesh,
+        # or a real multi-chip slice), the deps table's slot dimension is
+        # sharded across the mesh and every scan runs as a shard_map with
+        # per-shard CSR compaction (ref: the CommandStores scatter-gather,
+        # CommandStores.java:575-643; cross-shard Deps.merge, Deps.java:256)
+        self.mesh = None
+        import jax as _jax
+        n_dev = len(_jax.devices())
+        if n_dev > 1:
+            d = 1
+            while d * 2 <= n_dev:
+                d *= 2
+            from ..parallel.sharded import make_mesh
+            self.mesh = make_mesh(d)
         # learned compaction width for batched queries (sticky across
         # batches; see deps_query_batch)
         self._batch_k = 64
@@ -336,6 +369,7 @@ class DeviceState:
         self.n_queries = 0
         self.n_ticks = 0
         self.n_kernel_deps = 0
+        self.n_mesh_queries = 0
 
     # ------------------------------------------------------------------
     # registration hooks (called from local.commands transitions)
@@ -587,7 +621,10 @@ class DeviceState:
         q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
         packed = [(sb, wit, toks, rngs, tid)
                   for (tid, sb, wit, toks, rngs) in queries]
-        table = self.deps.device_table()
+        if self.mesh is not None:
+            table = self.deps.device_table_sharded(self.mesh)
+        else:
+            table = self.deps.device_table()
         n = table.capacity
         qnp = dk.pack_query_matrix(packed, q_m)
         qmat = jnp.asarray(qnp)                               # ONE upload
@@ -596,9 +633,21 @@ class DeviceState:
         # (the true count rides in the same download, so detection is free)
         # and the learned capacity persists so steady state stays one
         # round trip
-        s = min(self._batch_flat, len(queries) * n)
-        k = min(self._batch_k, n)
-        out_dev = dk.calculate_deps_flat(table, qmat, q_m, s, k)
+        if self.mesh is not None:
+            d = int(np.prod(list(self.mesh.shape.values())))
+        else:
+            d = 1
+        # caps are PER SHARD: each shard block holds at most nq * (n/d)
+        # entries, and its widest row at most n/d
+        s = min(self._batch_flat, len(queries) * (n // d))
+        k = min(self._batch_k, n // d)
+        if self.mesh is not None:
+            from ..parallel.sharded import sharded_calculate_deps_flat
+            out_dev = sharded_calculate_deps_flat(
+                self.mesh, q_m, s, k)(table, qmat)
+            self.n_mesh_queries += len(queries)
+        else:
+            out_dev = dk.calculate_deps_flat(table, qmat, q_m, s, k)
         box: Dict[str, object] = {"dev": out_dev}
         if immediate:
             # synchronous caller (deps_query, B=1): collect follows on the
@@ -609,7 +658,7 @@ class DeviceState:
             ids = (self.deps.msb, self.deps.lsb, self.deps.node)
             ivs = (self.deps.lo, self.deps.hi, self.deps.domain)
             return (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k,
-                    n, list(queries))
+                    n, d, list(queries))
         # prefetch the result on a worker thread: np.asarray blocks on the
         # (tunneled) transfer with the GIL released, so a pipelined caller
         # attributes batch i while batch i+1 computes AND downloads
@@ -632,7 +681,7 @@ class DeviceState:
         ivs = (self.deps.lo.copy(), self.deps.hi.copy(),
                self.deps.domain.copy())
         return (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k, n,
-                list(queries))
+                d, list(queries))
 
     def _batch_collect(self, handle):
         """Collect a dispatched batch: ONE sparse download (plus a re-run
@@ -645,18 +694,25 @@ class DeviceState:
         interleaved between begin and end must not shift the queried
         snapshot."""
         (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k, n,
-         queries) = handle
+         d, queries) = handle
         nq = len(queries)
+        shard_n = n // d
 
         def parse(out, s, k):
-            total, maxc = int(out[0]), int(out[1])
-            if total > s or maxc > k:
+            """Per-shard blocks (total, maxc, row_end[B], entries[s]) with
+            shard-local slot indices; shard 0 alone when unsharded."""
+            blocks = out.reshape(d, 2 + nq + s)
+            if int(blocks[:, 0].max()) > s or int(blocks[:, 1].max()) > k:
                 return None
-            row_end = out[2:2 + nq].astype(np.int64)
-            counts = np.diff(row_end, prepend=0)
-            b_idx = np.repeat(np.arange(nq), counts)
-            j_idx = out[2 + nq:2 + nq + total].astype(np.int64)
-            return b_idx, j_idx
+            bs, js = [], []
+            for i in range(d):
+                total = int(blocks[i, 0])
+                row_end = blocks[i, 2:2 + nq].astype(np.int64)
+                counts = np.diff(row_end, prepend=0)
+                bs.append(np.repeat(np.arange(nq), counts))
+                js.append(blocks[i, 2 + nq:2 + nq + total].astype(np.int64)
+                          + i * shard_n)
+            return np.concatenate(bs), np.concatenate(js)
 
         if th is not None:
             th.join()
@@ -670,12 +726,19 @@ class DeviceState:
         if parsed is None:
             # size the flat capacity to the observed total (+25% headroom,
             # 16k granularity) — pow2 rounding doubled the download
-            total = int(out[0])
-            s = min(-(-int(total * 1.25) // 16384) * 16384, nq * n)
-            k = min(_pow2_at_least(int(out[1])), n)
+            blocks = out.reshape(d, 2 + nq + s)
+            total = int(blocks[:, 0].max())
+            s = min(-(-int(total * 1.25) // 16384) * 16384, nq * shard_n)
+            k = min(_pow2_at_least(int(blocks[:, 1].max())), shard_n)
             self._batch_flat = max(self._batch_flat, s)
             self._batch_k = max(self._batch_k, k)
-            out = np.asarray(dk.calculate_deps_flat(table, qmat, q_m, s, k))
+            if d > 1:
+                from ..parallel.sharded import sharded_calculate_deps_flat
+                out = np.asarray(sharded_calculate_deps_flat(
+                    self.mesh, q_m, s, k)(table, qmat))
+            else:
+                out = np.asarray(dk.calculate_deps_flat(table, qmat, q_m,
+                                                        s, k))
             parsed = parse(out, s, k)
         b_idx, j_idx = parsed
         # exact geometry on the sparse pair list

@@ -193,11 +193,7 @@ def calculate_deps_indices_fused(table: DepsTable, qmat: jnp.ndarray,
     ascending indices after).  On a tunneled accelerator the round trips,
     not the kernel, dominate: the 9-array query upload and the
     idx/counts/max_conflict downloads each cost a full RTT."""
-    query = DepsQuery(
-        qmat[:, 0], qmat[:, 1], qmat[:, 2].astype(jnp.int32),
-        qmat[:, 3].astype(jnp.int32),
-        qmat[:, 7:7 + m], qmat[:, 7 + m:7 + 2 * m],
-        qmat[:, 4], qmat[:, 5], qmat[:, 6].astype(jnp.int32))
+    query = query_from_qmat(qmat, m)
     dep_mask, _mc = calculate_deps(table, query)
     idx, counts = _compact_topk(dep_mask, k)
     return jnp.concatenate([counts[:, None], idx], axis=1)
@@ -216,17 +212,27 @@ def calculate_deps_flat(table: DepsTable, qmat: jnp.ndarray,
     CSR — header (total, max row count), row_end[B], entries[s] — ~100KB
     for a 2048-query batch.
     """
-    query = DepsQuery(
+    return flat_csr_local(table, qmat, m, s, k)
+
+
+def query_from_qmat(qmat: jnp.ndarray, m: int) -> DepsQuery:
+    return DepsQuery(
         qmat[:, 0], qmat[:, 1], qmat[:, 2].astype(jnp.int32),
         qmat[:, 3].astype(jnp.int32),
         qmat[:, 7:7 + m], qmat[:, 7 + m:7 + 2 * m],
         qmat[:, 4], qmat[:, 5], qmat[:, 6].astype(jnp.int32))
+
+
+def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
+                   m: int, s: int, k: int) -> jnp.ndarray:
+    """The traceable body of calculate_deps_flat: exact mask over THIS
+    table (a full table, or one mesh shard's slice under shard_map), then
+    per-row top-k compaction (memory-safe: fuses into the mask computation,
+    no [B*N] index materialization) scattered into one CSR.  ``k`` caps the
+    widest row, ``s`` the batch total; both sticky-learned by the caller
+    from the header counts."""
+    query = query_from_qmat(qmat, m)
     mask, _mc = calculate_deps(table, query)
-    # per-row compaction to k entries (memory-safe: fuses into the mask
-    # computation, no [B*N] index materialization), then a device-side
-    # scatter packs the rows into one CSR so the download is the sparse
-    # result alone.  ``k`` caps the widest row, ``s`` the batch total;
-    # both sticky-learned by the caller from the header counts.
     k = min(k, mask.shape[1])
     idx, counts = _compact_topk(mask, k)                       # [B,k],[B]
     row_end = jnp.cumsum(counts)                               # [B]

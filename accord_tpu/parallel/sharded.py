@@ -144,6 +144,40 @@ def sharded_drain(mesh: Mesh):
     return jax.jit(fn)
 
 
+_FLAT_CACHE = {}
+
+
+def sharded_calculate_deps_flat(mesh: Mesh, m: int, s: int, k: int):
+    """Mesh-sharded variant of ops.deps_kernel.calculate_deps_flat: the slot
+    dimension lives across the mesh (the reference's CommandStores scatter,
+    CommandStores.java:575-643), the query batch is replicated, each device
+    scans and CSR-compacts its slice, and the per-shard CSRs concatenate —
+    the cross-shard ``Deps.merge`` (Deps.java:256) happens as the host
+    merges shard-local slot indices with their shard offsets.
+
+    Returns fn(table_sharded, qmat) -> int32[D * (2 + B + s)] where each
+    shard block is (total, max_row_count, row_end[B], entries[s]) with
+    SHARD-LOCAL slot indices."""
+    from ..ops import deps_kernel as dk
+    key = (tuple(mesh.shape.items()), m, s, k)
+    fn = _FLAT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    table_specs = DepsTable(P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS, None), P(STORE_AXIS, None))
+
+    def local(table: DepsTable, qmat):
+        return dk.flat_csr_local(table, qmat, m, s, k)
+
+    fn = jax.jit(jax.shard_map(local, mesh=mesh,
+                               in_specs=(table_specs, P()),
+                               out_specs=P(STORE_AXIS),
+                               check_vma=False))
+    _FLAT_CACHE[key] = fn
+    return fn
+
+
 def sharded_protocol_step(mesh: Mesh):
     """The fused multi-chip step: deps for a query batch + execution drain.
 
