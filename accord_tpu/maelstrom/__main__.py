@@ -92,6 +92,13 @@ def main() -> None:
 
     def emit(dest, body: dict) -> None:
         packet = {"src": proc.name, "dest": dest, "body": body}
+        if dest == proc.name:
+            # deliver self-addressed packets internally (defer via the
+            # timer heap to avoid re-entrancy): a replica coordinating for
+            # ranges it also serves must not depend on the harness looping
+            # its own packets back
+            scheduler.now(lambda: proc.handle(json.loads(json.dumps(packet))))
+            return
         stdout.write(json.dumps(packet) + "\n")
         stdout.flush()
 
@@ -129,7 +136,20 @@ def main() -> None:
                       file=sys.stderr)
                 continue
             proc.handle(packet)
-    scheduler.run_due()
+    # EOF: the harness never closes stdin mid-test, so this is shutdown —
+    # but in-flight coordinations may still need a few timer rounds to
+    # reply (smoke tests pipe a fixed set of lines and read the output).
+    # Drain until no coordination is in flight (recurring scans keep the
+    # timer heap perpetually non-empty, so heap emptiness can't be the
+    # condition), bounded by a grace window.
+    grace_until = now_micros() + 2_000_000
+    while now_micros() < grace_until and proc.node is not None \
+            and proc.node._coordinating:
+        scheduler.run_due()
+        deadline = scheduler.next_deadline()
+        if deadline is None:
+            break
+        time.sleep(min(max(deadline - now_micros(), 0) / 1e6, 0.05))
 
 
 if __name__ == "__main__":

@@ -27,6 +27,15 @@ class RunResult:
         self.ops_failed = 0
         self.ops_unresolved = 0
         self.packets = 0
+        # per-op commit latency in SIMULATED micros (client submit ->
+        # txn_ok) — the configs[0]/[1] p99 metric
+        self.latencies_micros: List[int] = []
+
+    def p99_micros(self) -> Optional[int]:
+        if not self.latencies_micros:
+            return None
+        xs = sorted(self.latencies_micros)
+        return xs[min(len(xs) - 1, int(len(xs) * 0.99))]
 
     def __repr__(self):
         return (f"RunResult(ok={self.ops_ok}, failed={self.ops_failed}, "
@@ -103,16 +112,35 @@ class MaelstromRunner:
 
     # -- workload (ref: Runner.java:123-190 generated txn bodies) -----------
     def run_workload(self, n_ops: int = 50, n_keys: int = 10,
-                     verify: bool = True) -> RunResult:
+                     verify: bool = True,
+                     keys_per_txn: Optional[int] = None,
+                     zipf_skew: Optional[float] = None) -> RunResult:
+        """``keys_per_txn`` pins the txn width (default 1..3 random);
+        ``zipf_skew`` draws keys Zipf-distributed over [0, n_keys) —
+        configs[1]'s 4-key multi-partition Zipf-0.9 shape."""
         wl = self.rs.fork()
         verifier = StrictSerializabilityVerifier()
         next_val = [0]
         pending = {}
 
+        def pick_key() -> int:
+            if zipf_skew is not None:
+                return wl.next_zipf(n_keys, zipf_skew)
+            return wl.next_int(n_keys)
+
         def submit(i: int):
             node = self.names[wl.next_int(len(self.names))]
-            n = wl.next_int(3) + 1
-            keys = sorted({wl.next_int(n_keys) for _ in range(n)})
+            n = keys_per_txn if keys_per_txn is not None \
+                else wl.next_int(3) + 1
+            n = min(n, n_keys)
+            chosen = set()
+            # redraw until n DISTINCT keys: under zipf the hot key repeats,
+            # and silently shrinking the txn would mislabel the metric
+            guard = 0
+            while len(chosen) < n and guard < 64:
+                chosen.add(pick_key())
+                guard += 1
+            keys = sorted(chosen)
             ops = []
             writes = {}
             reads = []
@@ -136,6 +164,7 @@ class MaelstromRunner:
                     self.result.ops_failed += 1
                     return
                 self.result.ops_ok += 1
+                self.result.latencies_micros.append(self.queue.now - start)
                 observed = {}
                 for op in body["txn"]:
                     if op[0] == "r":

@@ -76,6 +76,27 @@ def make_queries(seed, k, keyspace, max_iv):
     return qs
 
 
+class BenchStore:
+    """The store surface DeviceState attribution touches (shared by the
+    headline bench, the hot-key config and the mesh-replay config)."""
+
+    def __init__(self):
+        self.commands_for_key = {}
+        from accord_tpu.local.redundant import RedundantBefore
+        self.redundant_before = RedundantBefore()
+
+    class node:       # DeviceState touches .node for drain ticks only
+        scheduler = None
+
+
+class BenchSafe:
+    def __init__(self, store):
+        self.store = store
+
+    def redundant_before(self):
+        return self.store.redundant_before
+
+
 class HostIndexedBaseline:
     """The reference's scan shape on the host: per-key sorted TxnId lists
     (CommandsForKey) + a flat range-entry table stabbed per query (the
@@ -156,6 +177,164 @@ class HostIndexedBaseline:
         return out
 
 
+def bench_maelstrom_configs():
+    """BASELINE configs[0]/[1]: p99 commit latency through the in-process
+    Maelstrom runner (full wire serde on the hot path, 1ms mean link
+    latency).  SIMULATED time: the number measures protocol round counts,
+    not host speed — host mode so kernel RTTs don't skew a latency metric."""
+    from accord_tpu.maelstrom.runner import MaelstromRunner
+
+    def row(config, metric, res):
+        p99 = res.p99_micros()
+        return {"config": config, "metric": metric,
+                "value": None if p99 is None else round(p99 / 1000, 2),
+                "unit": "sim_ms", "ok": res.ops_ok,
+                "failed": res.ops_failed}
+
+    r0 = MaelstromRunner(3, seed=0, shards=8, device_mode=False)
+    yield row(0, "maelstrom_p99_commit_latency_3n_100k_single_key",
+              r0.run_workload(n_ops=250, n_keys=100, keys_per_txn=1))
+    r1 = MaelstromRunner(5, seed=1, shards=8, device_mode=False)
+    yield row(1, "maelstrom_p99_commit_latency_5n_10kk_4key_zipf09",
+              r1.run_workload(n_ops=250, n_keys=10_000, keys_per_txn=4,
+                              zipf_skew=0.9))
+
+
+def bench_hot_keys():
+    """BASELINE configs[3]: dense dependency graphs over 128 hot keys —
+    the deps scan at maximal per-key contention plus the executeAt-gated
+    drain over deep chains, both through the live device kernels."""
+    import time as _t
+    from accord_tpu.local.device_index import DeviceState
+    from accord_tpu.local.commands_for_key import InternalStatus
+    from accord_tpu.ops import drain_kernel as drk
+    from accord_tpu.ops.packing import pack_timestamps
+    from accord_tpu.primitives.deps import DepsBuilder
+    from accord_tpu.primitives.keys import Keys, IntKey
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    import jax.numpy as jnp
+
+    N3, B3 = 5000, 256
+    rng = np.random.default_rng(9)
+    store = BenchStore()
+    dev = DeviceState(store)
+    safe = BenchSafe(store)
+    hlcs = rng.choice(np.arange(1, 1_000_000), size=N3, replace=False)
+    for i in range(N3):
+        tid = TxnId.create(1, int(hlcs[i]), TxnKind.Write, Domain.Key,
+                           1 + i % 5)
+        toks = [int(t) for t in rng.integers(0, 128, rng.integers(1, 4))]
+        dev.register(tid, int(InternalStatus.PREACCEPTED),
+                     Keys([IntKey(t) for t in toks]))
+    queries = []
+    for b in range(B3 * 4):
+        bound = TxnId.create(1, int(rng.integers(1_000_000, 2_000_000)),
+                             TxnKind.Write, Domain.Key, 1)
+        toks = [int(t) for t in rng.integers(0, 128, rng.integers(1, 4))]
+        queries.append((bound, bound, bound.kind().witnesses(), toks, []))
+    batches = [queries[i * B3:(i + 1) * B3] for i in range(4)]
+    dev.deps_query_batch_attributed(safe, batches[0],
+                                    [DepsBuilder() for _ in batches[0]])
+    t0 = _t.time()
+    n_deps = 0
+    for batch in batches:
+        builders = [DepsBuilder() for _ in batch]
+        dev.deps_query_batch_attributed(safe, batch, builders)
+        n_deps += sum(sum(len(s) for s in b.key._map.values())
+                      for b in builders)
+    deps_rate = B3 * 4 / (_t.time() - t0)
+
+    # deep-chain drain: 4096 stable txns in one executeAt chain with dense
+    # local fan-in — the whole chain drains in one device fixpoint
+    ND = 4096
+    adj = np.zeros((ND, ND), bool)
+    for i in range(1, ND):
+        adj[i, i - 1] = True
+        for j in range(max(0, i - 8), i - 1):
+            adj[i, j] = rng.random() < 0.5
+    ids = [TxnId.create(1, 10 + i, TxnKind.Write, Domain.Key, 1)
+           for i in range(ND)]
+    em, el, en = pack_timestamps(ids)
+    from accord_tpu.ops.deps_kernel import SLOT_STABLE
+    state = drk.DrainState(jnp.asarray(adj),
+                           jnp.full(ND, SLOT_STABLE, jnp.int32),
+                           jnp.asarray(em), jnp.asarray(el),
+                           jnp.asarray(en), jnp.zeros(ND, bool))
+    applied, newly = drk.drain(state)
+    _ = np.asarray(applied)                              # warm + compile
+    t0 = _t.time()
+    reps = 3
+    for _i in range(reps):
+        applied, newly = drk.drain(state)
+        drained = int(np.asarray(newly).sum())
+    drain_rate = drained * reps / (_t.time() - t0)
+    return [{"config": 3,
+             "metric": "hot128_deps_scan_txns_per_sec_5k_inflight",
+             "value": round(deps_rate, 1), "unit": "txn/s",
+             "deps_found": n_deps},
+            {"config": 3,
+             "metric": "hot128_chain_drain_txns_per_sec",
+             "value": round(drain_rate, 1), "unit": "txn/s",
+             "chain_depth": ND}]
+
+
+def config4_child():
+    """BASELINE configs[4], run in a subprocess on the virtual 8-device CPU
+    mesh (multi-chip TPU hardware is not reachable from this environment):
+    a 64-shard keyspace replay through the mesh-sharded deps scan — every
+    query fans over all 8 mesh shards and merges shard CSRs (the
+    cross-shard Deps.merge / all-gather leg)."""
+    import time as _t
+    from accord_tpu.local.device_index import DeviceState
+    from accord_tpu.local.commands_for_key import InternalStatus
+    from accord_tpu.primitives.deps import DepsBuilder
+    from accord_tpu.primitives.keys import Keys, IntKey
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    SHARDS = 64
+    SHARD_WIDTH = 4096
+    N4, B4 = 20_000, 512
+    rng = np.random.default_rng(11)
+    store = BenchStore()
+    dev = DeviceState(store)
+    assert dev.mesh is not None, "config4 needs the multi-device mesh"
+    safe = BenchSafe(store)
+    hlcs = rng.choice(np.arange(1, 2_000_000), size=N4, replace=False)
+    t0 = _t.time()
+    for i in range(N4):
+        shard = int(rng.integers(0, SHARDS))
+        base = shard * SHARD_WIDTH
+        tid = TxnId.create(1, int(hlcs[i]), TxnKind.Write, Domain.Key,
+                           1 + i % 5)
+        toks = [base + int(t) for t in rng.integers(0, SHARD_WIDTH,
+                                                    rng.integers(1, 3))]
+        dev.register(tid, int(InternalStatus.PREACCEPTED),
+                     Keys([IntKey(t) for t in toks]))
+    replay_rate = N4 / (_t.time() - t0)   # registers only, pre-compile
+    queries = []
+    for b in range(B4):
+        bound = TxnId.create(1, int(rng.integers(2_000_000, 3_000_000)),
+                             TxnKind.Write, Domain.Key, 1)
+        shard = int(rng.integers(0, SHARDS))
+        toks = [shard * SHARD_WIDTH + int(t)
+                for t in rng.integers(0, SHARD_WIDTH, 2)]
+        queries.append((bound, bound, bound.kind().witnesses(), toks, []))
+    dev.deps_query_batch_attributed(safe, queries,     # warmup + compile
+                                    [DepsBuilder() for _ in queries])
+    t1 = _t.time()
+    reps = 4
+    for _i in range(reps):
+        dev.deps_query_batch_attributed(safe, queries,
+                                        [DepsBuilder() for _ in queries])
+    q_rate = B4 * reps / (_t.time() - t1)
+    print(json.dumps({
+        "config": 4,
+        "metric": "mesh8_64shard_replay_query_txns_per_sec",
+        "value": round(q_rate, 1), "unit": "txn/s",
+        "replay_register_rate": round(replay_rate, 1),
+        "mesh_devices": 8, "platform": "cpu-mesh (v5e-8 not reachable)"}))
+
+
 def main():
     from accord_tpu.ops.packing import enable_x64
     enable_x64()
@@ -182,26 +361,10 @@ def main():
     #    timed path is the protocol-complete one (floors + elision +
     #    attribution), not a stripped kernel ----------------------------
     from accord_tpu.local.commands_for_key import CommandsForKey
-    from accord_tpu.local.redundant import RedundantBefore
     from accord_tpu.primitives.keys import Range
     from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
 
-    class _BenchStore:    # the store surface attribution touches
-        def __init__(self):
-            self.commands_for_key = {}
-            self.redundant_before = RedundantBefore()
-
-        class node:       # DeviceState touches .node for drain ticks only
-            scheduler = None
-
-    class _BenchSafe:
-        def __init__(self, store):
-            self.store = store
-
-        def redundant_before(self):
-            return self.store.redundant_before
-
-    store = _BenchStore()
+    store = BenchStore()
     # non-trivial floors over a slice of the keyspace (shard-durable
     # watermarks in a live deployment)
     floor_id = TxnId.create(1, 500_000, TxnKind.ExclusiveSyncPoint,
@@ -210,7 +373,7 @@ def main():
         Ranges.of(*(Range(s, s + 50_000)
                     for s in range(0, KEYSPACE // 2, 100_000))), floor_id)
     dev = DeviceState(store)
-    safe = _BenchSafe(store)
+    safe = BenchSafe(store)
     t0 = time.time()
     for tid, toks, rngs in entries:
         keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
@@ -303,6 +466,49 @@ def main():
           f"zero-egress env cannot resolve the reference's gradle deps",
           file=sys.stderr)
 
+    # -- BASELINE configs[0]/[1]/[3]/[4]: secondary metrics (stderr; the
+    #    driver contract keeps stdout to the ONE headline JSON line) --------
+    try:
+        for row in bench_maelstrom_configs():
+            print("# CONFIG " + json.dumps(row), file=sys.stderr)
+    except Exception as e:   # secondary metric must not sink the headline
+        print(f"# CONFIG 0/1 failed: {e!r}", file=sys.stderr)
+    try:
+        for row in bench_hot_keys():
+            print("# CONFIG " + json.dumps(row), file=sys.stderr)
+    except Exception as e:
+        print(f"# CONFIG 3 failed: {e!r}", file=sys.stderr)
+    try:
+        import os
+        import subprocess
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        env["JAX_ENABLE_X64"] = "true"
+        child = subprocess.run(
+            [sys.executable, __file__, "--config4"], env=env,
+            capture_output=True, text=True, timeout=420)
+        for line in child.stdout.splitlines():
+            if line.strip().startswith("{"):
+                print("# CONFIG " + line.strip(), file=sys.stderr)
+        if child.returncode != 0:
+            print(f"# CONFIG 4 failed: {child.stderr[-400:]}",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"# CONFIG 4 failed: {e!r}", file=sys.stderr)
+
 
 if __name__ == "__main__":
-    main()
+    if "--config4" in sys.argv:
+        # env (JAX_PLATFORMS=cpu + 8 virtual devices) is set by the parent
+        # BEFORE this interpreter started — but an installed accelerator
+        # plugin can still win platform selection, so force it through
+        # jax.config too (same dance as tests/conftest.py)
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+        _jax.config.update("jax_enable_x64", True)
+        config4_child()
+    else:
+        main()
