@@ -91,14 +91,9 @@ def main() -> None:
     stdout = sys.stdout
 
     def emit(dest, body: dict) -> None:
+        # (self-addressed sends never reach here: MaelstromProcess
+        # intercepts dest == own-name and defers them internally)
         packet = {"src": proc.name, "dest": dest, "body": body}
-        if dest == proc.name:
-            # deliver self-addressed packets internally (defer via the
-            # timer heap to avoid re-entrancy): a replica coordinating for
-            # ranges it also serves must not depend on the harness looping
-            # its own packets back
-            scheduler.now(lambda: proc.handle(json.loads(json.dumps(packet))))
-            return
         stdout.write(json.dumps(packet) + "\n")
         stdout.flush()
 

@@ -114,19 +114,24 @@ class MaelstromRunner:
     def run_workload(self, n_ops: int = 50, n_keys: int = 10,
                      verify: bool = True,
                      keys_per_txn: Optional[int] = None,
-                     zipf_skew: Optional[float] = None) -> RunResult:
+                     zipf_skew: Optional[float] = None,
+                     spread_ring: bool = False) -> RunResult:
         """``keys_per_txn`` pins the txn width (default 1..3 random);
         ``zipf_skew`` draws keys Zipf-distributed over [0, n_keys) —
-        configs[1]'s 4-key multi-partition Zipf-0.9 shape."""
+        configs[1]'s 4-key multi-partition Zipf-0.9 shape.
+        ``spread_ring`` strides key values across the whole token ring so
+        an N-key space actually lands on every shard (small ints all hash
+        into shard 0 otherwise — a 'multi-partition' workload must be)."""
         wl = self.rs.fork()
         verifier = StrictSerializabilityVerifier()
         next_val = [0]
         pending = {}
+        stride = ((1 << 32) // n_keys) if spread_ring else 1
 
         def pick_key() -> int:
-            if zipf_skew is not None:
-                return wl.next_zipf(n_keys, zipf_skew)
-            return wl.next_int(n_keys)
+            k = (wl.next_zipf(n_keys, zipf_skew) if zipf_skew is not None
+                 else wl.next_int(n_keys))
+            return k * stride
 
         def submit(i: int):
             node = self.names[wl.next_int(len(self.names))]

@@ -193,11 +193,12 @@ def bench_maelstrom_configs():
 
     r0 = MaelstromRunner(3, seed=0, shards=8, device_mode=False)
     yield row(0, "maelstrom_p99_commit_latency_3n_100k_single_key",
-              r0.run_workload(n_ops=250, n_keys=100, keys_per_txn=1))
+              r0.run_workload(n_ops=250, n_keys=100, keys_per_txn=1,
+                              spread_ring=True))
     r1 = MaelstromRunner(5, seed=1, shards=8, device_mode=False)
     yield row(1, "maelstrom_p99_commit_latency_5n_10kk_4key_zipf09",
               r1.run_workload(n_ops=250, n_keys=10_000, keys_per_txn=4,
-                              zipf_skew=0.9))
+                              zipf_skew=0.9, spread_ring=True))
 
 
 def bench_hot_keys():

@@ -1,0 +1,181 @@
+"""Property-testing kit: generators + a seeded property runner.
+
+Rebuild of ref: accord-core/src/test/java/accord/utils/Gen.java, Gens.java,
+Property.java and AccordGens.java — the home-grown generator/property
+framework the reference's unit tiers run on.  Deterministic: every example
+derives from (base_seed + index), and a failure message carries the exact
+seed so the case replays as a one-liner.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Generic, List, Sequence, TypeVar
+
+from accord_tpu.primitives.deps import Deps, DepsBuilder
+from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges, Route
+from accord_tpu.primitives.timestamp import (Ballot, Domain, Timestamp,
+                                             TxnId, TxnKind)
+from accord_tpu.utils.random_source import RandomSource
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+class Gen(Generic[T]):
+    """A value generator: wraps fn(RandomSource) -> T
+    (ref: utils/Gen.java)."""
+
+    def __init__(self, fn: Callable[[RandomSource], T]):
+        self._fn = fn
+
+    def __call__(self, rng: RandomSource) -> T:
+        return self._fn(rng)
+
+    def map(self, f: Callable[[T], U]) -> "Gen[U]":
+        return Gen(lambda rng: f(self._fn(rng)))
+
+    def flat_map(self, f: Callable[[T], "Gen[U]"]) -> "Gen[U]":
+        return Gen(lambda rng: f(self._fn(rng))(rng))
+
+    def filter(self, pred: Callable[[T], bool],
+               max_tries: int = 100) -> "Gen[T]":
+        def gen(rng: RandomSource) -> T:
+            for _ in range(max_tries):
+                v = self._fn(rng)
+                if pred(v):
+                    return v
+            raise AssertionError("Gen.filter exhausted retries")
+        return Gen(gen)
+
+
+class Gens:
+    """Stock combinators (ref: utils/Gens.java)."""
+
+    @staticmethod
+    def constant(v: T) -> Gen[T]:
+        return Gen(lambda rng: v)
+
+    @staticmethod
+    def ints(lo: int, hi: int) -> Gen[int]:
+        """Uniform in [lo, hi)."""
+        return Gen(lambda rng: lo + rng.next_int(hi - lo))
+
+    @staticmethod
+    def bools(p: float = 0.5) -> Gen[bool]:
+        return Gen(lambda rng: rng.decide(p))
+
+    @staticmethod
+    def pick(items: Sequence[T]) -> Gen[T]:
+        return Gen(lambda rng: items[rng.next_int(len(items))])
+
+    @staticmethod
+    def lists(gen: Gen[T], min_len: int = 0, max_len: int = 8) -> Gen[List[T]]:
+        def fn(rng: RandomSource) -> List[T]:
+            n = min_len + rng.next_int(max_len - min_len + 1)
+            return [gen(rng) for _ in range(n)]
+        return Gen(fn)
+
+
+class AccordGens:
+    """Domain generators (ref: utils/AccordGens.java)."""
+
+    @staticmethod
+    def txn_ids(max_epoch: int = 4, max_hlc: int = 1 << 20,
+                nodes: int = 8,
+                kinds: Sequence[TxnKind] = (TxnKind.Read, TxnKind.Write,
+                                            TxnKind.SyncPoint,
+                                            TxnKind.ExclusiveSyncPoint)
+                ) -> Gen[TxnId]:
+        def fn(rng: RandomSource) -> TxnId:
+            kind = kinds[rng.next_int(len(kinds))]
+            domain = Domain.Range if kind.is_sync_point() else (
+                Domain.Range if rng.decide(0.2) else Domain.Key)
+            return TxnId.create(1 + rng.next_int(max_epoch),
+                                1 + rng.next_int(max_hlc), kind, domain,
+                                1 + rng.next_int(nodes))
+        return Gen(fn)
+
+    @staticmethod
+    def timestamps(max_epoch: int = 4, max_hlc: int = 1 << 20,
+                   nodes: int = 8) -> Gen[Timestamp]:
+        return Gen(lambda rng: Timestamp.from_values(
+            1 + rng.next_int(max_epoch), 1 + rng.next_int(max_hlc),
+            1 + rng.next_int(nodes)))
+
+    @staticmethod
+    def ballots(nodes: int = 8) -> Gen[Ballot]:
+        return Gen(lambda rng: Ballot(rng.next_int(1 << 16),
+                                      rng.next_int(1 << 16),
+                                      1 + rng.next_int(nodes)))
+
+    @staticmethod
+    def tokens(space: int = 1000) -> Gen[int]:
+        return Gens.ints(0, space)
+
+    @staticmethod
+    def keys(space: int = 1000, max_keys: int = 6) -> Gen[Keys]:
+        def fn(rng: RandomSource) -> Keys:
+            n = 1 + rng.next_int(max_keys)
+            toks = sorted({rng.next_int(space) for _ in range(n)})
+            return Keys([IntKey(t) for t in toks])
+        return Gen(fn)
+
+    @staticmethod
+    def ranges(space: int = 1000, max_ranges: int = 4,
+               max_width: int = 64) -> Gen[Ranges]:
+        def fn(rng: RandomSource) -> Ranges:
+            out = []
+            for _ in range(1 + rng.next_int(max_ranges)):
+                s = rng.next_int(space - 1)
+                out.append(Range(s, s + 1 + rng.next_int(max_width)))
+            return Ranges.of(*out)
+        return Gen(fn)
+
+    @staticmethod
+    def deps(space: int = 1000, max_entries: int = 12) -> Gen[Deps]:
+        ids = AccordGens.txn_ids()
+
+        def fn(rng: RandomSource) -> Deps:
+            b = DepsBuilder()
+            for _ in range(rng.next_int(max_entries + 1)):
+                dep = ids(rng)
+                if rng.decide(0.75):
+                    b.add_key(rng.next_int(space), dep)
+                else:
+                    s = rng.next_int(space - 1)
+                    b.add_range(Range(s, s + 1 + rng.next_int(32)), dep)
+            return b.build()
+        return Gen(fn)
+
+    @staticmethod
+    def routes(space: int = 1000) -> Gen[Route]:
+        keys = AccordGens.keys(space)
+
+        def fn(rng: RandomSource) -> Route:
+            ks = keys(rng)
+            home = ks[rng.next_int(len(ks))].token()
+            return Route.full(home, ks.to_unseekables())
+        return Gen(fn)
+
+
+def for_all(*gens: Gen, examples: int = 200, seed: int = 0):
+    """Decorator-style property runner (ref: utils/Property.java qt()):
+
+        @for_all(AccordGens.deps(), AccordGens.deps())
+        def prop(a, b):
+            assert ...
+
+    Runs ``examples`` cases from deterministic per-example seeds; a failing
+    example's assertion is re-raised with the replay seed attached."""
+    def run(prop: Callable) -> None:
+        for i in range(examples):
+            case_seed = seed * 1_000_003 + i
+            rng = RandomSource(case_seed)
+            args = [g(rng) for g in gens]
+            try:
+                prop(*args)
+            except AssertionError as e:
+                raise AssertionError(
+                    f"property failed (replay: RandomSource({case_seed}); "
+                    f"example #{i}): {e}\nargs={args!r}") from e
+    return run
