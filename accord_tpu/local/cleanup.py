@@ -150,6 +150,17 @@ def cleanup_store(safe: "SafeCommandStore") -> int:
             del store.commands[txn_id]
             store.transient_listeners.pop(txn_id, None)
         else:
+            # decide() required SHARD_REDUNDANT — an ExclusiveSyncPoint at
+            # or above this id applied at EVERY replica — so record the
+            # UNIVERSAL durability tier the truncation proves: a straggler
+            # fetching this record must be able to conclude "settled
+            # everywhere" (Propagate's purge gate), which mere Majority
+            # (set by InformDurable) does not license
+            from .status import Durability, Status
+            commands_mod.set_durability(
+                safe, txn_id,
+                Durability.Universal if cmd.has_been(Status.Applied)
+                else Durability.UniversalOrInvalidated)
             commands_mod.set_truncated_apply(safe, txn_id)
         released += 1
     _prune_cfks(store)

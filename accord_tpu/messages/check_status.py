@@ -138,6 +138,21 @@ class CheckStatus(Request):
         def map_fn(safe: SafeCommandStore):
             cmd = safe.if_present(txn_id)
             if cmd is None or cmd.save_status is SaveStatus.Uninitialised:
+                # the record may be GONE because cleanup erased it: if the
+                # store's durability watermarks prove everything at/below
+                # this id is durably settled on our slice, answer with the
+                # inference instead of a Nack (ref: the ErasedOrInvalidated
+                # inference, CheckStatus.java / Infer) — a straggler
+                # replica fetching a truncated txn must be able to learn
+                # "durably done everywhere" or it refetches forever
+                from .propagate import _propagate_min_epoch
+                owned = safe.store.ranges_for_epoch.all_between(
+                    _propagate_min_epoch(txn_id), txn_id.epoch())
+                if not owned.is_empty() and txn_id < \
+                        safe.store.durable_before.min_universal_before(owned):
+                    return CheckStatusOk(
+                        SaveStatus.Erased, Ballot.ZERO, Ballot.ZERO, None,
+                        Durability.UniversalOrInvalidated, None, None)
                 return CheckStatusNack()
             full = include is IncludeInfo.All
             return CheckStatusOk(

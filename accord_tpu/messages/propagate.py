@@ -61,6 +61,27 @@ class Propagate(Request):
             if status is Status.Invalidated:
                 commands.commit_invalidate(safe, txn_id)
                 return
+            from ..local.status import Durability
+            if status is Status.Truncated \
+                    and ok.durability >= Durability.UniversalOrInvalidated:
+                # The cluster durably truncated/erased this txn AT THE
+                # UNIVERSAL TIER (cleanup only truncates behind a shard-
+                # redundant watermark — an ExclusiveSyncPoint applied at
+                # EVERY replica — and records that tier; the erased-record
+                # inference answers UniversalOrInvalidated from the same
+                # watermark).  A copy stuck here is a dual-window or
+                # pre-bootstrap straggler, not a current serving owner —
+                # universal application included every current owner — so
+                # truncating it locally loses nothing and releases this
+                # store's drain + progress log (ref: Propagate.java's purge
+                # of cluster-erased state).  Majority durability alone must
+                # NOT take this branch: it does not prove this replica's
+                # copy is covered.
+                cmd = safe.if_present(txn_id)
+                if cmd is not None and not cmd.is_truncated():
+                    commands.set_durability(safe, txn_id, ok.durability)
+                    commands.set_truncated_apply(safe, txn_id)
+                return
             if ok.route is None or ok.partial_txn is None:
                 return
             # Sync points extend one epoch below: a dropped donor fetching a

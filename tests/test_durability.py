@@ -254,3 +254,108 @@ def test_fetch_max_conflict_covers_applied_write():
              for cmd in s.commands.values()
              if cmd.execute_at is not None and cmd.txn_id.kind().is_write())
     assert ts >= hi, (ts, hi)
+
+
+def test_fetch_unwedges_copy_of_cluster_erased_txn():
+    """A straggler copy stuck at ReadyToExecute after the cluster durably
+    truncated/erased the txn (dual-window / pre-bootstrap copies that
+    missed both the Apply and SetShardDurable rounds) must be released by
+    a fetch: peers whose record is GONE answer from their durability
+    watermarks (the ErasedOrInvalidated inference) and Propagate truncates
+    the local copy (ref: CheckStatus Infer + Propagate.java purge)."""
+    from accord_tpu.coordinate.fetch_data import fetch_data
+    from accord_tpu.local.status import SaveStatus
+    cluster = make_cluster(seed=41)
+    out = []
+    cluster.nodes[1].coordinate(kv_txn([10], {10: ("w",)})).begin(
+        lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+
+    # find the txn + a store holding it on node 3
+    tid = None
+    for s in cluster.nodes[3].command_stores.unsafe_all_stores():
+        for t, cmd in s.commands.items():
+            if t.kind().is_write() and cmd.save_status is SaveStatus.Applied:
+                tid, store, saved = t, s, cmd
+    assert tid is not None
+    route = saved.route
+
+    # drive durability until the record is truncated/erased cluster-wide
+    for _ in range(12):
+        for nid in sorted(cluster.nodes):
+            cluster.durability[nid].shard_tick()
+        cluster.run_until_quiescent()
+    gone = 0
+    for nid in (1, 2):
+        for s in cluster.nodes[nid].command_stores.unsafe_all_stores():
+            cmd = s.commands.get(tid)
+            if cmd is None or cmd.is_truncated():
+                gone += 1
+    assert gone > 0, "durability rounds never truncated the txn anywhere"
+
+    # regress node 3's copy to the wedge shape: ReadyToExecute, unapplied
+    store.commands[tid] = saved.updated(save_status=SaveStatus.ReadyToExecute)
+    fetched = []
+    fetch_data(cluster.nodes[3], tid, route.participants,
+               tid.epoch()).begin(lambda r, f: fetched.append((r, f)))
+    cluster.run_until_quiescent()
+    assert fetched and fetched[0][1] is None
+    cmd = store.commands.get(tid)
+    assert cmd is None or cmd.is_truncated() or \
+        cmd.save_status is SaveStatus.Applied, cmd
+    assert not (cmd is not None
+                and cmd.save_status is SaveStatus.ReadyToExecute), \
+        "straggler copy still wedged at ReadyToExecute"
+
+
+def test_fetch_unwedges_copy_when_all_peers_erased():
+    """The hardest straggler case: every peer ERASED the record entirely, so
+    the only knowledge left is the durability-watermark inference — the
+    fetch must still conclude 'universally settled' and truncate the stuck
+    copy (ref: the ErasedOrInvalidated inference; a Nack-everywhere answer
+    would refetch forever)."""
+    from accord_tpu.coordinate.fetch_data import fetch_data
+    from accord_tpu.local.status import SaveStatus
+    cluster = make_cluster(seed=43)
+    out = []
+    cluster.nodes[1].coordinate(kv_txn([10], {10: ("w",)})).begin(
+        lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    assert out[0][1] is None
+
+    tid = None
+    for s in cluster.nodes[3].command_stores.unsafe_all_stores():
+        for t, cmd in s.commands.items():
+            if t.kind().is_write() and cmd.save_status is SaveStatus.Applied:
+                tid, store, saved = t, s, cmd
+    assert tid is not None
+    route = saved.route
+
+    for _ in range(12):
+        for nid in sorted(cluster.nodes):
+            cluster.durability[nid].shard_tick()
+            cluster.durability[nid].global_tick()
+        cluster.run_until_quiescent()
+    # force the all-erased shape: peers drop the record entirely (their
+    # durable watermarks, which already passed the txn, stay)
+    for nid in (1, 2):
+        for s in cluster.nodes[nid].command_stores.unsafe_all_stores():
+            s.commands.pop(tid, None)
+    # sanity: the inference has ground to stand on somewhere
+    assert any(tid < s.durable_before.min_universal_before(
+                   s.ranges_for_epoch.all())
+               for nid in (1, 2)
+               for s in cluster.nodes[nid].command_stores.unsafe_all_stores()
+               if not s.ranges_for_epoch.all().is_empty()), \
+        "universal watermark never passed the txn; test setup is stale"
+
+    store.commands[tid] = saved.updated(save_status=SaveStatus.ReadyToExecute)
+    fetched = []
+    fetch_data(cluster.nodes[3], tid, route.participants,
+               tid.epoch()).begin(lambda r, f: fetched.append((r, f)))
+    cluster.run_until_quiescent()
+    cmd = store.commands.get(tid)
+    assert not (cmd is not None
+                and cmd.save_status is SaveStatus.ReadyToExecute), \
+        "straggler copy still wedged after all-peers-erased fetch"
