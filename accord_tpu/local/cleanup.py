@@ -28,7 +28,7 @@ from typing import TYPE_CHECKING
 
 from ..primitives.keys import Ranges
 from ..primitives.timestamp import TxnId
-from .status import SaveStatus, Status
+from .status import Durability, SaveStatus, Status
 
 if TYPE_CHECKING:
     from .command_store import SafeCommandStore
@@ -155,11 +155,15 @@ def cleanup_store(safe: "SafeCommandStore") -> int:
             # UNIVERSAL durability tier the truncation proves: a straggler
             # fetching this record must be able to conclude "settled
             # everywhere" (Propagate's purge gate), which mere Majority
-            # (set by InformDurable) does not license
-            from .status import Durability, Status
+            # (set by InformDurable) does not license.  Pure Universal only
+            # for genuinely APPLIED commands: has_been(Applied) alone is
+            # also true for Invalidated (it ranks above Applied), which
+            # never applied writes anywhere.
+            applied = cmd.has_been(Status.Applied) \
+                and not cmd.is_invalidated() and not cmd.is_truncated()
             commands_mod.set_durability(
                 safe, txn_id,
-                Durability.Universal if cmd.has_been(Status.Applied)
+                Durability.Universal if applied
                 else Durability.UniversalOrInvalidated)
             commands_mod.set_truncated_apply(safe, txn_id)
         released += 1

@@ -31,7 +31,8 @@ class CheckStatusOk(Reply):
                  accepted: Ballot, execute_at: Optional[Timestamp],
                  durability: Durability, route: Optional[Route],
                  home_key: Optional[int],
-                 partial_txn=None, partial_deps=None, writes=None, result=None):
+                 partial_txn=None, partial_deps=None, writes=None,
+                 result=None, truncated_covering=None):
         self.save_status = save_status
         self.promised = promised
         self.accepted = accepted
@@ -43,6 +44,12 @@ class CheckStatusOk(Reply):
         self.partial_deps = partial_deps
         self.writes = writes
         self.result = result
+        # the ranges over which a Truncated/Erased claim is PROVEN (the
+        # replying store's durably-settled slice): durability itself merges
+        # as a txn-global max, so a purge acting on truncation must check
+        # its own slice against this, not the scalar (a one-shard erasure
+        # must not purge another shard's unapplied copy)
+        self.truncated_covering = truncated_covering
 
     def is_ok(self) -> bool:
         return True
@@ -72,11 +79,20 @@ class CheckStatusOk(Reply):
             _merge_partial_txn(hi.partial_txn, lo.partial_txn),
             _merge_partial_deps(hi, lo),
             hi.writes if hi.writes is not None else lo.writes,
-            hi.result if hi.result is not None else lo.result)
+            hi.result if hi.result is not None else lo.result,
+            _union_coverings(hi.truncated_covering, lo.truncated_covering))
 
     def __repr__(self):
         return (f"CheckStatusOk({self.save_status.name}, promised={self.promised}, "
                 f"durability={self.durability.name})")
+
+
+def _union_coverings(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a.with_(b)
 
 
 def _merge_partial_txn(a, b):
@@ -152,9 +168,19 @@ class CheckStatus(Request):
                         safe.store.durable_before.min_universal_before(owned):
                     return CheckStatusOk(
                         SaveStatus.Erased, Ballot.ZERO, Ballot.ZERO, None,
-                        Durability.UniversalOrInvalidated, None, None)
+                        Durability.UniversalOrInvalidated, None, None,
+                        truncated_covering=owned)
                 return CheckStatusNack()
             full = include is IncludeInfo.All
+            covering = None
+            if cmd.is_truncated():
+                # the truncation claim is proven exactly for this store's
+                # slice (cleanup required shard-redundancy here)
+                from ..local.redundant import _as_ranges
+                owned = safe.store.ranges_for_epoch.all()
+                participants = cmd.participants()
+                covering = (owned if participants is None
+                            else owned.intersecting(_as_ranges(participants)))
             return CheckStatusOk(
                 cmd.save_status, cmd.promised, cmd.accepted, cmd.execute_at,
                 cmd.durability,
@@ -163,7 +189,8 @@ class CheckStatus(Request):
                 cmd.partial_txn if full else None,
                 cmd.partial_deps if full else None,
                 cmd.writes if full else None,
-                cmd.result if full else None)
+                cmd.result if full else None,
+                truncated_covering=covering)
 
         def reduce_fn(a, b):
             if not a.is_ok():

@@ -61,28 +61,45 @@ class Propagate(Request):
             if status is Status.Invalidated:
                 commands.commit_invalidate(safe, txn_id)
                 return
-            from ..local.status import Durability
-            if status is Status.Truncated \
-                    and ok.durability >= Durability.UniversalOrInvalidated:
-                # The cluster durably truncated/erased this txn AT THE
-                # UNIVERSAL TIER (cleanup only truncates behind a shard-
-                # redundant watermark — an ExclusiveSyncPoint applied at
-                # EVERY replica — and records that tier; the erased-record
-                # inference answers UniversalOrInvalidated from the same
-                # watermark).  A copy stuck here is a dual-window or
-                # pre-bootstrap straggler, not a current serving owner —
-                # universal application included every current owner — so
-                # truncating it locally loses nothing and releases this
-                # store's drain + progress log (ref: Propagate.java's purge
-                # of cluster-erased state).  Majority durability alone must
-                # NOT take this branch: it does not prove this replica's
-                # copy is covered.
+
+            def try_purge() -> None:
+                """Last resort when no apply/commit upgrade could act: the
+                cluster durably truncated/erased this txn AT THE UNIVERSAL
+                TIER over a proven covering that includes OUR slice
+                (cleanup only truncates behind a shard-redundant watermark
+                — an ExclusiveSyncPoint applied at EVERY replica — and
+                records that tier; the erased-record inference answers
+                from the same watermark, scoped to the answering store's
+                slice).  Then a copy stuck here is a dual-window or
+                pre-bootstrap straggler, not a current serving owner, and
+                truncating it locally loses nothing while releasing this
+                store's drain + progress log (ref: Propagate.java's purge
+                of cluster-erased state).  Majority durability, or a
+                covering from another shard alone, must NOT purge: neither
+                proves THIS replica's copy is covered — and the purge runs
+                only AFTER the apply ladder, so fetched writes drain
+                rather than truncate."""
+                from ..local.status import Durability
+                if status is not Status.Truncated \
+                        or ok.durability < Durability.UniversalOrInvalidated:
+                    return
                 cmd = safe.if_present(txn_id)
-                if cmd is not None and not cmd.is_truncated():
-                    commands.set_durability(safe, txn_id, ok.durability)
-                    commands.set_truncated_apply(safe, txn_id)
-                return
+                if cmd is None or cmd.is_truncated():
+                    return
+                my_slice = safe.store.ranges_for_epoch.all()
+                participants = cmd.participants()
+                if participants is not None:
+                    from ..local.redundant import _as_ranges
+                    my_slice = my_slice.intersecting(_as_ranges(participants))
+                if ok.truncated_covering is None or (
+                        not my_slice.without(ok.truncated_covering)
+                        .is_empty()):
+                    return   # the proof does not cover our slice
+                commands.set_durability(safe, txn_id, ok.durability)
+                commands.set_truncated_apply(safe, txn_id)
+
             if ok.route is None or ok.partial_txn is None:
+                try_purge()
                 return
             # Sync points extend one epoch below: a dropped donor fetching a
             # bootstrap fence's outcome must be able to apply it over its
@@ -125,6 +142,7 @@ class Propagate(Request):
                 return
             if status >= Status.PreCommitted and ok.execute_at is not None:
                 commands.precommit(safe, txn_id, ok.execute_at)
+            try_purge()
 
         node.for_each_local(PreLoadContext.for_txn(txn_id), self.participants,
                             _propagate_min_epoch(txn_id), to_epoch,

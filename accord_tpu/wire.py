@@ -267,7 +267,8 @@ def _register_messages() -> None:
     register_fields(check_status.CheckStatusOk,
                     ["save_status", "promised", "accepted", "execute_at",
                      "durability", "route", "home_key", "partial_txn",
-                     "partial_deps", "writes", "result"])
+                     "partial_deps", "writes", "result",
+                     "truncated_covering"])
     register_fields(check_status.CheckStatusNack, [])
 
     register_fields(inform.InformDurable, ["txn_id", "route", "durability"])
