@@ -56,7 +56,9 @@ class CoordinateTransaction(api.Callback):
             if getattr(reply, "rejected", False):
                 # fenced by an ExclusiveSyncPoint: this TxnId can never
                 # decide — the caller retries with a fresh id
-                self._fail(Rejected(self.txn_id))
+                self._fail(Rejected(self.txn_id,
+                                    floor=getattr(reply, "reject_floor",
+                                                  None)))
             else:
                 # a higher ballot owns this txn: a recovery coordinator
                 # preempted us

@@ -32,7 +32,14 @@ class Truncated(CoordinationFailed):
 
 class Rejected(CoordinationFailed):
     """Fenced by an ExclusiveSyncPoint (rejectBefore): this TxnId can never
-    decide; retry the transaction with a fresh, higher TxnId."""
+    decide; retry the transaction with a fresh, higher TxnId.  ``floor`` is
+    the rejecting fence's bound when known — the retry bumps the local HLC
+    past it so the fresh id clears the fence (a drift-behind coordinator
+    would otherwise re-issue doomed ids until its clock caught up)."""
+
+    def __init__(self, txn_id: TxnId = None, msg: str = "", floor=None):
+        super().__init__(txn_id, msg)
+        self.floor = floor
 
 
 class Exhausted(CoordinationFailed):

@@ -56,7 +56,9 @@ class _Propose(api.Callback):
         if not reply.is_ok():
             self.done = True
             if getattr(reply, "rejected", False):
-                self.result.set_failure(Rejected(self.txn_id))
+                self.result.set_failure(Rejected(
+                    self.txn_id,
+                    floor=getattr(reply, "reject_floor", None)))
             else:
                 self.result.set_failure(Preempted(self.txn_id))
             return

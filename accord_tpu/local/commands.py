@@ -90,7 +90,10 @@ def preaccept(safe: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
         # carries no payload and its fence marking max-merges to a no-op.
         floor = safe.store.reject_before_floor(partial_txn.keys)
         if floor is not None and txn_id < floor:
-            return AcceptOutcome.Rejected, None
+            # return the fence bound: the coordinator bumps its HLC past it
+            # before retrying, or a drift-behind node re-issues doomed ids
+            # until its clock catches up on its own
+            return AcceptOutcome.Rejected, floor
 
     witnessed_at = _compute_witnessed_at(safe, txn_id, partial_txn, permit_fast_path)
     safe.update_max_conflicts(partial_txn.keys, witnessed_at)
@@ -226,7 +229,7 @@ def accept(safe: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
         # lose a committed write).
         floor = safe.store.reject_before_floor(keys)
         if floor is not None and txn_id < floor:
-            return AcceptOutcome.Rejected, None
+            return AcceptOutcome.Rejected, floor
 
     new_status = (SaveStatus.AcceptedWithDefinition if cmd.is_defined()
                   else SaveStatus.Accepted)

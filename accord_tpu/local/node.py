@@ -351,6 +351,22 @@ class Node:
             # its fence id and re-marks its watermark.
             if isinstance(failure, Rejected) and not explicit_id \
                     and _retries < 5:
+                floor = getattr(failure, "floor", None)
+                if floor is not None:
+                    # learn the fence bound so the retry's fresh id clears
+                    # it instead of being re-rejected until the local clock
+                    # drifts past on its own.  Timestamps are epoch-major:
+                    # a fence minted in a later epoch needs the topology
+                    # too, not just the HLC — retry under with_epoch
+                    self.unique_now_at_least(floor)
+                    if floor.epoch() > self.epoch():
+                        superseded["flag"] = True
+                        self._coordinating.pop(txn_id, None)
+                        self.with_epoch(
+                            floor.epoch(),
+                            lambda: self._invalidate_then_retry(
+                                txn, txn_id, _retries, result))
+                        return
                 # fenced by an ExclusiveSyncPoint: the TxnId can never newly
                 # decide here — but unfenced replicas may retain (fast-path)
                 # PreAccepts of it that a later recovery could complete.

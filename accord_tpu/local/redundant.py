@@ -106,6 +106,24 @@ class RedundantBefore:
     def _merge(self, ranges: Ranges, entry: RedundantEntry) -> None:
         self._map = self._map.add(ranges, entry, lambda a, b: a.merge(b))
 
+    def shard_redundant_ranges(self, txn_id: TxnId,
+                               within: Ranges) -> Ranges:
+        """The subranges of ``within`` where ``txn_id`` is PROVEN
+        SHARD_REDUNDANT (an ExclusiveSyncPoint at or above it applied at
+        every replica).  This — not raw ownership — is what a truncation
+        claim may advertise as its covering: watermark gaps and
+        majority-only segments prove nothing."""
+        from ..primitives.keys import Range
+        out = []
+
+        def fold(entry, start, end, acc):
+            if entry.status_of(txn_id) is RedundantStatus.SHARD_REDUNDANT:
+                out.append(Range(start, end))
+            return acc
+
+        self._map.fold_with_bounds(fold, None)
+        return Ranges.of(*out).intersecting(within)
+
     def status(self, txn_id: TxnId, participants) -> RedundantStatus:
         ranges = _as_ranges(participants)
         statuses = [e.status_of(txn_id) for e in self._map.values_intersecting(ranges)]
@@ -284,6 +302,16 @@ class DurableBefore:
             self._map = self._map.add(
                 rs, DurableBefore.Entry(majority, universal),
                 lambda a, b: a.merge(b))
+
+
+def participant_slice(owned: Ranges, participants) -> Ranges:
+    """``owned`` ∩ the participants' token coverage — the one definition of
+    'this store's slice of the txn' shared by the truncation replier
+    (CheckStatus) and the purger (Propagate); a drift between the two
+    breaks the proof-containment check."""
+    if participants is None:
+        return owned
+    return owned.intersecting(_as_ranges(participants))
 
 
 def _as_ranges(keys_or_ranges) -> Ranges:

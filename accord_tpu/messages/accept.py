@@ -22,11 +22,17 @@ class AcceptReply(Reply):
 
     def __init__(self, superseded_by: Optional[Ballot] = None,
                  deps: Optional[PartialDeps] = None,
-                 redundant: bool = False, rejected: bool = False):
+                 redundant: bool = False, rejected: bool = False,
+                 reject_floor=None):
         self.superseded_by = superseded_by
         self.deps = deps
         self.redundant = redundant
         self.rejected = rejected   # fenced by rejectBefore: retry w/ new id
+        # the fence bound that rejected us: the coordinator bumps its HLC
+        # past it so the retry's fresh id clears the fence (a drift-behind
+        # node would otherwise re-issue doomed ids until its clock catches
+        # up on its own)
+        self.reject_floor = reject_floor
 
     def is_ok(self) -> bool:
         return self.superseded_by is None and not self.redundant \
@@ -71,7 +77,7 @@ class Accept(TxnRequest):
             if outcome is commands.AcceptOutcome.Redundant:
                 return AcceptReply(redundant=True)
             if outcome is commands.AcceptOutcome.Rejected:
-                return AcceptReply(rejected=True)
+                return AcceptReply(rejected=True, reject_floor=superseded)
             # return deps witnessed up to executeAt for the coordinator's
             # final merge (ref: Accept.java AcceptReply.deps)
             deps = calculate_partial_deps(safe, txn_id, partial_txn.keys,

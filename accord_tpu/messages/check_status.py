@@ -166,21 +166,29 @@ class CheckStatus(Request):
                     _propagate_min_epoch(txn_id), txn_id.epoch())
                 if not owned.is_empty() and txn_id < \
                         safe.store.durable_before.min_universal_before(owned):
+                    # advertise only the PROVEN shard-redundant subranges:
+                    # watermark gaps / majority-only segments must not be
+                    # claimed (a purger trusting an overclaimed covering
+                    # could drop a write a majority never settled)
+                    covering = safe.redundant_before() \
+                        .shard_redundant_ranges(txn_id, owned)
                     return CheckStatusOk(
                         SaveStatus.Erased, Ballot.ZERO, Ballot.ZERO, None,
                         Durability.UniversalOrInvalidated, None, None,
-                        truncated_covering=owned)
+                        truncated_covering=(covering if not covering.is_empty()
+                                            else None))
                 return CheckStatusNack()
             full = include is IncludeInfo.All
             covering = None
             if cmd.is_truncated():
-                # the truncation claim is proven exactly for this store's
-                # slice (cleanup required shard-redundancy here)
-                from ..local.redundant import _as_ranges
+                # the truncation claim is proven exactly for the shard-
+                # redundant part of this store's slice of the txn
+                from ..local.redundant import participant_slice
                 owned = safe.store.ranges_for_epoch.all()
-                participants = cmd.participants()
-                covering = (owned if participants is None
-                            else owned.intersecting(_as_ranges(participants)))
+                covering = safe.redundant_before().shard_redundant_ranges(
+                    txn_id, participant_slice(owned, cmd.participants()))
+                if covering.is_empty():
+                    covering = None
             return CheckStatusOk(
                 cmd.save_status, cmd.promised, cmd.accepted, cmd.execute_at,
                 cmd.durability,

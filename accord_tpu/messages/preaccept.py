@@ -91,8 +91,11 @@ class PreAcceptOk(Reply):
 class PreAcceptNack(Reply):
     type = MessageType.PRE_ACCEPT_RSP
 
-    def __init__(self, reason: str = "Preempted"):
+    def __init__(self, reason: str = "Preempted", reject_floor=None):
         self.reason = reason   # "Preempted" | "Rejected" (fence) | "Truncated"
+        # for "Rejected": the fence bound, so the coordinator's retry can
+        # bump its HLC past it (see AcceptReply.reject_floor)
+        self.reject_floor = reject_floor
 
     @property
     def rejected(self) -> bool:
@@ -137,7 +140,7 @@ class PreAccept(TxnRequest):
             if outcome is commands.AcceptOutcome.Truncated:
                 return PreAcceptNack("Truncated")
             if outcome is commands.AcceptOutcome.Rejected:
-                return PreAcceptNack("Rejected")
+                return PreAcceptNack("Rejected", reject_floor=witnessed_at)
             if outcome is commands.AcceptOutcome.Redundant:
                 cmd = safe.get(txn_id)
                 witnessed_at = cmd.execute_at

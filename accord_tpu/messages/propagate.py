@@ -62,44 +62,39 @@ class Propagate(Request):
                 commands.commit_invalidate(safe, txn_id)
                 return
 
-            def try_purge() -> None:
-                """Last resort when no apply/commit upgrade could act: the
-                cluster durably truncated/erased this txn AT THE UNIVERSAL
-                TIER over a proven covering that includes OUR slice
-                (cleanup only truncates behind a shard-redundant watermark
-                — an ExclusiveSyncPoint applied at EVERY replica — and
-                records that tier; the erased-record inference answers
-                from the same watermark, scoped to the answering store's
-                slice).  Then a copy stuck here is a dual-window or
+            def _purge_eligible() -> bool:
+                """The cluster durably truncated/erased this txn AT THE
+                UNIVERSAL TIER over a proven covering that includes OUR
+                slice (cleanup truncates only behind a shard-redundant
+                watermark — an ExclusiveSyncPoint applied at EVERY replica
+                — and replies advertise only their proven shard-redundant
+                subranges).  Then a copy stuck here is a dual-window or
                 pre-bootstrap straggler, not a current serving owner, and
                 truncating it locally loses nothing while releasing this
                 store's drain + progress log (ref: Propagate.java's purge
                 of cluster-erased state).  Majority durability, or a
                 covering from another shard alone, must NOT purge: neither
-                proves THIS replica's copy is covered — and the purge runs
-                only AFTER the apply ladder, so fetched writes drain
-                rather than truncate."""
+                proves THIS replica's copy is covered."""
                 from ..local.status import Durability
                 if status is not Status.Truncated \
                         or ok.durability < Durability.UniversalOrInvalidated:
-                    return
+                    return False
                 cmd = safe.if_present(txn_id)
                 if cmd is None or cmd.is_truncated():
-                    return
-                my_slice = safe.store.ranges_for_epoch.all()
-                participants = cmd.participants()
-                if participants is not None:
-                    from ..local.redundant import _as_ranges
-                    my_slice = my_slice.intersecting(_as_ranges(participants))
-                if ok.truncated_covering is None or (
-                        not my_slice.without(ok.truncated_covering)
-                        .is_empty()):
-                    return   # the proof does not cover our slice
+                    return False
+                from ..local.redundant import participant_slice
+                my_slice = participant_slice(
+                    safe.store.ranges_for_epoch.all(), cmd.participants())
+                return ok.truncated_covering is not None and \
+                    my_slice.without(ok.truncated_covering).is_empty()
+
+            def do_purge() -> None:
                 commands.set_durability(safe, txn_id, ok.durability)
                 commands.set_truncated_apply(safe, txn_id)
 
             if ok.route is None or ok.partial_txn is None:
-                try_purge()
+                if _purge_eligible():
+                    do_purge()
                 return
             # Sync points extend one epoch below: a dropped donor fetching a
             # bootstrap fence's outcome must be able to apply it over its
@@ -133,6 +128,15 @@ class Propagate(Request):
                 commands.apply(safe, txn_id, ok.route, ok.execute_at, deps,
                                partial_txn, ok.writes, ok.result)
                 return
+            # purge sits BETWEEN the apply rung and the commit/precommit
+            # upgrades: fetched writes always drain in preference to a
+            # purge, but when the cluster durably erased the outcome (no
+            # reply can ever carry it) re-committing on every fetch would
+            # wedge the copy at Stable forever — the purge must win over
+            # the pointless upgrade
+            if _purge_eligible():
+                do_purge()
+                return
             if status >= Status.Committed and ok.execute_at is not None \
                     and ok.partial_deps is not None \
                     and _deps_cover(ok.partial_deps, ok.route, owned):
@@ -142,7 +146,6 @@ class Propagate(Request):
                 return
             if status >= Status.PreCommitted and ok.execute_at is not None:
                 commands.precommit(safe, txn_id, ok.execute_at)
-            try_purge()
 
         node.for_each_local(PreLoadContext.for_txn(txn_id), self.participants,
                             _propagate_min_epoch(txn_id), to_epoch,

@@ -222,14 +222,16 @@ def _register_messages() -> None:
     register_fields(preaccept.PreAccept,
                     ["txn_id", "txn", "route", "max_epoch", "min_epoch"])
     register_fields(preaccept.PreAcceptOk, ["txn_id", "witnessed_at", "deps"])
-    register_fields(preaccept.PreAcceptNack, ["reason"])
+    register_fields(preaccept.PreAcceptNack,
+                    ["reason", "reject_floor"])
 
     register_fields(accept.Accept,
                     ["txn_id", "txn", "route", "ballot", "execute_at",
                      "deps", "min_epoch", "max_epoch"])
     register_fields(accept.AcceptInvalidate, ["txn_id", "route", "ballot"])
     register_fields(accept.AcceptReply,
-                    ["superseded_by", "deps", "redundant", "rejected"])
+                    ["superseded_by", "deps", "redundant", "rejected",
+                     "reject_floor"])
 
     register_enum(commit.CommitKind)
     register_fields(commit.Commit,
