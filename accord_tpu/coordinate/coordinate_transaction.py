@@ -113,8 +113,11 @@ class CoordinateTransaction(api.Callback):
                 # awaits every LOWER TxnId, and a txn reading from
                 # still-bootstrapping new-epoch replicas can otherwise gate
                 # the very bootstrap it waits on; the fresh id sits ABOVE
-                # the fence, decoupling them.
-                self.result.set_failure(Rejected(self.txn_id))
+                # the fence, decoupling them.  Carry the executeAt as the
+                # floor: the retry bumps its HLC/topology past it instead
+                # of re-allocating in the stale epoch.
+                self.result.set_failure(Rejected(self.txn_id,
+                                                 floor=execute_at))
                 return
             deps = Deps.merge([ok.deps for ok in oks])
             self.node.agent.events_listener().on_slow_path_taken(self.txn_id, deps)

@@ -357,15 +357,29 @@ class Node:
                     # it instead of being re-rejected until the local clock
                     # drifts past on its own.  Timestamps are epoch-major:
                     # a fence minted in a later epoch needs the topology
-                    # too, not just the HLC — retry under with_epoch
+                    # too, not just the HLC — retry under with_epoch, with
+                    # a deadline fallback (await_epoch never fails on its
+                    # own; an unreachable config service must surface the
+                    # original Rejected rather than hang the client)
                     self.unique_now_at_least(floor)
                     if floor.epoch() > self.epoch():
                         superseded["flag"] = True
                         self._coordinating.pop(txn_id, None)
-                        self.with_epoch(
-                            floor.epoch(),
-                            lambda: self._invalidate_then_retry(
-                                txn, txn_id, _retries, result))
+                        started = {"flag": False}
+
+                        def go():
+                            if not started["flag"]:
+                                started["flag"] = True
+                                self._invalidate_then_retry(
+                                    txn, txn_id, _retries, result)
+
+                        def bail():
+                            if not started["flag"] and not result.is_done():
+                                started["flag"] = True
+                                result.settle(None, failure)
+
+                        self.with_epoch(floor.epoch(), go)
+                        self.scheduler.once(15_000_000, bail)
                         return
                 # fenced by an ExclusiveSyncPoint: the TxnId can never newly
                 # decide here — but unfenced replicas may retain (fast-path)

@@ -117,7 +117,13 @@ class RedundantBefore:
         out = []
 
         def fold(entry, start, end, acc):
-            if entry.status_of(txn_id) is RedundantStatus.SHARD_REDUNDANT:
+            # test redundant_before DIRECTLY: status_of masks it behind
+            # pre-bootstrap/stale, but those describe THIS store's data
+            # health — the shard-redundancy proof (ESP applied at every
+            # replica) holds regardless, and hiding it would silently
+            # shrink advertised truncation coverings (a straggler could
+            # then never purge)
+            if txn_id < entry.redundant_before:
                 out.append(Range(start, end))
             return acc
 

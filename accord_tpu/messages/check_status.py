@@ -166,17 +166,15 @@ class CheckStatus(Request):
                     _propagate_min_epoch(txn_id), txn_id.epoch())
                 if not owned.is_empty() and txn_id < \
                         safe.store.durable_before.min_universal_before(owned):
-                    # advertise only the PROVEN shard-redundant subranges:
-                    # watermark gaps / majority-only segments must not be
-                    # claimed (a purger trusting an overclaimed covering
-                    # could drop a write a majority never settled)
-                    covering = safe.redundant_before() \
-                        .shard_redundant_ranges(txn_id, owned)
+                    # min_universal_before is gap-aware (an uncovered
+                    # segment yields NONE and fails the gate), so the
+                    # universal-tier proof already spans the whole owned
+                    # slice — advertise it all; narrowing further only
+                    # costs the straggler's liveness
                     return CheckStatusOk(
                         SaveStatus.Erased, Ballot.ZERO, Ballot.ZERO, None,
                         Durability.UniversalOrInvalidated, None, None,
-                        truncated_covering=(covering if not covering.is_empty()
-                                            else None))
+                        truncated_covering=owned)
                 return CheckStatusNack()
             full = include is IncludeInfo.All
             covering = None

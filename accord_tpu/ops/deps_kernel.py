@@ -102,21 +102,11 @@ def empty_table(capacity: int, max_intervals: int) -> DepsTable:
     )
 
 
-@jax.jit
-def calculate_deps(table: DepsTable, query: DepsQuery,
-                   prune_msb: jnp.ndarray = None, prune_lsb: jnp.ndarray = None,
-                   prune_node: jnp.ndarray = None
-                   ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
-    """Returns (dep_mask bool[B, N], max_conflict (msb, lsb, node)[B]).
-
-    max_conflict covers every live overlapping slot regardless of TxnId order
-    or kind — it is the executeAt floor, not the dep set.
-    """
-    if prune_msb is None:
-        prune_msb = jnp.zeros((), jnp.int64)
-        prune_lsb = jnp.zeros((), jnp.int64)
-        prune_node = jnp.zeros((), jnp.int32)
-
+def _dep_mask_and_conflict(table: DepsTable, query: DepsQuery,
+                           prune_msb, prune_lsb, prune_node):
+    """Traceable core shared by calculate_deps (mask + max_conflict) and
+    the flat-CSR path (mask only — computing the conflict floor there
+    would be pure wasted VPU time, its consumer discards it)."""
     live = table.status >= SLOT_TRANSITIVE                     # [N]
     not_invalidated = table.status != SLOT_INVALIDATED         # [N]
 
@@ -148,7 +138,25 @@ def calculate_deps(table: DepsTable, query: DepsQuery,
                          prune_msb, prune_lsb, prune_node)
 
     dep_mask = conflict & witnessed & earlier & not_self & above_floor[None, :]
+    return dep_mask, conflict
 
+
+@jax.jit
+def calculate_deps(table: DepsTable, query: DepsQuery,
+                   prune_msb: jnp.ndarray = None, prune_lsb: jnp.ndarray = None,
+                   prune_node: jnp.ndarray = None
+                   ) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
+    """Returns (dep_mask bool[B, N], max_conflict (msb, lsb, node)[B]).
+
+    max_conflict covers every live overlapping slot regardless of TxnId order
+    or kind — it is the executeAt floor, not the dep set.
+    """
+    if prune_msb is None:
+        prune_msb = jnp.zeros((), jnp.int64)
+        prune_lsb = jnp.zeros((), jnp.int64)
+        prune_node = jnp.zeros((), jnp.int32)
+    dep_mask, conflict = _dep_mask_and_conflict(table, query, prune_msb,
+                                                prune_lsb, prune_node)
     # [1, N] inputs broadcast against the [B, N] mask inside masked_ts_max
     max_conflict = masked_ts_max(table.msb[None, :], table.lsb[None, :],
                                  table.node[None, :], conflict)
@@ -232,7 +240,9 @@ def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
     widest row, ``s`` the batch total; both sticky-learned by the caller
     from the header counts."""
     query = query_from_qmat(qmat, m)
-    mask, _mc = calculate_deps(table, query)
+    mask, _conflict = _dep_mask_and_conflict(
+        table, query, jnp.zeros((), jnp.int64), jnp.zeros((), jnp.int64),
+        jnp.zeros((), jnp.int32))
     k = min(k, mask.shape[1])
     idx, counts = _compact_topk(mask, k)                       # [B,k],[B]
     row_end = jnp.cumsum(counts)                               # [B]
