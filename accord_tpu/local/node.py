@@ -351,48 +351,30 @@ class Node:
             # its fence id and re-marks its watermark.
             if isinstance(failure, Rejected) and not explicit_id \
                     and _retries < 5:
-                floor = getattr(failure, "floor", None)
-                if floor is not None:
-                    # learn the fence bound so the retry's fresh id clears
-                    # it instead of being re-rejected until the local clock
-                    # drifts past on its own.  Timestamps are epoch-major:
-                    # a fence minted in a later epoch needs the topology
-                    # too, not just the HLC — retry under with_epoch, with
-                    # a deadline fallback (await_epoch never fails on its
-                    # own; an unreachable config service must surface the
-                    # original Rejected rather than hang the client)
-                    self.unique_now_at_least(floor)
-                    if floor.epoch() > self.epoch():
-                        superseded["flag"] = True
-                        self._coordinating.pop(txn_id, None)
-                        started = {"flag": False}
-
-                        def go():
-                            if not started["flag"]:
-                                started["flag"] = True
-                                self._invalidate_then_retry(
-                                    txn, txn_id, _retries, result)
-
-                        def bail():
-                            if not started["flag"] and not result.is_done():
-                                started["flag"] = True
-                                result.settle(None, failure)
-
-                        self.with_epoch(floor.epoch(), go)
-                        self.scheduler.once(15_000_000, bail)
-                        return
                 # fenced by an ExclusiveSyncPoint: the TxnId can never newly
                 # decide here — but unfenced replicas may retain (fast-path)
                 # PreAccepts of it that a later recovery could complete.
-                # Invalidate the old id FIRST, and only then retry with a
-                # fresh id (ref: CoordinateTransaction.java:87-94
+                # Invalidate the old id FIRST (always immediately — it runs
+                # in the OLD id's epoch), and only then retry with a fresh
+                # id (ref: CoordinateTransaction.java:87-94
                 # proposeAndCommitInvalidate before any client retry);
                 # retrying immediately risks the payload applying under both
                 # ids.  Mark this attempt superseded so its watchdog does
-                # not race the invalidation.
+                # not race the invalidation.  When the rejecting fence's
+                # bound is known, bump the HLC past it so the fresh id
+                # clears the fence; a fence minted in a LATER epoch
+                # additionally makes the retry wait for that topology
+                # (epoch-major timestamps — see _invalidate_then_retry).
+                floor = getattr(failure, "floor", None)
+                retry_epoch = None
+                if floor is not None:
+                    self.unique_now_at_least(floor)
+                    if floor.epoch() > self.epoch():
+                        retry_epoch = floor.epoch()
                 superseded["flag"] = True
                 self._coordinating.pop(txn_id, None)
-                self._invalidate_then_retry(txn, txn_id, _retries, result)
+                self._invalidate_then_retry(txn, txn_id, _retries, result,
+                                            retry_at_epoch=retry_epoch)
                 return
             result.settle(value, failure)
 
@@ -444,12 +426,17 @@ class Node:
 
     def _invalidate_then_retry(self, txn: Txn, old_id: TxnId, retries: int,
                                result: async_chain.AsyncResult,
-                               attempt: int = 0) -> None:
+                               attempt: int = 0,
+                               retry_at_epoch: Optional[int] = None) -> None:
         """Invalidate a fence-Rejected TxnId before the client retry
         (ref: coordinate/Invalidate.java proposeAndCommitInvalidate via
         CoordinateTransaction.java:87-94).  If invalidation reports the old
         id redundant — it actually decided somewhere — adopt its outcome
-        instead of issuing a duplicate transaction."""
+        instead of issuing a duplicate transaction.  ``retry_at_epoch``
+        makes the FRESH id wait for a later fence epoch's topology;
+        invalidation itself always runs immediately in the old id's epoch
+        (deferring it would leave recoverable PreAccepts of the old id
+        while the client already resubmitted — the double-apply hazard)."""
         from ..coordinate.recover import (Recover, _next_ballot_bits,
                                           _propose_invalidate)
         from ..primitives.timestamp import Ballot
@@ -459,7 +446,25 @@ class Node:
                                                old_id.epoch())
 
         def retry():
-            self.coordinate(txn, _retries=retries + 1).begin(result.settle)
+            def go():
+                self.coordinate(txn, _retries=retries + 1).begin(
+                    result.settle)
+            if retry_at_epoch is None or retry_at_epoch <= self.epoch():
+                go()
+                return
+            fired = {"flag": False}
+
+            def once():
+                if not fired["flag"]:
+                    fired["flag"] = True
+                    go()
+
+            # await_epoch never fails on its own: back it with a deadline
+            # that retries in the CURRENT epoch rather than hanging the
+            # client (the fresh id may be re-rejected, but retries are
+            # bounded and the old id is already invalidated)
+            self.with_epoch(retry_at_epoch, once)
+            self.scheduler.once(15_000_000, once)
 
         def adopt():
             # the old id reached a decision after all: finish it and hand

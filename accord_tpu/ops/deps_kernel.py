@@ -103,10 +103,14 @@ def empty_table(capacity: int, max_intervals: int) -> DepsTable:
 
 
 def _dep_mask_and_conflict(table: DepsTable, query: DepsQuery,
-                           prune_msb, prune_lsb, prune_node):
+                           prune_msb=None, prune_lsb=None, prune_node=None):
     """Traceable core shared by calculate_deps (mask + max_conflict) and
-    the flat-CSR path (mask only — computing the conflict floor there
-    would be pure wasted VPU time, its consumer discards it)."""
+    the flat-CSR path (mask only; XLA dead-code-eliminates the unused
+    conflict reduce there).  ``prune_* = None`` means no floor."""
+    if prune_msb is None:
+        prune_msb = jnp.zeros((), jnp.int64)
+        prune_lsb = jnp.zeros((), jnp.int64)
+        prune_node = jnp.zeros((), jnp.int32)
     live = table.status >= SLOT_TRANSITIVE                     # [N]
     not_invalidated = table.status != SLOT_INVALIDATED         # [N]
 
@@ -151,10 +155,6 @@ def calculate_deps(table: DepsTable, query: DepsQuery,
     max_conflict covers every live overlapping slot regardless of TxnId order
     or kind — it is the executeAt floor, not the dep set.
     """
-    if prune_msb is None:
-        prune_msb = jnp.zeros((), jnp.int64)
-        prune_lsb = jnp.zeros((), jnp.int64)
-        prune_node = jnp.zeros((), jnp.int32)
     dep_mask, conflict = _dep_mask_and_conflict(table, query, prune_msb,
                                                 prune_lsb, prune_node)
     # [1, N] inputs broadcast against the [B, N] mask inside masked_ts_max
@@ -240,9 +240,7 @@ def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
     widest row, ``s`` the batch total; both sticky-learned by the caller
     from the header counts."""
     query = query_from_qmat(qmat, m)
-    mask, _conflict = _dep_mask_and_conflict(
-        table, query, jnp.zeros((), jnp.int64), jnp.zeros((), jnp.int64),
-        jnp.zeros((), jnp.int32))
+    mask, _conflict = _dep_mask_and_conflict(table, query)
     k = min(k, mask.shape[1])
     idx, counts = _compact_topk(mask, k)                       # [B,k],[B]
     row_end = jnp.cumsum(counts)                               # [B]

