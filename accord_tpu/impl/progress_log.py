@@ -92,12 +92,19 @@ class _BlockedEntry:
 class SimpleProgressLog(api.ProgressLog):
     """(ref: impl/SimpleProgressLog.java)."""
 
+    # bound on waiting for a past epoch's topology before dropping a
+    # stand-down signal (matches the ephemeral/invalidate 15s fallback)
+    EPOCH_WAIT_MICROS = 15_000_000
+
     def __init__(self, store, scan_delay_micros: int = 500_000):
         self.store = store
         self.scan_delay_micros = scan_delay_micros
         self.home: Dict[TxnId, _HomeEntry] = {}
         self.blocked: Dict[TxnId, _BlockedEntry] = {}
         self._scheduled = None
+        # stand-down signals dropped because a past epoch's topology never
+        # arrived within the bounded wait (diagnostic, surfaced via stats)
+        self.inform_durable_dropped = 0
 
     # -- scheduling ----------------------------------------------------------
     def _arm(self) -> None:
@@ -285,9 +292,26 @@ class SimpleProgressLog(api.ProgressLog):
         manager = node.topology_manager
         if not manager.has_epoch(txn_id.epoch()):
             # the blocked entry is already popped, so a silent drop would
-            # lose the stand-down signal for good — wait for the epoch
-            node.with_epoch(txn_id.epoch(),
-                            lambda: self._inform_home_durable(txn_id, merged))
+            # lose the stand-down signal for good — wait for the epoch, but
+            # BOUNDED: a (typically old) epoch whose history is never
+            # delivered must not leak this callback forever.  First of
+            # epoch-arrival / deadline wins; on deadline the signal is
+            # dropped with a diagnostic counter (the home shard will
+            # re-learn durability from the next durability-service round).
+            state = {"done": False}
+
+            def on_epoch():
+                if not state["done"]:
+                    state["done"] = True
+                    self._inform_home_durable(txn_id, merged)
+
+            def on_deadline():
+                if not state["done"]:
+                    state["done"] = True
+                    self.inform_durable_dropped += 1
+
+            node.with_epoch(txn_id.epoch(), on_epoch)
+            node.scheduler.once(self.EPOCH_WAIT_MICROS, on_deadline)
             return
         topology = manager.get_topology_for_epoch(txn_id.epoch())
         home = Ranges.of(route.home_as_range())

@@ -292,6 +292,13 @@ class CommandsForKey:
                                InternalStatus.INVALIDATED))
         return cut
 
+    def may_elide_any(self) -> bool:
+        """Monotone pre-filter for the batch attribution: False when no
+        entry on this key can be elided for ANY bound (no committed writes
+        recorded, no unwitnessable entries) — the common key skips the
+        per-bound pivot lookup entirely."""
+        return bool(self._committed_write_execs) or self._n_unwitnessable > 0
+
     def can_elide(self, bound: Timestamp):
         """Batch fast-path for the device attribution: returns None when NO
         entry on this key can be elided for ``bound`` (no unwitnessable

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 
 from ..ops import deps_kernel as dk
 from ..ops import drain_kernel as drk
-from ..ops.packing import to_i64, unpack_txn_id
+from ..ops.packing import to_i64
 from ..primitives.keys import Range, Ranges
 from ..primitives.timestamp import Domain, Kinds, Timestamp, TxnId
 
@@ -75,8 +75,25 @@ def _grow(arr: np.ndarray, new_len: int, fill) -> np.ndarray:
     return out
 
 
+@jax.jit
+def _scatter_bucket_rows(dev, idx, rows):
+    """Fused dirty-bucket update for the seven bucket-entry arrays."""
+    return tuple(a.at[idx].set(r) for a, r in zip(dev, rows))
+
+
 class _DepsMirror:
-    """Host mirror of one store's DepsTable, with dirty-row tracking."""
+    """Host mirror of one store's DepsTable, with dirty-row tracking, plus
+    the host half of the bucketed interval index (the CINTIA-analogue in
+    ops.deps_kernel.bucketed_flat): per-bucket (lo, hi, slot) entry lists
+    kept incrementally, wide/overflow entries in a straggler set, dirty
+    buckets scatter-updated to the device alongside the slot table."""
+
+    # bucket width = 2^BSHIFT tokens; intervals (and query probes) touching
+    # more than SPAN buckets go to the wide/straggler path
+    BSHIFT = 6
+    SPAN = 4
+    BUCKET_K = 128        # entries per bucket before spilling wide
+    WIDE_MAX = 4096       # beyond this many stragglers the dense scan wins
 
     def __init__(self, capacity: int = _MIN_CAPACITY,
                  max_intervals: int = _MIN_INTERVALS):
@@ -92,9 +109,168 @@ class _DepsMirror:
         self.hi = np.full((capacity, max_intervals), dk.PAD_HI, np.int64)
         self.slot_of: Dict[TxnId, int] = {}
         self.id_of: Dict[int, TxnId] = {}
+        # parallel object column: obj[slot] is the TxnId living in the slot
+        # (None when free) — snapshot with the packed columns at batch
+        # begin, so result attribution is a pure C-level take instead of a
+        # per-slot dict lookup + verification
+        self.obj = np.full(capacity, None, object)
         self.free_slots: List[int] = list(range(capacity - 1, -1, -1))
         self._dirty: Set[int] = set()
         self._device: Optional[dk.DepsTable] = None
+        # -- bucket index (host truth) --
+        self.bucket_row: Dict[int, int] = {}     # bucket id -> dense row
+        self.bucket_entries: List[List[Tuple[int, int, int]]] = []
+        self.bucket_dirty: Set[int] = set()
+        self.wide_entries: Set[Tuple[int, int, int]] = set()
+        self.wide_dirty = True
+        self._bhost = None                        # (blo, bhi, bslot) np
+        self._bdev = None                         # jnp triple
+        self._g_cap = 0
+        self._wdev = None                         # (wlo, whi, wslot) jnp
+        self._sorted_bids = np.zeros(0, np.int64)
+        self._row_of_sorted = np.zeros(0, np.int32)
+        self._bids_stale = False
+
+    # -- bucket index maintenance -------------------------------------------
+    def _bucket_add(self, slot: int, lo: int, hi: int) -> None:
+        if self.status[slot] == dk.SLOT_INVALIDATED:
+            return   # structurally excluded (de-indexed on invalidation)
+        blo, bhi = lo >> self.BSHIFT, hi >> self.BSHIFT
+        if bhi - blo + 1 > self.SPAN:
+            self.wide_entries.add((lo, hi, slot))
+            self.wide_dirty = True
+            return
+        for bid in range(blo, bhi + 1):
+            row = self.bucket_row.get(bid)
+            if row is None:
+                row = len(self.bucket_entries)
+                self.bucket_row[bid] = row
+                self.bucket_entries.append([])
+                self._bids_stale = True
+            ents = self.bucket_entries[row]
+            if len(ents) >= self.BUCKET_K:
+                # overflow spill: the straggler list absorbs hot buckets
+                self.wide_entries.add((lo, hi, slot))
+                self.wide_dirty = True
+            else:
+                ents.append((lo, hi, slot))
+                self.bucket_dirty.add(row)
+
+    def _bucket_remove(self, slot: int) -> None:
+        """De-index every interval of ``slot`` (called before the row's
+        lo/hi are cleared on free)."""
+        row_lo, row_hi = self.lo[slot], self.hi[slot]
+        for m in range(self.max_intervals):
+            lo, hi = int(row_lo[m]), int(row_hi[m])
+            if lo > hi:
+                continue
+            ent = (lo, hi, slot)
+            blo, bhi = lo >> self.BSHIFT, hi >> self.BSHIFT
+            if bhi - blo + 1 > self.SPAN:
+                if ent in self.wide_entries:
+                    self.wide_entries.discard(ent)
+                    self.wide_dirty = True
+                continue
+            spilled = False
+            for bid in range(blo, bhi + 1):
+                r = self.bucket_row.get(bid)
+                if r is not None:
+                    try:
+                        self.bucket_entries[r].remove(ent)
+                        self.bucket_dirty.add(r)
+                        continue
+                    except ValueError:
+                        pass
+                spilled = True
+            if spilled and ent in self.wide_entries:
+                self.wide_entries.discard(ent)
+                self.wide_dirty = True
+
+    def bid_rows(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(sorted bucket ids, dense row per id) for vectorized query->row
+        mapping via searchsorted."""
+        if self._bids_stale or len(self._sorted_bids) != len(self.bucket_row):
+            n = len(self.bucket_row)
+            bids = np.fromiter(self.bucket_row.keys(), np.int64, n)
+            rows = np.fromiter(self.bucket_row.values(), np.int32, n)
+            order = np.argsort(bids)
+            self._sorted_bids = bids[order]
+            self._row_of_sorted = rows[order]
+            self._bids_stale = False
+        return self._sorted_bids, self._row_of_sorted
+
+    def _fill_bucket_row(self, arrs, r, ents) -> None:
+        """Write one bucket's entries into the 7 host row arrays, with the
+        immutable id/kind columns read from the mirror (entries are live,
+        so the mirror columns are current for their slots)."""
+        blo, bhi, bslot, bmsb, blsb, bnode, bkind = arrs
+        blo[r] = dk.PAD_LO
+        bhi[r] = dk.PAD_HI
+        bslot[r] = -1
+        for i, (lo, hi, s) in enumerate(ents):
+            blo[r, i] = lo
+            bhi[r, i] = hi
+            bslot[r, i] = s
+            bmsb[r, i] = self.msb[s]
+            blsb[r, i] = self.lsb[s]
+            bnode[r, i] = self.node[s]
+            bkind[r, i] = self.kind[s]
+
+    def bucket_device(self) -> "dk.BucketTable":
+        """Sync the bucket index to the device (dirty-row scatter, like the
+        slot table) and return the BucketTable."""
+        k = self.BUCKET_K
+        g_cap = _pow2_at_least(max(len(self.bucket_entries), 1), 64)
+        if self._bdev is None or g_cap != self._g_cap:
+            blo = np.full((g_cap, k), dk.PAD_LO, np.int64)
+            bhi = np.full((g_cap, k), dk.PAD_HI, np.int64)
+            bslot = np.full((g_cap, k), -1, np.int32)
+            bmsb = np.zeros((g_cap, k), np.int64)
+            blsb = np.zeros((g_cap, k), np.int64)
+            bnode = np.zeros((g_cap, k), np.int32)
+            bkind = np.zeros((g_cap, k), np.int32)
+            self._bhost = (blo, bhi, bslot, bmsb, blsb, bnode, bkind)
+            for r, ents in enumerate(self.bucket_entries):
+                if ents:
+                    self._fill_bucket_row(self._bhost, r, ents)
+            self._bdev = tuple(jnp.asarray(a) for a in self._bhost)
+            self._g_cap = g_cap
+            self.bucket_dirty.clear()
+        elif self.bucket_dirty:
+            rows = sorted(self.bucket_dirty)
+            for r in rows:
+                self._fill_bucket_row(self._bhost, r, self.bucket_entries[r])
+            padded = _pow2_at_least(len(rows), 8)
+            idx = np.concatenate([np.array(rows, np.int32),
+                                  np.full(padded - len(rows), rows[-1],
+                                          np.int32)])
+            self._bdev = _scatter_bucket_rows(
+                self._bdev, jnp.asarray(idx),
+                tuple(a[idx] for a in self._bhost))
+            self.bucket_dirty.clear()
+        if self._wdev is None or self.wide_dirty:
+            w = _pow2_at_least(max(len(self.wide_entries), 1), 16)
+            wlo = np.full(w, dk.PAD_LO, np.int64)
+            whi = np.full(w, dk.PAD_HI, np.int64)
+            wslot = np.full(w, -1, np.int32)
+            wmsb = np.zeros(w, np.int64)
+            wlsb = np.zeros(w, np.int64)
+            wnode = np.zeros(w, np.int32)
+            wkind = np.zeros(w, np.int32)
+            for i, (lo, hi, s) in enumerate(self.wide_entries):
+                wlo[i] = lo
+                whi[i] = hi
+                wslot[i] = s
+                wmsb[i] = self.msb[s]
+                wlsb[i] = self.lsb[s]
+                wnode[i] = self.node[s]
+                wkind[i] = self.kind[s]
+            self._wdev = (jnp.asarray(wlo), jnp.asarray(whi),
+                          jnp.asarray(wslot), jnp.asarray(wmsb),
+                          jnp.asarray(wlsb), jnp.asarray(wnode),
+                          jnp.asarray(wkind))
+            self.wide_dirty = False
+        return dk.BucketTable(*self._bdev, *self._wdev)
 
     # -- slot management ----------------------------------------------------
     def alloc(self, txn_id: TxnId) -> int:
@@ -106,6 +282,7 @@ class _DepsMirror:
         slot = self.free_slots.pop()
         self.slot_of[txn_id] = slot
         self.id_of[slot] = txn_id
+        self.obj[slot] = txn_id
         self.msb[slot] = to_i64(txn_id.msb)
         self.lsb[slot] = to_i64(txn_id.lsb)
         self.node[slot] = txn_id.node
@@ -122,6 +299,8 @@ class _DepsMirror:
         if slot is None:
             return
         self.id_of.pop(slot, None)
+        self.obj[slot] = None
+        self._bucket_remove(slot)
         self.status[slot] = dk.SLOT_FREE
         self.lo[slot] = dk.PAD_LO
         self.hi[slot] = dk.PAD_HI
@@ -139,6 +318,7 @@ class _DepsMirror:
         self.status = _grow(self.status, new, dk.SLOT_FREE)
         self.lo = _grow(self.lo, new, dk.PAD_LO)
         self.hi = _grow(self.hi, new, dk.PAD_HI)
+        self.obj = _grow(self.obj, new, None)
         self.free_slots.extend(range(new - 1, old - 1, -1))
         self.capacity = new
         self._device = None  # shape changed: full re-upload
@@ -178,6 +358,7 @@ class _DepsMirror:
             row_hi[used] = hi_v
             used += 1
             self._dirty.add(slot)
+            self._bucket_add(slot, lo_v, hi_v)
 
     def set_status(self, slot: int, status: int) -> None:
         if self.status[slot] != status:
@@ -234,12 +415,18 @@ class _DepsMirror:
 
 
 class _DrainMirror:
-    """Host mirror of the execution drain graph: adjacency over the store's
-    in-flight (stable-but-unapplied) txns and their direct dependencies."""
+    """Host mirror of the execution drain graph: SPARSE adjacency over the
+    store's in-flight (stable-but-unapplied) txns and their direct
+    dependencies — per-slot dep/waiter sets, the host analogue of the
+    reference's WaitingOn bitset-over-txnIds (ref: local/Command.java:
+    1295-1332).  The r04 dense bool[capacity, capacity] matrix needed
+    O(N^2) host memory (10^10 entries at the 100k-in-flight spec); edge
+    count here is bounded by the live waiting sets."""
 
     def __init__(self, capacity: int = _MIN_CAPACITY):
         self.capacity = capacity
-        self.adj = np.zeros((capacity, capacity), bool)
+        self.deps_of: List[Set[int]] = [set() for _ in range(capacity)]
+        self.waiters_of: List[Set[int]] = [set() for _ in range(capacity)]
         self.status = np.full(capacity, dk.SLOT_FREE, np.int32)
         self.exec_msb = np.zeros(capacity, np.int64)
         self.exec_lsb = np.zeros(capacity, np.int64)
@@ -249,6 +436,22 @@ class _DrainMirror:
         self.slot_of: Dict[TxnId, int] = {}
         self.id_of: Dict[int, TxnId] = {}
         self.free_slots: List[int] = list(range(capacity - 1, -1, -1))
+
+    # -- edge maintenance ---------------------------------------------------
+    def add_edge(self, waiter: int, dep: int) -> None:
+        self.deps_of[waiter].add(dep)
+        self.waiters_of[dep].add(waiter)
+
+    def clear_deps(self, slot: int) -> None:
+        for dep in self.deps_of[slot]:
+            self.waiters_of[dep].discard(slot)
+        self.deps_of[slot].clear()
+
+    def _clear_edges(self, slot: int) -> None:
+        self.clear_deps(slot)
+        for w in self.waiters_of[slot]:
+            self.deps_of[w].discard(slot)
+        self.waiters_of[slot].clear()
 
     def alloc(self, txn_id: TxnId) -> int:
         slot = self.slot_of.get(txn_id)
@@ -264,8 +467,7 @@ class _DrainMirror:
         self.exec_lsb[slot] = 0
         self.exec_node[slot] = 0
         self.awaits_all[slot] = txn_id.kind().awaits_only_deps()
-        self.adj[slot, :] = False
-        self.adj[:, slot] = False
+        self._clear_edges(slot)
         self.active[slot] = False
         return slot
 
@@ -274,17 +476,15 @@ class _DrainMirror:
         if txn_id is not None:
             del self.slot_of[txn_id]
         self.status[slot] = dk.SLOT_FREE
-        self.adj[slot, :] = False
-        self.adj[:, slot] = False
+        self._clear_edges(slot)
         self.active[slot] = False
         self.free_slots.append(slot)
 
     def _grow_capacity(self) -> None:
         old = self.capacity
         new = old * 2
-        adj = np.zeros((new, new), bool)
-        adj[:old, :old] = self.adj
-        self.adj = adj
+        self.deps_of.extend(set() for _ in range(new - old))
+        self.waiters_of.extend(set() for _ in range(new - old))
         self.status = _grow(self.status, new, dk.SLOT_FREE)
         self.exec_msb = _grow(self.exec_msb, new, 0)
         self.exec_lsb = _grow(self.exec_lsb, new, 0)
@@ -302,28 +502,62 @@ class _DrainMirror:
             self.exec_lsb[slot] = to_i64(execute_at.lsb)
             self.exec_node[slot] = execute_at.node
 
-    def state(self) -> Tuple[drk.DrainState, np.ndarray]:
+    # above this live count the drain ships the ELL (padded row-index)
+    # adjacency instead of the dense matrix: dense [n, n] at 100k in-flight
+    # is 10GB of bools; ELL is n x max_degree
+    DENSE_MAX = 8192
+
+    def state(self):
         """Compacted drain state over LIVE slots only (padded to a power-of-
         two bucket so jit caches per bucket): the kernel cost scales with the
         in-flight set, not the high-water capacity.  Returns (state,
-        live_slot_index) for mapping frontier rows back to slots."""
+        live_slot_index); ``state`` is a dense DrainState below DENSE_MAX
+        live slots (MXU matvec fixpoint) and an EllDrainState above it
+        (gather fixpoint — no O(N^2) anywhere)."""
         live = np.nonzero(self.status != dk.SLOT_FREE)[0]
         n = _pow2_at_least(len(live), 16)
-        adj = np.zeros((n, n), bool)
-        adj[: len(live), : len(live)] = self.adj[np.ix_(live, live)]
+        local = np.full(self.capacity, -1, np.int32)
+        local[live] = np.arange(len(live), dtype=np.int32)
         status = np.full(n, dk.SLOT_FREE, np.int32)
         status[: len(live)] = self.status[live]
-        ts0 = np.zeros(n, np.int64)
-        em, el = ts0.copy(), ts0.copy()
+        em = np.zeros(n, np.int64)
+        el = np.zeros(n, np.int64)
         en = np.zeros(n, np.int32)
         aw = np.zeros(n, bool)
         em[: len(live)] = self.exec_msb[live]
         el[: len(live)] = self.exec_lsb[live]
         en[: len(live)] = self.exec_node[live]
         aw[: len(live)] = self.awaits_all[live]
-        state = drk.DrainState(jnp.asarray(adj), jnp.asarray(status),
-                               jnp.asarray(em), jnp.asarray(el),
-                               jnp.asarray(en), jnp.asarray(aw))
+        if n <= self.DENSE_MAX:
+            adj = np.zeros((n, n), bool)
+            ris, rjs = [], []
+            for i in live:
+                row = self.deps_of[int(i)]
+                if row:
+                    ris.extend([int(local[i])] * len(row))
+                    rjs.extend(row)
+            if ris:
+                li = np.array(ris, np.int64)
+                lj = local[np.array(rjs, np.int64)]
+                ok = lj >= 0
+                adj[li[ok], lj[ok]] = True
+            state = drk.DrainState(jnp.asarray(adj), jnp.asarray(status),
+                                   jnp.asarray(em), jnp.asarray(el),
+                                   jnp.asarray(en), jnp.asarray(aw))
+            return state, live
+        max_deg = max((len(self.deps_of[int(i)]) for i in live), default=0)
+        d = _pow2_at_least(max(max_deg, 1), 4)
+        adj_idx = np.full((n, d), -1, np.int32)
+        for i in live:
+            row = self.deps_of[int(i)]
+            if row:
+                li = local[i]
+                cols = local[np.fromiter(row, np.int64, len(row))]
+                cols = cols[cols >= 0]
+                adj_idx[li, : len(cols)] = cols
+        state = drk.EllDrainState(jnp.asarray(adj_idx), jnp.asarray(status),
+                                  jnp.asarray(em), jnp.asarray(el),
+                                  jnp.asarray(en), jnp.asarray(aw))
         return state, live
 
     def sweep_free(self) -> None:
@@ -331,10 +565,146 @@ class _DrainMirror:
         not being driven, and no waiter edge pointing at them."""
         terminal = (self.status == dk.SLOT_APPLIED) | \
                    (self.status == dk.SLOT_INVALIDATED)
-        referenced = self.adj.any(axis=0)
-        for slot in np.nonzero(terminal & ~self.active & ~referenced)[0]:
-            if self.id_of.get(int(slot)) is not None:
-                self.free(int(slot))
+        for slot in np.nonzero(terminal & ~self.active)[0]:
+            s = int(slot)
+            if not self.waiters_of[s] and self.id_of.get(s) is not None:
+                self.free(s)
+
+
+def _group_dedupe(cols):
+    """lexsort by ``cols`` (last array = primary key) + shift-compare
+    dedupe; returns (order, first_mask) — the tiny-array-friendly
+    replacement for np.unique(axis=0), whose void-view machinery costs
+    ~0.2ms per call."""
+    order = np.lexsort(cols)
+    first = np.ones(len(order), bool)
+    acc = None
+    for c in cols:
+        cs = c[order]
+        d = cs[1:] != cs[:-1]
+        acc = d if acc is None else (acc | d)
+    first[1:] = acc
+    return order, first
+
+
+def _finalize_key_batch(builders, bb, tt, dm, dl, dn, objs) -> None:
+    """Construct every builder's KeyDeps in ONE vectorized pass over the
+    batch's key emits — two lexsorts + shift-compares; per-builder Python
+    touches only group boundaries (the CSR freeze the reference does per
+    reply in KeyDeps.Builder, done batch-wide)."""
+    from ..primitives.deps import KeyDeps
+    from ..primitives.keys import RoutingKeys
+    o, first = _group_dedupe((dn, dl, dm, tt, bb))
+    o = o[first]
+    bb, tt, dm, dl, dn, objs = bb[o], tt[o], dm[o], dl[o], dn[o], objs[o]
+    n = len(bb)
+    # per-builder unique deps, ordered by packed id (== TxnId order)
+    o2 = np.lexsort((dn, dl, dm, bb))
+    b2 = bb[o2]
+    newb = np.ones(n, bool)
+    newb[1:] = b2[1:] != b2[:-1]
+    newd = newb | _changed((dm, dl, dn), o2)
+    gid = np.cumsum(newd) - 1
+    base = np.maximum.accumulate(np.where(newb, gid, 0))
+    inv = np.empty(n, np.int64)
+    inv[o2] = gid - base
+    dep_rows = o2[newd]
+    dep_bs = bb[dep_rows]
+    dep_objs = objs[dep_rows]
+    dstart = np.nonzero(newb[newd])[0]
+    dbounds = np.append(dstart, len(dep_rows))
+    txn_lists = {int(b): dep_objs[dbounds[i]:dbounds[i + 1]].tolist()
+                 for i, b in enumerate(dep_bs[dstart].tolist())}
+    # (b, token) groups over the (b, tok, dep)-ordered arrays
+    newg = np.ones(n, bool)
+    newg[1:] = (bb[1:] != bb[:-1]) | (tt[1:] != tt[:-1])
+    gstart = np.nonzero(newg)[0]
+    gb = bb[gstart].tolist()
+    gt = tt[gstart].tolist()
+    gbounds = gstart.tolist()
+    gbounds.append(n)
+    inv_l = inv.tolist()                  # ONE conversion; C-level slices
+    keys_of: Dict[int, List[int]] = {}
+    rows_of: Dict[int, List[List[int]]] = {}
+    cur_b, ks, rs = None, None, None
+    for i in range(len(gb)):
+        b = gb[i]
+        if b != cur_b:                    # groups arrive sorted by builder
+            cur_b = b
+            ks = keys_of[b] = []
+            rs = rows_of[b] = []
+        ks.append(gt[i])
+        rs.append(inv_l[gbounds[i]:gbounds[i + 1]])
+    for b, toks in keys_of.items():
+        builders[b].key.set_prebuilt(
+            KeyDeps(RoutingKeys(toks, _presorted=True), txn_lists[b],
+                    rows_of[b]))
+
+
+def _finalize_range_batch(builders, bb, lo, hi, dm, dl, dn, objs) -> None:
+    """Range-domain analogue of _finalize_key_batch: the group key is the
+    (lo, hi) clip instead of the token."""
+    from ..primitives.deps import RangeDeps
+    o, first = _group_dedupe((dn, dl, dm, hi, lo, bb))
+    o = o[first]
+    bb, lo, hi, dm, dl, dn, objs = (bb[o], lo[o], hi[o], dm[o], dl[o],
+                                    dn[o], objs[o])
+    n = len(bb)
+    o2 = np.lexsort((dn, dl, dm, bb))
+    b2 = bb[o2]
+    newb = np.ones(n, bool)
+    newb[1:] = b2[1:] != b2[:-1]
+    newd = newb | _changed((dm, dl, dn), o2)
+    gid = np.cumsum(newd) - 1
+    base = np.maximum.accumulate(np.where(newb, gid, 0))
+    inv = np.empty(n, np.int64)
+    inv[o2] = gid - base
+    dep_rows = o2[newd]
+    dep_bs = bb[dep_rows]
+    dep_objs = objs[dep_rows]
+    dstart = np.nonzero(newb[newd])[0]
+    dbounds = np.append(dstart, len(dep_rows))
+    txn_lists = {int(b): dep_objs[dbounds[i]:dbounds[i + 1]].tolist()
+                 for i, b in enumerate(dep_bs[dstart].tolist())}
+    newg = np.ones(n, bool)
+    newg[1:] = ((bb[1:] != bb[:-1]) | (lo[1:] != lo[:-1])
+                | (hi[1:] != hi[:-1]))
+    gstart = np.nonzero(newg)[0]
+    gb = bb[gstart].tolist()
+    glo = lo[gstart].tolist()
+    ghi = hi[gstart].tolist()
+    gbounds = gstart.tolist()
+    gbounds.append(n)
+    inv_l = inv.tolist()
+    rngs_of: Dict[int, List[Range]] = {}
+    rows_of: Dict[int, List[List[int]]] = {}
+    cur_b, rs, rw = None, None, None
+    mk = Range
+    for i in range(len(gb)):
+        b = gb[i]
+        if b != cur_b:
+            cur_b = b
+            rs = rngs_of[b] = []
+            rw = rows_of[b] = []
+        rs.append(mk(glo[i], ghi[i]))
+        rw.append(inv_l[gbounds[i]:gbounds[i + 1]])
+    for b, rngs in rngs_of.items():
+        builders[b].range.set_prebuilt(
+            RangeDeps(rngs, txn_lists[b], rows_of[b]))
+
+
+def _changed(cols, order) -> np.ndarray:
+    """Shift-compare over reordered columns: True where any column differs
+    from the previous row (first row excluded — callers OR with their own
+    leading mask)."""
+    acc = None
+    for c in cols:
+        cs = c[order]
+        d = cs[1:] != cs[:-1]
+        acc = d if acc is None else (acc | d)
+    out = np.zeros(len(order), bool)
+    out[1:] = acc
+    return out
 
 
 class DeviceState:
@@ -370,6 +740,9 @@ class DeviceState:
         self.n_ticks = 0
         self.n_kernel_deps = 0
         self.n_mesh_queries = 0
+        self.n_bucketed_queries = 0
+        self.n_dispatches = 0       # kernel dispatches: n_queries /
+        #                             n_dispatches = mean lived batch size
 
     # ------------------------------------------------------------------
     # registration hooks (called from local.commands transitions)
@@ -400,6 +773,10 @@ class DeviceState:
         else:
             new = max(cur, status)
         self.deps.set_status(slot, new)
+        if new == dk.SLOT_INVALIDATED and cur != dk.SLOT_INVALIDATED:
+            # de-index: the bucket path excludes invalidated entries
+            # structurally (the dense path excludes them by status)
+            self.deps._bucket_remove(slot)
         dslot = self.drain.slot_of.get(txn_id)
         if dslot is not None:
             self.drain.set_status(dslot, new, execute_at)
@@ -434,7 +811,8 @@ class DeviceState:
                                  witnesses)
         if query is None:
             return
-        handle = self.deps_query_batch_begin([query], immediate=True)
+        handle = self.deps_query_batch_begin([query], immediate=True,
+                                             prune_floors=True)
         self.deps_query_batch_end_attributed(safe, handle, [builder])
 
     def build_query(self, safe, txn_id: TxnId, keys,
@@ -452,18 +830,6 @@ class DeviceState:
         if not q_toks and not q_rngs:
             return None
         return (txn_id, started_before, witnesses, q_toks, q_rngs)
-
-    def _resolve_id(self, j: int, ids) -> TxnId:
-        """Slot -> TxnId via the live reverse map when it still matches the
-        batch snapshot (no object allocation on the hot path); fall back to
-        unpacking from the snapshot columns when the slot was recycled
-        between begin and end."""
-        msb, lsb, node = ids
-        cand = self.deps.id_of.get(j)
-        if cand is not None and to_i64(cand.msb) == msb[j] \
-                and to_i64(cand.lsb) == lsb[j] and cand.node == node[j]:
-            return cand
-        return unpack_txn_id(msb[j], lsb[j], node[j])
 
     def _attribute_batch(self, safe, b_idx, j_idx, overlap, ids, ivs, qnp,
                          queries, builders) -> None:
@@ -485,21 +851,7 @@ class DeviceState:
         lo, hi, dom = ivs
         rb = safe.redundant_before()
         _MISSING = object()
-        floors: Dict[int, TxnId] = {}
         cfks: Dict[int, object] = {}
-        id_cache: Dict[int, TxnId] = {}
-
-        def resolve(j: int) -> TxnId:
-            d = id_cache.get(j)
-            if d is None:
-                d = id_cache[j] = self._resolve_id(j, ids)
-            return d
-
-        def floor_of(t: int) -> TxnId:
-            f = floors.get(t)
-            if f is None:
-                f = floors[t] = rb.deps_floor(t)
-            return f
 
         def elide_ctx(t: int, bound):
             """(cfk, pivot) when elision is possible on this key for this
@@ -528,38 +880,48 @@ class DeviceState:
         key_dep = (dom[j_idx] == int(Domain.Key))[p_i]
 
         # key-domain deps: emitted at the dep's own footprint point,
-        # deduped per (pair, token); floors + elision decide survival
+        # deduped per (pair, token); floors + elision decide survival.
+        # Emits reach the builders through the batch finalize (whole-batch
+        # vectorized dedupe/CSR, set_prebuilt per builder) — per-emit
+        # Python runs only for the rare keys with elidable state
         kp, km = p_i[key_dep], m_i[key_dep]
+        msb_a, lsb_a, node_a, obj_a = ids
         if len(kp):
-            key_pairs = np.unique(
-                np.stack([kp, lo_p[kp, km]], axis=1), axis=0)
-            pp, tt = key_pairs[:, 0], key_pairs[:, 1]
-            jj, bb = j_idx[pp], b_idx[pp]
+            tt = lo_p[kp, km]                 # key-domain footprint = point
+            jj, bb = j_idx[kp], b_idx[kp]
             # vectorized RedundantBefore floor: dep >= floor(token),
             # lexicographic over the packed (msb, lsb, node) triples (the
             # same int64 ordering the kernel's ts_lt assumes)
-            msb_a, lsb_a, node_a = ids
-            uniq_t, inv = np.unique(tt, return_inverse=True)
-            f_objs = [floor_of(int(t)) for t in uniq_t]
-            fmsb = np.array([to_i64(f.msb) for f in f_objs], np.int64)[inv]
-            flsb = np.array([to_i64(f.lsb) for f in f_objs], np.int64)[inv]
-            fnode = np.array([f.node for f in f_objs], np.int64)[inv]
+            fmsb, flsb, fnode = rb.deps_floor_batch(tt)
             dmsb, dlsb, dnode = msb_a[jj], lsb_a[jj], node_a[jj]
             keep = ((dmsb > fmsb)
                     | ((dmsb == fmsb)
                        & ((dlsb > flsb)
                           | ((dlsb == flsb) & (dnode >= fnode)))))
-            # object resolution via one unique pass + C-level take
-            jj_k = jj[keep]
-            uq_j, inv_j = np.unique(jj_k, return_inverse=True)
-            objs = np.empty(len(uq_j), object)
-            for i, j in enumerate(uq_j.tolist()):
-                objs[i] = resolve(j)
-            deps_k = objs[inv_j]
-            # keys with ANYTHING elidable get the per-dep check; the common
-            # key skips it entirely (one can_elide per token+bound)
-            for b, t, dep_id in zip(bb[keep].tolist(), tt[keep].tolist(),
-                                    deps_k):
+            jj_k, bb_k, tt_k = jj[keep], bb[keep], tt[keep]
+            dmsb_k, dlsb_k, dnode_k = dmsb[keep], dlsb[keep], dnode[keep]
+            # object resolution: pure take from the snapshot object column
+            deps_k = obj_a[jj_k]
+            # tokens with ANYTHING elidable get the per-emit check; the
+            # common key goes through the batch finalize with no per-emit
+            # Python at all
+            uniq_t2, inv_t2 = np.unique(tt_k, return_inverse=True)
+            tok_maybe = np.zeros(len(uniq_t2), bool)
+            cfk_map = self.store.commands_for_key
+            for i, t in enumerate(uniq_t2.tolist()):
+                cfk = cfk_map.get(t)
+                if cfk is not None and cfk.may_elide_any():
+                    tok_maybe[i] = True
+            flagged = tok_maybe[inv_t2]
+            plain = ~flagged
+            if plain.any():
+                _finalize_key_batch(builders, bb_k[plain], tt_k[plain],
+                                    dmsb_k[plain], dlsb_k[plain],
+                                    dnode_k[plain], deps_k[plain])
+            for idx in np.nonzero(flagged)[0].tolist():
+                b = int(bb_k[idx])
+                t = int(tt_k[idx])
+                dep_id = deps_k[idx]
                 ctx = elide_ctx(t, queries[b][1])
                 if ctx is not None:
                     info = ctx[0].get(dep_id)
@@ -568,24 +930,40 @@ class DeviceState:
                         continue
                 builders[b].add_key(t, dep_id)
 
-        # range-domain deps: emit the dep∩query interval clip per pair
+        # range-domain deps: emit the dep∩query interval clip per pair —
+        # batch-finalized (dedupe/sort/CSR in one vectorized pass; Range
+        # objects materialize once per unique clip)
         rp, rm, rq = p_i[~key_dep], m_i[~key_dep], q_i[~key_dep]
         if len(rp):
             ilo = np.maximum(lo_p[rp, rm], qlo_p[rp, rq])
             ihi = np.minimum(hi_p[rp, rm], qhi_p[rp, rq]) + 1
-            range_pairs = np.unique(
-                np.stack([rp, ilo, ihi], axis=1), axis=0)
-            rpp = range_pairs[:, 0]
-            uq_j, inv_j = np.unique(j_idx[rpp], return_inverse=True)
-            objs = np.empty(len(uq_j), object)
-            for i, j in enumerate(uq_j.tolist()):
-                objs[i] = resolve(j)
-            deps_r = objs[inv_j]
-            bb_r = b_idx[rpp].tolist()
-            for b, lo_v, hi_v, dep_id in zip(
-                    bb_r, range_pairs[:, 1].tolist(),
-                    range_pairs[:, 2].tolist(), deps_r):
-                builders[b].add_range(Range(lo_v, hi_v), dep_id)
+            jj_r = j_idx[rp]
+            dmsb_r, dlsb_r, dnode_r = msb_a[jj_r], lsb_a[jj_r], node_a[jj_r]
+            # batch-global RedundantBefore floor on range-domain deps (the
+            # host analogue of the device prune, applied on EVERY attributed
+            # path so pruned and unpruned kernels agree; the pruned history
+            # is covered by the boundary fence dep, messages/preaccept.py:
+            # add_boundary_deps)
+            m_all = qnp[:, 7:7 + q_m]
+            h_all = qnp[:, 7 + q_m:7 + 2 * q_m]
+            u_all = m_all <= h_all
+            if u_all.any():
+                fl = rb.min_floor_over(int(m_all[u_all].min()),
+                                       int(h_all[u_all].max()))
+                if fl > TxnId.NONE:
+                    fm, fls, fn = (to_i64(fl.msb), to_i64(fl.lsb), fl.node)
+                    keep_r = ((dmsb_r > fm)
+                              | ((dmsb_r == fm)
+                                 & ((dlsb_r > fls)
+                                    | ((dlsb_r == fls) & (dnode_r >= fn)))))
+                    rp, ilo, ihi, jj_r = (rp[keep_r], ilo[keep_r],
+                                          ihi[keep_r], jj_r[keep_r])
+                    dmsb_r, dlsb_r, dnode_r = (dmsb_r[keep_r],
+                                               dlsb_r[keep_r],
+                                               dnode_r[keep_r])
+            if len(rp):
+                _finalize_range_batch(builders, b_idx[rp], ilo, ihi,
+                                      dmsb_r, dlsb_r, dnode_r, obj_a[jj_r])
 
     def deps_query_batch(self, queries):
         """Batched deps scan: ONE kernel call for B concurrent queries (the
@@ -608,95 +986,199 @@ class DeviceState:
         the exact code deps_query runs (B=1) — and what the bench times."""
         if not queries:
             return
-        handle = self.deps_query_batch_begin(queries)
+        handle = self.deps_query_batch_begin(queries, prune_floors=True)
         self.deps_query_batch_end_attributed(safe, handle, builders)
 
-    def deps_query_batch_begin(self, queries, immediate: bool = False):
+    # below this many stragglers the bucketed path is used for narrow
+    # queries on a single device; above it (hot/adversarial footprints) the
+    # dense scan is the better kernel anyway
+    BUCKETED = True
+
+    def deps_query_batch_begin(self, queries, immediate: bool = False,
+                               prune_floors: bool = False):
         """Dispatch a batched deps scan WITHOUT waiting: one fused query
-        upload + kernel enqueue; returns an opaque handle for
+        upload per kernel part + enqueue; returns an opaque handle for
         deps_query_batch_end.  Callers overlap the next batch's dispatch
         with the previous batch's result download (double-buffering) — on a
-        tunneled accelerator the round trips dominate the kernel by ~1000x,
-        so the pipeline nearly doubles sustained throughput."""
+        tunneled accelerator the round trips dominate the kernel, so the
+        pipeline nearly doubles sustained throughput.
+
+        Dispatch is adaptive: under a mesh the scan fans over the sharded
+        dense kernel; on a single device queries whose intervals are narrow
+        probe the bucketed index (O(candidates) instead of O(N)), wide
+        queries — and everything, when the straggler list says the
+        footprint distribution defeats bucketing — take the dense kernel.
+        All parts share one mirror snapshot and one geometry/attribution
+        pass, so every path yields identical protocol results."""
         q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
         packed = [(sb, wit, toks, rngs, tid)
                   for (tid, sb, wit, toks, rngs) in queries]
-        if self.mesh is not None:
-            table = self.deps.device_table_sharded(self.mesh)
-        else:
-            table = self.deps.device_table()
-        n = table.capacity
+        nq = len(queries)
         qnp = dk.pack_query_matrix(packed, q_m)
-        qmat = jnp.asarray(qnp)                               # ONE upload
-        # adaptive + STICKY flat-compaction capacity: the coarse pair list
-        # is sparse, so the download stays ~100KB; an overflow escalates
-        # (the true count rides in the same download, so detection is free)
-        # and the learned capacity persists so steady state stays one
-        # round trip
+        parts: List[Dict[str, object]] = []
+        # conservative batch-global RedundantBefore floor, applied ON
+        # DEVICE (the exact floors still run in attribution): in durable-
+        # prefix-dominated stores this keeps the CSR to the live tail
+        # instead of shipping redundant history.  Opt-in: the attributed
+        # (protocol) paths enable it; the raw-CSR path documents no floors
+        # and never prunes
+        prune = None
+        rb = getattr(self.store, "redundant_before", None)
+        if prune_floors and rb is not None and self.mesh is None:
+            lo_cols = qnp[:, 7:7 + q_m]
+            hi_cols = qnp[:, 7 + q_m:7 + 2 * q_m]
+            used = lo_cols <= hi_cols
+            if used.any():
+                f = rb.min_floor_over(int(lo_cols[used].min()),
+                                      int(hi_cols[used].max()))
+                if f > TxnId.NONE:
+                    prune = (jnp.asarray(to_i64(f.msb)),
+                             jnp.asarray(to_i64(f.lsb)),
+                             jnp.asarray(np.int32(f.node)))
+
+        def dispatch(kind, rows):
+            """rows: np int64 array of query indices for this part, padded
+            to a pow2 batch by repeating the last row (pads map to -1)."""
+            b_pad = _pow2_at_least(len(rows), 1)
+            rows_p = np.concatenate(
+                [rows, np.full(b_pad - len(rows), rows[-1], np.int64)])
+            gmap = np.concatenate(
+                [rows, np.full(b_pad - len(rows), -1, np.int64)])
+            part: Dict[str, object] = {"kind": kind, "gmap": gmap,
+                                       "nq": b_pad, "q_m": q_m}
+            if kind == "sharded":
+                table = self.deps.device_table_sharded(self.mesh)
+                d = int(np.prod(list(self.mesh.shape.values())))
+                n = table.capacity
+                s = min(self._batch_flat, b_pad * (n // d))
+                k = min(self._batch_k, n // d)
+                qmat = jnp.asarray(qnp[rows_p])
+                from ..parallel.sharded import sharded_calculate_deps_flat
+                out_dev = sharded_calculate_deps_flat(
+                    self.mesh, q_m, s, k)(table, qmat)
+                self.n_mesh_queries += len(rows)
+                part.update(table=table, qmat=qmat, d=d, shard_n=n // d,
+                            s=s, k=k)
+            elif kind == "dense":
+                table = self.deps.device_table()
+                n = table.capacity
+                s = min(self._batch_flat, b_pad * n)
+                k = min(self._batch_k, n)
+                qmat = jnp.asarray(qnp[rows_p])
+                if prune is not None:
+                    out_dev = dk.calculate_deps_flat_pruned(
+                        table, qmat, *prune, q_m, s, k)
+                else:
+                    out_dev = dk.calculate_deps_flat(table, qmat, q_m, s, k)
+                part.update(table=table, qmat=qmat, d=1, shard_n=n, s=s,
+                            k=k, prune=prune)
+            else:   # bucketed
+                table = self.deps.device_table()
+                btable = self.deps.bucket_device()
+                span = self.deps.SPAN
+                c = (q_m * span * self.deps.BUCKET_K
+                     + btable.wlo.shape[0])
+                s = min(self._batch_flat, b_pad * c)
+                k = min(self._batch_k, c)
+                qb = qcols[rows_p].reshape(b_pad, q_m * span)
+                qmat = jnp.asarray(np.concatenate(
+                    [qnp[rows_p], qb], axis=1))
+                if prune is not None:
+                    out_dev = dk.bucketed_flat_pruned(table, btable, qmat,
+                                                      q_m, span, s, k,
+                                                      *prune)
+                else:
+                    out_dev = dk.bucketed_flat_jit(table, btable, qmat,
+                                                   q_m, span, s, k)
+                self.n_bucketed_queries += len(rows)
+                part.update(table=table, btable=btable, qmat=qmat, d=1,
+                            shard_n=table.capacity, s=s, k=k, c=c,
+                            span=span, prune=prune)
+            self.n_dispatches += 1
+            box: Dict[str, object] = {"dev": out_dev}
+            part["box"] = box
+            if not immediate:
+                # prefetch on a worker thread: np.asarray blocks on the
+                # (tunneled) transfer with the GIL released, so a pipelined
+                # caller attributes batch i while batch i+1 computes AND
+                # downloads
+                def _fetch():
+                    try:
+                        box["out"] = np.asarray(out_dev)
+                    except BaseException as e:     # surfaced after join
+                        box["err"] = e
+
+                import threading
+                th = threading.Thread(target=_fetch, daemon=True)
+                th.start()
+                part["th"] = th
+            parts.append(part)
+
         if self.mesh is not None:
-            d = int(np.prod(list(self.mesh.shape.values())))
+            dispatch("sharded", np.arange(nq, dtype=np.int64))
+        elif not self.BUCKETED or \
+                len(self.deps.wide_entries) > self.deps.WIDE_MAX:
+            dispatch("dense", np.arange(nq, dtype=np.int64))
         else:
-            d = 1
-        # caps are PER SHARD: each shard block holds at most nq * (n/d)
-        # entries, and its widest row at most n/d
-        s = min(self._batch_flat, len(queries) * (n // d))
-        k = min(self._batch_k, n // d)
-        if self.mesh is not None:
-            from ..parallel.sharded import sharded_calculate_deps_flat
-            out_dev = sharded_calculate_deps_flat(
-                self.mesh, q_m, s, k)(table, qmat)
-            self.n_mesh_queries += len(queries)
-        else:
-            out_dev = dk.calculate_deps_flat(table, qmat, q_m, s, k)
-        box: Dict[str, object] = {"dev": out_dev}
+            qcols, wide_q = self._bucket_query_cols(qnp, q_m)
+            narrow = np.nonzero(~wide_q)[0].astype(np.int64)
+            wide = np.nonzero(wide_q)[0].astype(np.int64)
+            if len(narrow):
+                dispatch("bucketed", narrow)
+            if len(wide):
+                dispatch("dense", wide)
         if immediate:
             # synchronous caller (deps_query, B=1): collect follows on the
             # next line with no interleaved mutation, so skip the snapshot
             # copies and the prefetch thread — the live mirror IS the
             # snapshot
-            th = None
-            ids = (self.deps.msb, self.deps.lsb, self.deps.node)
+            ids = (self.deps.msb, self.deps.lsb, self.deps.node,
+                   self.deps.obj)
             ivs = (self.deps.lo, self.deps.hi, self.deps.domain)
-            return (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k,
-                    n, d, list(queries))
-        # prefetch the result on a worker thread: np.asarray blocks on the
-        # (tunneled) transfer with the GIL released, so a pipelined caller
-        # attributes batch i while batch i+1 computes AND downloads
+        else:
+            # snapshot the mirror's id + interval columns: the mirror
+            # mutates in place, and a slot freed+reallocated between begin
+            # and end would otherwise resolve this batch's indices to the
+            # WRONG TxnId (or footprint)
+            ids = (self.deps.msb.copy(), self.deps.lsb.copy(),
+                   self.deps.node.copy(), self.deps.obj.copy())
+            ivs = (self.deps.lo.copy(), self.deps.hi.copy(),
+                   self.deps.domain.copy())
+        return (parts, ids, ivs, qnp, q_m, list(queries))
 
-        def _fetch():
-            try:
-                box["out"] = np.asarray(out_dev)
-            except BaseException as e:     # surfaced after join
-                box["err"] = e
+    def _bucket_query_cols(self, qnp: np.ndarray, q_m: int):
+        """Vectorized query->bucket-row mapping: int64[NQ, q_m, SPAN] dense
+        rows (-1 = no/empty bucket) and the wide-query mask (any interval
+        spanning more than SPAN buckets — those take the dense kernel)."""
+        shift = self.deps.BSHIFT
+        span = self.deps.SPAN
+        lo = qnp[:, 7:7 + q_m]
+        hi = qnp[:, 7 + q_m:7 + 2 * q_m]
+        used = lo <= hi
+        blo = lo >> shift
+        bhi = hi >> shift
+        wide_q = np.any(used & (bhi - blo + 1 > span), axis=1)
+        sorted_bids, row_of = self.deps.bid_rows()
+        cols = np.full((qnp.shape[0], q_m, span), -1, np.int64)
+        if len(sorted_bids):
+            for off in range(span):
+                bid = blo + off
+                ok = used & (bid <= bhi)
+                idx = np.searchsorted(sorted_bids, bid)
+                idxc = np.minimum(idx, len(sorted_bids) - 1)
+                found = ok & (sorted_bids[idxc] == bid)
+                cols[:, :, off] = np.where(found, row_of[idxc], -1)
+        return cols, wide_q
 
-        import threading
-        th = threading.Thread(target=_fetch, daemon=True)
-        th.start()
-        # snapshot the mirror's id + interval columns: the mirror mutates in
-        # place, and a slot freed+reallocated between begin and end would
-        # otherwise resolve this batch's indices to the WRONG TxnId (or
-        # footprint)
-        ids = (self.deps.msb.copy(), self.deps.lsb.copy(),
-               self.deps.node.copy())
-        ivs = (self.deps.lo.copy(), self.deps.hi.copy(),
-               self.deps.domain.copy())
-        return (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k, n,
-                d, list(queries))
-
-    def _batch_collect(self, handle):
-        """Collect a dispatched batch: ONE sparse download (plus a re-run
-        when the learned flat capacity overflowed), then the host-side
-        EXACT geometry pass over the coarse pairs — the kernel's bounding-
-        box mask admits a query sitting inside a slot's interval gap; the
-        vectorized overlap here drops those and hands the surviving
-        (pair, dep-interval, query-interval) triples to attribution.  The
-        re-run uses the table snapshot captured at begin — registrations
-        interleaved between begin and end must not shift the queried
-        snapshot."""
-        (box, th, table, ids, ivs, qnp, qmat, packed, q_m, s, k, n,
-         d, queries) = handle
-        nq = len(queries)
-        shard_n = n // d
+    def _collect_part(self, part):
+        """Download + parse one kernel part; re-run once when the learned
+        flat capacity overflowed.  Returns (global b_idx, j_idx)."""
+        box = part["box"]
+        th = part.get("th")
+        nq = part["nq"]
+        d = part["d"]
+        shard_n = part["shard_n"]
+        s, k = part["s"], part["k"]
 
         def parse(out, s, k):
             """Per-shard blocks (total, maxc, row_end[B], entries[s]) with
@@ -711,7 +1193,8 @@ class DeviceState:
                 counts = np.diff(row_end, prepend=0)
                 bs.append(np.repeat(np.arange(nq), counts))
                 js.append(blocks[i, 2 + nq:2 + nq + total].astype(np.int64)
-                          + i * shard_n)
+                          + (i * shard_n if part["kind"] != "bucketed"
+                             else 0))
             return np.concatenate(bs), np.concatenate(js)
 
         if th is not None:
@@ -729,18 +1212,61 @@ class DeviceState:
             blocks = out.reshape(d, 2 + nq + s)
             total = int(blocks[:, 0].max())
             s = min(-(-int(total * 1.25) // 16384) * 16384, nq * shard_n)
-            k = min(_pow2_at_least(int(blocks[:, 1].max())), shard_n)
             self._batch_flat = max(self._batch_flat, s)
-            self._batch_k = max(self._batch_k, k)
-            if d > 1:
+            q_m = part["q_m"]
+            if part["kind"] == "sharded":
+                k = min(_pow2_at_least(int(blocks[:, 1].max())), shard_n)
+                self._batch_k = max(self._batch_k, k)
                 from ..parallel.sharded import sharded_calculate_deps_flat
                 out = np.asarray(sharded_calculate_deps_flat(
-                    self.mesh, q_m, s, k)(table, qmat))
+                    self.mesh, q_m, s, k)(part["table"], part["qmat"]))
+            elif part["kind"] == "dense":
+                k = min(_pow2_at_least(int(blocks[:, 1].max())), shard_n)
+                self._batch_k = max(self._batch_k, k)
+                pr = part["prune"]
+                if pr is not None:
+                    out = np.asarray(dk.calculate_deps_flat_pruned(
+                        part["table"], part["qmat"], *pr, q_m, s, k))
+                else:
+                    out = np.asarray(dk.calculate_deps_flat(
+                        part["table"], part["qmat"], q_m, s, k))
             else:
-                out = np.asarray(dk.calculate_deps_flat(table, qmat, q_m,
-                                                        s, k))
+                k = min(_pow2_at_least(int(blocks[:, 1].max())),
+                        part["c"])
+                self._batch_k = max(self._batch_k, k)
+                pr = part["prune"]
+                if pr is not None:
+                    out = np.asarray(dk.bucketed_flat_pruned(
+                        part["table"], part["btable"], part["qmat"], q_m,
+                        part["span"], s, k, *pr))
+                else:
+                    out = np.asarray(dk.bucketed_flat_jit(
+                        part["table"], part["btable"], part["qmat"], q_m,
+                        part["span"], s, k))
             parsed = parse(out, s, k)
-        b_idx, j_idx = parsed
+        b_local, j_idx = parsed
+        gmap = part["gmap"]
+        b_global = gmap[b_local]
+        keep = b_global >= 0                      # drop pad rows
+        return b_global[keep], j_idx[keep]
+
+    def _batch_collect(self, handle):
+        """Collect a dispatched batch: one sparse download per part (plus a
+        re-run when the learned flat capacity overflowed), then the
+        host-side EXACT geometry pass over the coarse pairs — the kernel's
+        bounding-box mask admits a query sitting inside a slot's interval
+        gap; the vectorized overlap here drops those and hands the
+        surviving (pair, dep-interval, query-interval) triples to
+        attribution.  Re-runs use the table snapshot captured at begin —
+        registrations interleaved between begin and end must not shift the
+        queried snapshot."""
+        (parts, ids, ivs, qnp, q_m, queries) = handle
+        nq = len(queries)
+        outs = [self._collect_part(p) for p in parts]
+        b_idx = np.concatenate([o[0] for o in outs]) if outs else \
+            np.zeros(0, np.int64)
+        j_idx = np.concatenate([o[1] for o in outs]) if outs else \
+            np.zeros(0, np.int64)
         # exact geometry on the sparse pair list
         lo, hi, _dom = ivs
         lo_p, hi_p = lo[j_idx], hi[j_idx]                       # [P, M]
@@ -767,7 +1293,7 @@ class DeviceState:
         counts = np.bincount(b_idx, minlength=len(queries))
         row_ptr = np.zeros(len(queries) + 1, np.int64)
         np.cumsum(counts, out=row_ptr[1:])
-        msb, lsb, node = ids
+        msb, lsb, node, _obj = ids
         return (row_ptr, msb[j_idx], lsb[j_idx], node[j_idx])
 
     def deps_query_batch_end_attributed(self, safe, handle, builders) -> None:
@@ -789,10 +1315,10 @@ class DeviceState:
             return
         slot = self.drain.alloc(txn_id)
         self.drain.set_status(slot, dk.SLOT_STABLE, cmd.execute_at)
-        self.drain.adj[slot, :] = False
+        self.drain.clear_deps(slot)
         for dep in cmd.waiting_on.waiting_ids():
             dslot = self._dep_drain_slot(safe, dep)
-            self.drain.adj[slot, dslot] = True
+            self.drain.add_edge(slot, dslot)
         self.drain.active[slot] = True
         self.schedule_tick()
 
@@ -824,7 +1350,7 @@ class DeviceState:
         slot = self.drain.slot_of.get(txn_id)
         if slot is not None:
             self.drain.active[slot] = False
-            self.drain.adj[slot, :] = False
+            self.drain.clear_deps(slot)
 
     # Coalescing quantum for drain ticks (simulated/real micros): many dep
     # transitions land per tick, so the per-tick adjacency upload + kernel
@@ -852,7 +1378,18 @@ class DeviceState:
                 self.drain.sweep_free()
             return
         state, live = self.drain.state()
-        ready = np.asarray(drk.ready_frontier(state))[: len(live)]
+        if isinstance(state, drk.EllDrainState):
+            # large in-flight set: sparse gather sweep (no [N, N] anywhere)
+            ready = np.asarray(drk.ready_frontier_ell(state))[: len(live)]
+        elif self.mesh is not None and \
+                state.status.shape[0] % len(self.mesh.devices.flat) == 0:
+            # live mesh path: the frontier sweep row-shards across devices
+            # (the fixpoint analogue is parallel.sharded.sharded_drain)
+            from ..parallel.sharded import sharded_ready_frontier
+            ready = np.asarray(
+                sharded_ready_frontier(self.mesh)(state))[: len(live)]
+        else:
+            ready = np.asarray(drk.ready_frontier(state))[: len(live)]
         cand_slots = live[ready & self.drain.active[live]]
         if len(cand_slots) != 0:
             cands = sorted(

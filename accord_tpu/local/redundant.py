@@ -161,6 +161,45 @@ class RedundantBefore:
             return TxnId.NONE
         return max(e.redundant_before, e.bootstrapped_at)
 
+    def min_floor_over(self, lo: int, hi: int) -> TxnId:
+        """Conservative batch-global deps floor: the MIN deps_floor over
+        every map segment overlapping [lo, hi] (TxnId.NONE as soon as any
+        overlapped segment has no floor).  Safe to apply ON DEVICE before
+        the exact per-token host floors: it never exceeds any token's
+        floor inside the window."""
+        import bisect
+        b = self._map.boundaries
+        i0 = bisect.bisect_right(b, lo)
+        i1 = bisect.bisect_right(b, hi)
+        out = None
+        for v in self._map.values[i0:i1 + 1]:
+            f = TxnId.NONE if v is None else max(v.redundant_before,
+                                                 v.bootstrapped_at)
+            if out is None or f < out:
+                out = f
+            if out == TxnId.NONE:
+                break
+        return out if out is not None else TxnId.NONE
+
+    def deps_floor_batch(self, tokens):
+        """Vectorized deps_floor over a token column: packed (msb, lsb,
+        node) int64 arrays aligned with ``tokens``.  One floor is computed
+        per distinct map segment (the map has a handful of segments; the
+        batch has thousands of tokens)."""
+        import numpy as np
+
+        from ..ops.packing import to_i64
+        m = self._map
+        bnd = np.asarray(m.boundaries, np.int64)
+        idx = np.searchsorted(bnd, tokens, side="right")
+        packed = np.empty((len(m.values), 3), np.int64)
+        for i, v in enumerate(m.values):
+            f = TxnId.NONE if v is None else max(v.redundant_before,
+                                                 v.bootstrapped_at)
+            packed[i] = (to_i64(f.msb), to_i64(f.lsb), f.node)
+        sel = packed[idx]
+        return sel[:, 0], sel[:, 1], sel[:, 2]
+
     def boundary_dep(self, token: int) -> Optional[TxnId]:
         """The bootstrap-fence TxnId flooring this key's deps, if any.  A
         PreAccept reply that pruned entries below the floor must include the

@@ -232,7 +232,7 @@ def query_from_qmat(qmat: jnp.ndarray, m: int) -> DepsQuery:
 
 
 def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
-                   m: int, s: int, k: int) -> jnp.ndarray:
+                   m: int, s: int, k: int, prune=None) -> jnp.ndarray:
     """The traceable body of calculate_deps_flat: exact mask over THIS
     table (a full table, or one mesh shard's slice under shard_map), then
     per-row top-k compaction (memory-safe: fuses into the mask computation,
@@ -240,7 +240,10 @@ def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
     widest row, ``s`` the batch total; both sticky-learned by the caller
     from the header counts."""
     query = query_from_qmat(qmat, m)
-    mask, _conflict = _dep_mask_and_conflict(table, query)
+    if prune is None:
+        mask, _conflict = _dep_mask_and_conflict(table, query)
+    else:
+        mask, _conflict = _dep_mask_and_conflict(table, query, *prune)
     k = min(k, mask.shape[1])
     idx, counts = _compact_topk(mask, k)                       # [B,k],[B]
     row_end = jnp.cumsum(counts)                               # [B]
@@ -252,6 +255,166 @@ def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
         .set(idx.reshape(-1), mode="drop")[:s]
     header = jnp.stack([row_end[-1], jnp.max(counts)]).astype(jnp.int32)
     return jnp.concatenate([header, row_end.astype(jnp.int32), flat])
+
+
+# -- bucketed index kernel ----------------------------------------------------
+#
+# The CINTIA-style device index (ref: utils/CheckpointIntervalArray.java:40-60,
+# CheckpointIntervalArrayBuilder.java — the reference's checkpointed interval
+# stabbing structure), redesigned for static shapes: the token space is cut
+# into width-2^shift buckets; every NARROW slot interval is registered as an
+# (lo, hi, slot) entry in each bucket it touches; intervals spanning many
+# buckets — and bucket-overflow spill — live in a separate WIDE list that
+# every query always checks (the reference's straggler/checkpoint split).
+# A query probes only the K entries of the <= SPAN buckets each of its
+# intervals touches, so the scan is O(candidates), not O(N): the exact
+# predicate (overlap, earlier-TxnId, witness, liveness) runs per candidate,
+# duplicates (one slot reachable via several buckets/intervals) are removed
+# by an in-row sort, and the surviving slot ids compact into the same packed
+# CSR the dense kernel ships.
+
+
+class BucketTable(NamedTuple):
+    """Device half of the bucket index: G buckets x K interval entries plus
+    the wide/straggler entries (-1 slot = empty).
+
+    Every IMMUTABLE per-slot column the predicate needs (packed TxnId,
+    kind) is embedded in the entry: TPU gathers of scalar columns at
+    arbitrary candidate indices lower to slow per-element loops (~140ms
+    per gathered column at B=2048, C=4k over the VPU), while row gathers
+    of whole bucket lines are effectively free.  Liveness needs no status
+    column: entries are de-indexed on invalidate/free, so candidates are
+    live by construction (the exact status/floor semantics are re-applied
+    by the host geometry + attribution pass either way)."""
+
+    blo: jnp.ndarray     # int64[G, K] entry interval starts (PAD_LO empty)
+    bhi: jnp.ndarray     # int64[G, K]
+    bslot: jnp.ndarray   # int32[G, K] owning slot (-1 empty)
+    bmsb: jnp.ndarray    # int64[G, K] owning TxnId packed
+    blsb: jnp.ndarray    # int64[G, K]
+    bnode: jnp.ndarray   # int32[G, K]
+    bkind: jnp.ndarray   # int32[G, K]
+    wlo: jnp.ndarray     # int64[W] wide/straggler entries
+    whi: jnp.ndarray     # int64[W]
+    wslot: jnp.ndarray   # int32[W]
+    wmsb: jnp.ndarray    # int64[W]
+    wlsb: jnp.ndarray    # int64[W]
+    wnode: jnp.ndarray   # int32[W]
+    wkind: jnp.ndarray   # int32[W]
+
+
+def _entry_pred(query: DepsQuery, ov, slot, emsb, elsb, enode, ekind,
+                extra_dims: int):
+    """Exact per-entry predicate on embedded entry columns; ``extra_dims``
+    broadcasts the per-query scalars over the candidate axes."""
+    idx = (slice(None),) + (None,) * extra_dims
+    valid = slot >= 0
+    witnessed = (query.witness_mask[idx] >> ekind) & 1 > 0
+    earlier = ts_lt(emsb, elsb, enode,
+                    query.msb[idx], query.lsb[idx], query.node[idx])
+    not_self = ~ts_eq(emsb, elsb, enode, query.self_msb[idx],
+                      query.self_lsb[idx], query.self_node[idx])
+    return valid & ov & witnessed & earlier & not_self
+
+
+def bucketed_flat(table: DepsTable, buckets: BucketTable, qmat: jnp.ndarray,
+                  m: int, span: int, s: int, k: int, prune=None) -> jnp.ndarray:
+    """Bucket-indexed batched deps scan -> packed CSR (header(total, maxc),
+    row_end[B], entries[s]) — same layout as flat_csr_local, d=1.
+
+    ``qmat`` carries the standard query columns plus m*span bucket-row
+    columns (int64, -1 = no bucket) appended by the host packer.  ``table``
+    is unused on the device (kept in the signature so dispatch snapshots
+    stay uniform across kernels); all predicate data rides in ``buckets``."""
+    query = query_from_qmat(qmat, m)
+    b = qmat.shape[0]
+    qbuck = qmat[:, 7 + 2 * m:].astype(jnp.int32)          # [B, m*span]
+    g = jnp.clip(qbuck, 0)
+    has = qbuck >= 0                                        # [B, m*span]
+    # bucket candidates: every entry of every touched bucket, each checked
+    # against the query interval that touched the bucket (row gathers only)
+    elo = buckets.blo[g]                                    # [B, m*span, K]
+    ehi = buckets.bhi[g]
+    qlo = jnp.repeat(query.lo, span, axis=1)[:, :, None]    # [B, m*span, 1]
+    qhi = jnp.repeat(query.hi, span, axis=1)[:, :, None]
+    ov = (elo <= qhi) & (qlo <= ehi) & has[:, :, None]      # [B, m*span, K]
+    pred_b = _entry_pred(query, ov, buckets.bslot[g], buckets.bmsb[g],
+                         buckets.blsb[g], buckets.bnode[g],
+                         buckets.bkind[g], 2)
+    cand = jnp.where(has[:, :, None], buckets.bslot[g], -1).reshape(b, -1)
+    pred_b = pred_b.reshape(b, -1)
+    # wide/straggler candidates: checked against ALL query intervals
+    w = buckets.wlo.shape[0]
+    ov_w = jnp.any((buckets.wlo[None, None, :] <= query.hi[:, :, None])
+                   & (query.lo[:, :, None] <= buckets.whi[None, None, :]),
+                   axis=1)                                  # [B, W]
+    pred_w = _entry_pred(query, ov_w, buckets.wslot[None, :],
+                         buckets.wmsb[None, :], buckets.wlsb[None, :],
+                         buckets.wnode[None, :], buckets.wkind[None, :], 1)
+    cand = jnp.concatenate(
+        [cand, jnp.broadcast_to(buckets.wslot[None, :], (b, w))], axis=1)
+    pred = jnp.concatenate([pred_b, pred_w], axis=1)        # [B, C]
+    if prune is not None:
+        pmsb, plsb, pnode = prune
+        above_b = ~ts_lt(buckets.bmsb[g], buckets.blsb[g], buckets.bnode[g],
+                         pmsb, plsb, pnode).reshape(b, -1)
+        above_w = ~ts_lt(buckets.wmsb[None, :], buckets.wlsb[None, :],
+                         buckets.wnode[None, :], pmsb, plsb, pnode)
+        pred = pred & jnp.concatenate(
+            [above_b, jnp.broadcast_to(above_w, (b, w))], axis=1)
+    # dedupe (a slot is reachable via several buckets/intervals): sort the
+    # surviving ids per row, mark adjacent repeats; -1 rejects sort first
+    hit = jnp.where(pred, cand, -1)
+    hit = jnp.sort(hit, axis=1)
+    uniq = jnp.concatenate(
+        [hit[:, :1] >= 0,
+         (hit[:, 1:] >= 0) & (hit[:, 1:] != hit[:, :-1])], axis=1)
+    counts = jnp.sum(uniq, axis=1, dtype=jnp.int32)         # [B]
+    row_end = jnp.cumsum(counts)
+    starts = row_end - counts
+    # compact the unique survivors to the row's first k columns via top_k
+    # (scattering all B*C candidate positions directly is pathologically
+    # slow on TPU; the top_k keeps the scatter at B*k elements) — unique
+    # survivors keep ascending slot order because scores descend with col
+    c = hit.shape[1]
+    k = min(k, c)
+    col = jnp.arange(c, dtype=jnp.int32)
+    scores = jnp.where(uniq, c - col, 0)
+    top, tidx = jax.lax.top_k(scores, k)                    # [B, k]
+    vals = jnp.take_along_axis(hit, tidx, axis=1)
+    valid = top > 0
+    pos = starts[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    pos = jnp.where(valid & (pos < s), pos, s)
+    flat = jnp.full(s + 1, -1, jnp.int32).at[pos.reshape(-1)] \
+        .set(vals.reshape(-1), mode="drop")[:s]
+    header = jnp.stack([row_end[-1], jnp.max(counts)]).astype(jnp.int32)
+    return jnp.concatenate([header, row_end.astype(jnp.int32), flat])
+
+
+bucketed_flat_jit = jax.jit(bucketed_flat, static_argnums=(3, 4, 5, 6))
+
+
+@partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def bucketed_flat_pruned(table: DepsTable, buckets: BucketTable,
+                         qmat: jnp.ndarray, m: int, span: int, s: int,
+                         k: int, prune_msb: jnp.ndarray = None,
+                         prune_lsb: jnp.ndarray = None,
+                         prune_node: jnp.ndarray = None) -> jnp.ndarray:
+    return bucketed_flat(table, buckets, qmat, m, span, s, k,
+                         (prune_msb, prune_lsb, prune_node))
+
+
+@partial(jax.jit, static_argnums=(5, 6, 7))
+def calculate_deps_flat_pruned(table: DepsTable, qmat: jnp.ndarray,
+                               prune_msb: jnp.ndarray, prune_lsb: jnp.ndarray,
+                               prune_node: jnp.ndarray,
+                               m: int, s: int, k: int) -> jnp.ndarray:
+    """calculate_deps_flat with a device-side RedundantBefore floor: entries
+    below the (conservative, batch-global) floor never enter the CSR, so a
+    hot store whose durable prefix dominates ships only the live tail (the
+    host attribution still applies the exact per-token floors on top)."""
+    return flat_csr_local(table, qmat, m, s, k,
+                          (prune_msb, prune_lsb, prune_node))
 
 
 def pack_query_matrix(queries: Sequence[tuple], max_intervals: int) -> np.ndarray:

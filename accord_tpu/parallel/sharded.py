@@ -144,6 +144,47 @@ def sharded_drain(mesh: Mesh):
     return jax.jit(fn)
 
 
+_FRONTIER_CACHE = {}
+
+
+def sharded_ready_frontier(mesh: Mesh):
+    """Row-sharded single frontier sweep — the live ``DeviceState._tick``
+    path under a mesh (the fixpoint variant above is ``sharded_drain``; the
+    tick wants one sweep because the host re-validates and applies each
+    candidate before the next sweep's statuses are known).  fn(state) ->
+    ready bool[N] replicated."""
+    key = tuple(d.id for d in mesh.devices.flat)
+    fn = _FRONTIER_CACHE.get(key)
+    if fn is not None:
+        return fn
+    state_specs = DrainState(P(STORE_AXIS, None), P(STORE_AXIS),
+                             P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                             P(STORE_AXIS))
+
+    def local(state: DrainState):
+        full_em = lax.all_gather(state.exec_msb, STORE_AXIS, axis=0, tiled=True)
+        full_el = lax.all_gather(state.exec_lsb, STORE_AXIS, axis=0, tiled=True)
+        full_en = lax.all_gather(state.exec_node, STORE_AXIS, axis=0, tiled=True)
+        full_status = lax.all_gather(state.status, STORE_AXIS, axis=0,
+                                     tiled=True)
+        undecided = (full_status >= 0) & (full_status < SLOT_COMMITTED)
+        dead = (full_status == SLOT_INVALIDATED) | (full_status == SLOT_FREE)
+        exec_before = ts_lt(full_em[None, :], full_el[None, :], full_en[None, :],
+                            state.exec_msb[:, None], state.exec_lsb[:, None],
+                            state.exec_node[:, None])
+        blocking = state.adj & (undecided[None, :] | exec_before |
+                                state.awaits_all[:, None]) & ~dead[None, :]
+        applied = full_status == SLOT_APPLIED
+        waiting = jnp.any(blocking & ~applied[None, :], axis=1)
+        ready_local = (state.status == SLOT_STABLE) & ~waiting
+        return lax.all_gather(ready_local, STORE_AXIS, axis=0, tiled=True)
+
+    fn = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=(state_specs,),
+                               out_specs=P(), check_vma=False))
+    _FRONTIER_CACHE[key] = fn
+    return fn
+
+
 _FLAT_CACHE = {}
 
 
@@ -159,7 +200,11 @@ def sharded_calculate_deps_flat(mesh: Mesh, m: int, s: int, k: int):
     shard block is (total, max_row_count, row_end[B], entries[s]) with
     SHARD-LOCAL slot indices."""
     from ..ops import deps_kernel as dk
-    key = (tuple(mesh.shape.items()), m, s, k)
+    # key by the mesh's device placement, not just its shape: two equal-
+    # shaped meshes with different device orderings must not share a jitted
+    # shard_map closed over the first mesh object
+    dev_key = tuple(d.id for d in mesh.devices.flat)
+    key = (tuple(mesh.shape.items()), dev_key, m, s, k)
     fn = _FLAT_CACHE.get(key)
     if fn is not None:
         return fn

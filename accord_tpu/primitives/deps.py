@@ -207,10 +207,22 @@ class KeyDeps:
 
 class KeyDepsBuilder:
     """Accumulates (token, TxnId) relations, freezes to CSR
-    (ref: utils/RelationMultiMap.AbstractBuilder)."""
+    (ref: utils/RelationMultiMap.AbstractBuilder).
+
+    Two ingestion paths: per-emit ``add`` (host protocol code) and
+    ``set_prebuilt`` (the device batch attribution constructs whole batches
+    of builders' KeyDeps in one vectorized pass); build() merges them."""
 
     def __init__(self):
         self._map: Dict[int, Set[TxnId]] = {}
+        self._prebuilt: Optional[KeyDeps] = None
+
+    def set_prebuilt(self, deps: "KeyDeps") -> None:
+        """Attach a batch-finalized KeyDeps (the device attribution builds
+        whole batches of builders in one vectorized pass); build() merges
+        it with any per-emit additions."""
+        self._prebuilt = deps if self._prebuilt is None \
+            else self._prebuilt.with_(deps)
 
     def add(self, token: int, txn_id: TxnId) -> "KeyDepsBuilder":
         s = self._map.get(token)
@@ -220,9 +232,16 @@ class KeyDepsBuilder:
         return self
 
     def is_empty(self) -> bool:
-        return not self._map
+        return not self._map \
+            and (self._prebuilt is None or self._prebuilt.is_empty())
 
     def build(self) -> KeyDeps:
+        if self._prebuilt is not None:
+            if not self._map:
+                return self._prebuilt
+            inc = KeyDepsBuilder()
+            inc._map = self._map
+            return self._prebuilt.with_(inc.build())
         if not self._map:
             return KeyDeps.none()
         tokens = sorted(self._map)
@@ -231,8 +250,10 @@ class KeyDepsBuilder:
             all_ids.update(s)
         txn_ids = sorted(all_ids)
         index_of = {t: i for i, t in enumerate(txn_ids)}
-        per_key = [sorted(index_of[t] for t in self._map[tok]) for tok in tokens]
-        return KeyDeps(RoutingKeys(tokens, _presorted=True), txn_ids, per_key)
+        per_key = [sorted(index_of[t] for t in self._map[tok])
+                   for tok in tokens]
+        return KeyDeps(RoutingKeys(tokens, _presorted=True), txn_ids,
+                       per_key)
 
 
 _NONE_KEY_DEPS = KeyDeps(RoutingKeys.empty(), [], [])
@@ -381,8 +402,16 @@ class RangeDeps:
 
 
 class RangeDepsBuilder:
+    """Same two ingestion paths as KeyDepsBuilder: per-emit ``add`` and
+    ``set_prebuilt`` from the device batch attribution."""
+
     def __init__(self):
         self._map: Dict[Tuple[int, int], Set[TxnId]] = {}
+        self._prebuilt: Optional[RangeDeps] = None
+
+    def set_prebuilt(self, deps: "RangeDeps") -> None:
+        self._prebuilt = deps if self._prebuilt is None \
+            else self._prebuilt.with_(deps)
 
     def add(self, rng: Range, txn_id: TxnId) -> "RangeDepsBuilder":
         key = (rng.start, rng.end)
@@ -393,9 +422,16 @@ class RangeDepsBuilder:
         return self
 
     def is_empty(self) -> bool:
-        return not self._map
+        return not self._map \
+            and (self._prebuilt is None or self._prebuilt.is_empty())
 
     def build(self) -> RangeDeps:
+        if self._prebuilt is not None:
+            if not self._map:
+                return self._prebuilt
+            inc = RangeDepsBuilder()
+            inc._map = self._map
+            return self._prebuilt.with_(inc.build())
         if not self._map:
             return RangeDeps.none()
         keys = sorted(self._map)
@@ -405,7 +441,8 @@ class RangeDepsBuilder:
         txn_ids = sorted(all_ids)
         index_of = {t: i for i, t in enumerate(txn_ids)}
         ranges = [Range(s, e) for (s, e) in keys]
-        per_range = [sorted(index_of[t] for t in self._map[k]) for k in keys]
+        per_range = [sorted(index_of[t] for t in self._map[k])
+                     for k in keys]
         return RangeDeps(ranges, txn_ids, per_range)
 
 

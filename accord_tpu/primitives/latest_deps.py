@@ -46,8 +46,8 @@ class LatestEntry:
         """(ref: AbstractEntry.reduce) — pick the more decided entry; within
         PROPOSED the higher ballot; union locals below DECIDED."""
         win, lose = a, b
-        if (b.known, b.ballot if b.known is PROPOSED else Ballot.ZERO) > \
-                (a.known, a.ballot if a.known is PROPOSED else Ballot.ZERO):
+        if (b.known, b.ballot if b.known == PROPOSED else Ballot.ZERO) > \
+                (a.known, a.ballot if a.known == PROPOSED else Ballot.ZERO):
             win, lose = b, a
         if win.known >= DECIDED:
             return win
@@ -150,7 +150,7 @@ class LatestDeps:
             else:
                 sufficient.append(Range(start, end))
                 picked = _slice(entry.coordinated, seg) \
-                    if entry.known is PROPOSED else None
+                    if entry.known == PROPOSED else None
                 picked = _union(picked, _slice(entry.local, seg))
             return acc if picked is None else acc.with_(picked)
 

@@ -399,23 +399,39 @@ def main():
     dev.deps_query_batch_attributed(   # warmup/compile (+ learn k)
         safe, batches[0], [DepsBuilder() for _ in batches[0]])
     rates = []
+    phases = {"begin": 0.0, "collect": 0.0, "build": 0.0}
+
+    def count_built(built):
+        return sum(sum(len(r) for r in d.key_deps._ranges_per_key)
+                   + sum(len(r) for r in d.range_deps._per_range)
+                   for d in built)
+
     for rep in range(REPS):
         t0 = time.time()
         n_deps = 0
         # double-buffered: dispatch batch i+1 while downloading batch i —
-        # the server-side pipelining a deployment uses (full protocol
-        # results are still materialized for every query)
+        # the server-side pipelining a deployment uses.  Every query's
+        # PROTOCOL-COMPLETE result is materialized: floors + elision +
+        # attribution folded into builders, then frozen to the CSR
+        # KeyDeps/RangeDeps a replica would ship (ref KeyDeps.Builder)
         pending = []
 
         def collect(handle, batch):
             builders = [DepsBuilder() for _ in batch]
+            t1 = time.time()
             dev.deps_query_batch_end_attributed(safe, handle, builders)
-            return sum(sum(len(s) for s in b.key._map.values())
-                       + sum(len(s) for s in b.range._map.values())
-                       for b in builders)
+            t2 = time.time()
+            built = [b.build() for b in builders]
+            t3 = time.time()
+            phases["collect"] += t2 - t1
+            phases["build"] += t3 - t2
+            return count_built(built)
 
         for batch in batches:
-            pending.append((dev.deps_query_batch_begin(batch), batch))
+            t1 = time.time()
+            handle = dev.deps_query_batch_begin(batch)
+            phases["begin"] += time.time() - t1
+            pending.append((handle, batch))
             if len(pending) >= PIPELINE:
                 n_deps += collect(*pending.pop(0))
         while pending:
@@ -424,6 +440,7 @@ def main():
         rates.append(B * BATCHES / dt)
     dev_med = statistics.median(rates)
     dev_min = min(rates)
+    n_phase_batches = BATCHES * REPS
 
     # -- live maintenance: interleave inserts with query batches -------------
     extra = build_workload(np.random.default_rng(7), B * 8, KEYSPACE, M)
@@ -439,15 +456,21 @@ def main():
     live_s = time.time() - t0
     live_rate = (B * 8 * 2) / live_s   # one insert + one query per txn
 
-    # -- host baseline: reference-shaped indexed scan ------------------------
+    # -- host baseline: reference-shaped indexed scan, >=1k queries x 5
+    #    reps, median + spread (the r04 64-query sample was too thin to
+    #    anchor a 10x claim) ------------------------------------------------
     base = HostIndexedBaseline(entries)
-    hq = make_queries(999, 64, KEYSPACE, M)
-    for q in hq[:4]:
+    hq = make_queries(999, 1024, KEYSPACE, M)
+    for q in hq[:32]:
         base.query(*q)   # warm caches
-    t0 = time.time()
-    for q in hq:
-        base.query(*q)
-    host_rate = len(hq) / (time.time() - t0)
+    host_rates = []
+    for _rep in range(5):
+        t0 = time.time()
+        for q in hq:
+            base.query(*q)
+        host_rates.append(len(hq) / (time.time() - t0))
+    host_rate = statistics.median(host_rates)
+    host_spread = max(host_rates) / min(host_rates)
 
     print(json.dumps({
         "metric": "preaccept_deps_calc_txns_per_sec_100k_inflight"
@@ -456,21 +479,32 @@ def main():
         "value": round(dev_med, 2),
         "unit": "txn/s",
         "vs_baseline": round(dev_med / host_rate, 2),
+        "vs_baseline_kind": "host-numpy",
     }))
+    pb = {k: 1e3 * v / n_phase_batches for k, v in phases.items()}
     print(f"# device={jax.devices()[0].platform} N={N} B={B} "
           f"queries_per_rep={B * BATCHES} reps={REPS}\n"
           f"# dev_median={dev_med:.1f}/s dev_min={dev_min:.1f}/s "
           f"spread={max(rates) / min(rates):.2f}x\n"
+          f"# phase breakdown (ms/batch of {B}, wall, phases overlap via "
+          f"double-buffering): begin(pack+upload+dispatch)={pb['begin']:.1f} "
+          f"collect(download+parse+geometry+attribute)={pb['collect']:.1f} "
+          f"csr_freeze={pb['build']:.1f}\n"
+          f"# index: bucketed_queries={dev.n_bucketed_queries} "
+          f"dispatches={dev.n_dispatches} "
+          f"wide_entries={len(dev.deps.wide_entries)} "
+          f"buckets={len(dev.deps.bucket_entries)}\n"
           f"# build={build_rate:.0f} reg/s live_insert+query={live_rate:.0f} op/s\n"
           f"# baseline=host indexed scan (numpy-vectorized reference "
-          f"semantics) {host_rate:.1f} q/s; JVM baseline unavailable: "
-          f"zero-egress env cannot resolve the reference's gradle deps\n"
-          f"# NOTE vs round 3: r03 timed a raw-CSR kernel path against a "
-          f"count-only baseline; this round BOTH sides materialize the "
-          f"protocol-complete result (floors + elision + attribution into "
-          f"real builders on the device side; (key, dep) pair lists on the "
-          f"baseline side) — the honest like-for-like ratio, not a "
-          f"regression",
+          f"semantics) {host_rate:.1f} q/s median of 5x{len(hq)} queries, "
+          f"spread={host_spread:.2f}x; vs_baseline_kind=host-numpy: the JVM "
+          f"baseline is unavailable (zero-egress env cannot resolve the "
+          f"reference's gradle deps)\n"
+          f"# methodology (r05): device side runs the live protocol store "
+          f"through the bucketed device interval index (CINTIA-analogue) "
+          f"with floors + elision + attribution + CSR freeze; baseline "
+          f"materializes (key, dep) pair lists (CSR freeze not charged to "
+          f"the baseline — generous)",
           file=sys.stderr)
 
     # -- BASELINE configs[0]/[1]/[3]/[4]: secondary metrics (stderr; the

@@ -1,0 +1,253 @@
+"""Bucketed interval index (ops.deps_kernel.bucketed_flat + the
+_DepsMirror bucket maintenance): the single-device fast path the real-chip
+bench runs.  The suite's virtual mesh forces the sharded kernel everywhere
+else, so these tests pin mesh=None and drive the bucketed path directly,
+checking it against the dense kernel and a host brute force — identical
+results through every footprint shape: points, narrow ranges, wide
+(straggler) ranges, hot-bucket overflow spill, frees, and wide queries
+(dense sub-batch fallback)."""
+
+import numpy as np
+import pytest
+
+from accord_tpu.local.commands_for_key import InternalStatus
+from accord_tpu.local.device_index import DeviceState, _DepsMirror
+from accord_tpu.local.redundant import RedundantBefore
+from accord_tpu.primitives.deps import DepsBuilder
+from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+
+class _Store:
+    def __init__(self):
+        self.commands_for_key = {}
+        self.redundant_before = RedundantBefore()
+
+    class node:
+        scheduler = None
+
+
+class _Safe:
+    def __init__(self, store):
+        self.store = store
+
+    def redundant_before(self):
+        return self.store.redundant_before
+
+
+def _mk_state():
+    store = _Store()
+    dev = DeviceState(store)
+    dev.mesh = None          # pin the single-device path under the test mesh
+    return store, dev, _Safe(store)
+
+
+def _workload(rng, n, keyspace, hot_frac=0.0, wide_frac=0.0):
+    hlcs = rng.choice(np.arange(1, 10 * n + 10), size=n, replace=False)
+    out = []
+    for i in range(n):
+        r = rng.random()
+        kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
+        if r < wide_frac:
+            # straggler: interval spanning many buckets
+            s = int(rng.integers(0, keyspace // 2))
+            toks, rngs = [], [Range(s, s + int(rng.integers(
+                _DepsMirror.SPAN * (1 << _DepsMirror.BSHIFT) + 1,
+                keyspace // 2)))]
+            dom = Domain.Range
+        elif r < wide_frac + hot_frac:
+            # hot bucket: tokens from one 64-token window (overflow spill)
+            toks = [int(t) for t in rng.integers(0, 1 << _DepsMirror.BSHIFT,
+                                                 rng.integers(1, 3))]
+            rngs = []
+            dom = Domain.Key
+        elif rng.random() < 0.5:
+            toks = [int(t) for t in rng.integers(0, keyspace,
+                                                 rng.integers(1, 4))]
+            rngs = []
+            dom = Domain.Key
+        else:
+            toks = []
+            rngs = []
+            for _ in range(int(rng.integers(1, 3))):
+                s = int(rng.integers(0, keyspace - 80))
+                rngs.append(Range(s, s + int(rng.integers(1, 80))))
+            dom = Domain.Range
+        tid = TxnId.create(1, int(hlcs[i]), kind, dom,
+                           1 + int(rng.integers(0, 5)))
+        out.append((tid, toks, rngs))
+    return out
+
+
+def _queries(rng, nq, keyspace, n, wide_q_frac=0.0):
+    qs = []
+    for _ in range(nq):
+        bound = TxnId.create(1, int(rng.integers(10 * n + 10, 20 * n + 20)),
+                             TxnKind.Write, Domain.Key, 1)
+        toks, rngs = [], []
+        for _ in range(int(rng.integers(1, 4))):
+            if rng.random() < wide_q_frac:
+                s = int(rng.integers(0, keyspace // 2))
+                rngs.append(Range(s, s + keyspace // 3))
+            elif rng.random() < 0.5:
+                toks.append(int(rng.integers(0, keyspace)))
+            else:
+                s = int(rng.integers(0, keyspace - 80))
+                rngs.append(Range(s, s + int(rng.integers(1, 80))))
+        qs.append((bound, bound, bound.kind().witnesses(), toks, rngs))
+    return qs
+
+
+def _brute(entries, q):
+    """(bound, self, witnesses, toks, rngs) -> sorted dep TxnId list."""
+    bound, _self_id, witnesses, toks, rngs = q
+    out = set()
+    for tid, etoks, erngs in entries:
+        if not (tid < bound) or tid == bound:
+            continue
+        if not witnesses.test(tid.kind()):
+            continue
+        hit = False
+        for t in toks:
+            if t in etoks or any(r.contains_token(t) for r in erngs):
+                hit = True
+        for r in rngs:
+            for t in etoks:
+                if r.contains_token(t):
+                    hit = True
+            for er in erngs:
+                if er.start < r.end and r.start < er.end:
+                    hit = True
+        if hit:
+            out.add(tid)
+    return sorted(out)
+
+
+def _raw_deps(dev, qs):
+    row_ptr, msb, lsb, node = dev.deps_query_batch(qs)
+    from accord_tpu.ops.packing import unpack_txn_id
+    out = []
+    for b in range(len(qs)):
+        sl = slice(int(row_ptr[b]), int(row_ptr[b + 1]))
+        out.append(sorted(unpack_txn_id(m, l, n)
+                          for m, l, n in zip(msb[sl], lsb[sl], node[sl])))
+    return out
+
+
+@pytest.mark.parametrize("shape", ["spread", "hot", "wide", "mixed"])
+def test_bucketed_matches_bruteforce_and_dense(shape):
+    rng = np.random.default_rng({"spread": 1, "hot": 2, "wide": 3,
+                                 "mixed": 4}[shape])
+    hot = 0.6 if shape == "hot" else (0.2 if shape == "mixed" else 0.0)
+    wide = 0.3 if shape == "wide" else (0.2 if shape == "mixed" else 0.0)
+    keyspace = 20_000
+    entries = _workload(rng, 300, keyspace, hot_frac=hot, wide_frac=wide)
+    store, dev, safe = _mk_state()
+    for tid, toks, rngs in entries:
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+    qs = _queries(rng, 40, keyspace, 300,
+                  wide_q_frac=0.2 if shape in ("wide", "mixed") else 0.0)
+    got = _raw_deps(dev, qs)
+    assert dev.n_bucketed_queries > 0, "bucketed path never ran"
+    # identical to brute force
+    for q, g in zip(qs, got):
+        assert g == _brute(entries, q)
+    # identical to the dense kernel on the same store
+    dev.BUCKETED = False
+    dense = _raw_deps(dev, qs)
+    assert got == dense
+
+
+def test_bucketed_survives_frees_and_requery():
+    rng = np.random.default_rng(9)
+    keyspace = 5_000
+    entries = _workload(rng, 200, keyspace, wide_frac=0.15)
+    store, dev, safe = _mk_state()
+    for tid, toks, rngs in entries:
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+    drop = entries[::3]
+    for tid, _t, _r in drop:
+        dev.free(tid)
+    kept = [e for i, e in enumerate(entries) if i % 3 != 0]
+    qs = _queries(rng, 30, keyspace, 200)
+    got = _raw_deps(dev, qs)
+    for q, g in zip(qs, got):
+        assert g == _brute(kept, q)
+    # the freed slots must be fully de-indexed: no stale bucket entries
+    live = set()
+    for ents in dev.deps.bucket_entries:
+        live.update(s for (_l, _h, s) in ents)
+    live.update(s for (_l, _h, s) in dev.deps.wide_entries)
+    assert all(dev.deps.id_of.get(s) is not None for s in live)
+
+
+def test_bucketed_attributed_matches_dense_attributed():
+    """The protocol-complete path (floors + elision + attribution) must be
+    byte-identical between the bucketed and dense kernels."""
+    rng = np.random.default_rng(11)
+    keyspace = 8_000
+    entries = _workload(rng, 250, keyspace, hot_frac=0.2, wide_frac=0.1)
+    store, dev, safe = _mk_state()
+    floor_id = TxnId.create(1, 50, TxnKind.ExclusiveSyncPoint, Domain.Range, 1)
+    store.redundant_before.add_redundant(
+        Ranges.of(Range(0, keyspace // 3)), floor_id)
+    for tid, toks, rngs in entries:
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+    qs = _queries(rng, 32, keyspace, 250, wide_q_frac=0.1)
+
+    def run():
+        builders = [DepsBuilder() for _ in qs]
+        dev.deps_query_batch_attributed(safe, qs, builders)
+        out = []
+        for b in builders:
+            deps = b.build()
+            out.append(([(k, tuple(deps.key_deps.txn_ids_for(k)))
+                         for k in deps.key_deps.keys.tokens()],
+                        [(r.start, r.end, tuple(deps.range_deps.txn_ids[j]
+                                                for j in row))
+                         for r, row in zip(deps.range_deps.ranges,
+                                           deps.range_deps._per_range)]))
+        return out
+
+    got = run()
+    dev.BUCKETED = False
+    want = run()
+    assert got == want
+
+
+def test_device_floor_prune_matches_host_floors():
+    """A floor covering the whole queried window makes the batch-global
+    DEVICE prune engage (min_floor_over > NONE); results must still be
+    exactly the host-floored ones, on both kernels."""
+    rng = np.random.default_rng(21)
+    keyspace = 4_000
+    entries = _workload(rng, 220, keyspace, wide_frac=0.1)
+    store, dev, safe = _mk_state()
+    floor_id = TxnId.create(1, 1_000, TxnKind.ExclusiveSyncPoint,
+                            Domain.Range, 1)
+    store.redundant_before.add_redundant(
+        Ranges.of(Range(-(1 << 60), 1 << 60)), floor_id)
+    assert store.redundant_before.min_floor_over(0, keyspace) == floor_id
+    for tid, toks, rngs in entries:
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+    qs = _queries(rng, 24, keyspace, 220)
+
+    def run():
+        builders = [DepsBuilder() for _ in qs]
+        dev.deps_query_batch_attributed(safe, qs, builders)
+        return [sorted(set(b.build().key_deps.txn_ids)
+                       | set(b.build().range_deps.txn_ids))
+                for b in builders]
+
+    got = run()
+    dev.BUCKETED = False
+    assert got == run()
+    # floors applied: every brute-force dep below the floor is gone, every
+    # one at/above it survives
+    for q, g in zip(qs, got):
+        want = [t for t in _brute(entries, q) if t >= floor_id]
+        assert g == want

@@ -1,0 +1,32 @@
+import sys, collections
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', True)
+from accord_tpu.sim import cluster as cl
+
+hist = collections.Counter()
+orig = cl.Cluster.route_request
+def patched(self, src, dst, request, callback_id):
+    name = type(request).__name__
+    if name == "CheckStatus":
+        f = sys._getframe(1)
+        stack = []
+        for _ in range(8):
+            if f is None: break
+            stack.append(f.f_code.co_qualname)
+            f = f.f_back
+        # find the most informative caller
+        key = None
+        for s in stack:
+            if "find_route" in s or "probe" in s or "_QuorumRpc" in s or "quorum" in s:
+                continue
+        hist[tuple(stack[2:6])] += 1
+    return orig(self, src, dst, request, callback_id)
+cl.Cluster.route_request = patched
+
+from tests.test_burn import run_burn
+r = run_burn(15, n_ops=500, workload_micros=60_000_000)
+print('ok', r.ops_ok, 'failed', r.ops_failed, 'cs', r.stats.get('CheckStatus',0))
+for k, v in hist.most_common(8):
+    print(v, " <- ".join(k))

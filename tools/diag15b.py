@@ -1,0 +1,36 @@
+import sys, collections
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', True)
+from accord_tpu.coordinate import recover as rec
+
+starts = collections.Counter()
+orig_start = rec.Recover._start
+def pstart(self):
+    starts[self.txn_id] += 1
+    return orig_start(self)
+rec.Recover._start = pstart
+
+fdr = collections.Counter()
+orig_f = rec._fetch_definition_then_recover
+def pf(node, txn_id, route, result):
+    fdr[txn_id] += 1
+    return orig_f(node, txn_id, route, result)
+rec._fetch_definition_then_recover = pf
+
+mr = collections.Counter()
+orig_m = rec.maybe_recover
+def pm(node, txn_id, route, prev, txn=None):
+    mr[txn_id] += 1
+    return orig_m(node, txn_id, route, prev, txn)
+rec.maybe_recover = pm
+
+from tests.test_burn import run_burn
+r = run_burn(15, n_ops=500, workload_micros=60_000_000)
+print('ok', r.ops_ok, 'failed', r.ops_failed, 'cs', r.stats.get('CheckStatus',0))
+print("Recover._start total", sum(starts.values()), "max-per-txn", max(starts.values(), default=0))
+print("fetch_def total", sum(fdr.values()), "max", max(fdr.values(), default=0))
+print("maybe_recover total", sum(mr.values()), "max", max(mr.values(), default=0))
+for t, c in starts.most_common(3): print("  start", t, c)
+for t, c in mr.most_common(3): print("  mr", t, c)

@@ -1,0 +1,46 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from accord_tpu.ops.packing import enable_x64
+enable_x64()
+import jax, jax.numpy as jnp
+from functools import partial
+
+B, P, K, G, N, M = 2048, 32, 128, 16384, 131072, 8
+rng = np.random.default_rng(0)
+blo = jnp.asarray(rng.integers(0, 1 << 40, (G, K)))
+bhi = blo + 64
+bslot = jnp.asarray(rng.integers(0, N, (G, K)).astype(np.int32))
+qbuck = jnp.asarray(rng.integers(0, G, (B, P)).astype(np.int32))
+qlo = jnp.asarray(rng.integers(0, 1 << 40, (B, M)))
+qhi = qlo + 64
+msb = jnp.asarray(rng.integers(0, 1 << 40, N))
+status = jnp.asarray(rng.integers(0, 5, N).astype(np.int32))
+
+def t(label, fn, *a):
+    f = jax.jit(fn)
+    f(*a).block_until_ready()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); f(*a).block_until_ready(); ts.append(time.perf_counter()-t0)
+    print(f"{label:30s} {min(ts)*1e3:8.1f} ms")
+
+t("gather blo[g] [B,P,K] i64", lambda g: blo[jnp.clip(g,0)].sum(), qbuck)
+t("gather bslot [B,P,K] i32", lambda g: bslot[jnp.clip(g,0)].sum(), qbuck)
+def ovl(g):
+    elo = blo[g]; ehi = bhi[g]
+    ql = jnp.repeat(qlo, 4, axis=1)[:, :, None]
+    qh = jnp.repeat(qhi, 4, axis=1)[:, :, None]
+    return ((elo <= qh) & (ql <= ehi)).sum()
+t("overlap [B,P,K]", ovl, qbuck)
+cand = jnp.asarray(rng.integers(-1, N, (B, P*K)).astype(np.int32))
+t("gather msb[cand] [B,C]", lambda c: msb[jnp.clip(c,0)].sum(), cand)
+t("gather status[cand]+5col", lambda c: (msb[jnp.clip(c,0)] + status[jnp.clip(c,0)]).sum(), cand)
+t("sort [B,C] i32", lambda c: jnp.sort(c, axis=1).sum(), cand)
+t("topk k=64 [B,C]", lambda c: jax.lax.top_k(c, 64)[0].sum(), cand)
+t("topk k=256 [B,C]", lambda c: jax.lax.top_k(c, 256)[0].sum(), cand)
+scat_vals = jnp.asarray(rng.integers(0, N, (B, 64)).astype(np.int32))
+pos = jnp.asarray(rng.integers(0, 180224, (B, 64)))
+t("scatter B*64 -> s", lambda v, p: jnp.full(180225, -1, jnp.int32).at[p.reshape(-1)].set(v.reshape(-1), mode="drop").sum(), scat_vals, pos)
+cum = jnp.asarray(rng.integers(0, 2, (B, P*K)).astype(np.int32))
+t("cumsum axis1 [B,C]", lambda c: jnp.cumsum(c, axis=1).sum(), cum)

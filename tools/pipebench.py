@@ -1,0 +1,26 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from accord_tpu.ops.packing import enable_x64
+enable_x64()
+import jax, jax.numpy as jnp
+
+B, C = 2048, 4096
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.integers(0, 1 << 40, (B, C)))
+
+@jax.jit
+def f(x, i):
+    return jnp.sort(x + i, axis=1)[:, :64]
+
+f(x, 1).block_until_ready()
+t0 = time.perf_counter(); f(x, 2).block_until_ready()
+print(f"single call: {1e3*(time.perf_counter()-t0):.1f} ms")
+t0 = time.perf_counter()
+outs = [f(x, 3+i) for i in range(8)]
+for o in outs: o.block_until_ready()
+print(f"8 async calls: {1e3*(time.perf_counter()-t0):.1f} ms total")
+# upload+dispatch+download pipelined
+t0 = time.perf_counter()
+hostbufs = [np.asarray(o) for o in outs]
+print(f"8 downloads: {1e3*(time.perf_counter()-t0):.1f} ms")

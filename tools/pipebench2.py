@@ -1,0 +1,41 @@
+import sys, time, threading
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from accord_tpu.ops.packing import enable_x64
+enable_x64()
+import jax, jax.numpy as jnp
+
+B, C = 2048, 4096
+rng = np.random.default_rng(0)
+x32 = jnp.asarray(rng.integers(0, 1 << 30, (B, C)).astype(np.int32))
+x64 = jnp.asarray(rng.integers(0, 1 << 40, (B, C)))
+
+@jax.jit
+def s32(x, i): return jnp.sort(x + i, axis=1)[:, :64]
+@jax.jit
+def s64(x, i): return jnp.sort(x + i, axis=1)[:, :64]
+@jax.jit
+def tiny(x, i): return (x + i).sum()
+
+def t(label, fn, reps=3):
+    ts = []
+    for r in range(reps):
+        t0 = time.perf_counter(); fn(r); ts.append(time.perf_counter()-t0)
+    print(f"{label:34s} {min(ts)*1e3:8.1f} ms")
+
+np.asarray(s32(x32, 0)); np.asarray(s64(x64, 0)); np.asarray(tiny(x32, 0))
+t("s32 asarray e2e", lambda r: np.asarray(s32(x32, r+10)))
+t("s64 asarray e2e", lambda r: np.asarray(s64(x64, r+10)))
+t("tiny asarray e2e", lambda r: np.asarray(tiny(x32, r+10)))
+t("s32 block_until_ready only", lambda r: s32(x32, r+20).block_until_ready())
+def overlap(r):
+    outs = [s32(x32, 100*r+i) for i in range(4)]
+    res = [None]*4
+    ths = [threading.Thread(target=lambda i=i: res.__setitem__(i, np.asarray(outs[i]))) for i in range(4)]
+    for th in ths: th.start()
+    for th in ths: th.join()
+t("4x s32 threaded fetch", overlap)
+def serial(r):
+    for i in range(4):
+        np.asarray(s32(x32, 200*r+i))
+t("4x s32 serial fetch", serial)

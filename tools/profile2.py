@@ -1,0 +1,83 @@
+import sys, time, cProfile, pstats
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from accord_tpu.ops.packing import enable_x64
+enable_x64()
+import jax
+from bench import build_workload, make_queries, BenchStore, BenchSafe
+from accord_tpu.local.device_index import DeviceState, _pow2_at_least
+from accord_tpu.local.commands_for_key import InternalStatus, CommandsForKey
+from accord_tpu.primitives.keys import Keys, IntKey, Ranges, Range
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from accord_tpu.primitives.deps import DepsBuilder
+from accord_tpu.ops import deps_kernel as dk
+
+N, B, KEYSPACE, M = 100_000, 2048, 1_000_000, 8
+rng = np.random.default_rng(42)
+entries = build_workload(rng, N, KEYSPACE, M)
+store = BenchStore()
+floor_id = TxnId.create(1, 500_000, TxnKind.ExclusiveSyncPoint, Domain.Range, 1)
+store.redundant_before.add_redundant(
+    Ranges.of(*(Range(s, s + 50_000) for s in range(0, KEYSPACE // 2, 100_000))), floor_id)
+dev = DeviceState(store)
+safe = BenchSafe(store)
+for tid, toks, rngs in entries:
+    keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+    dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+    for t in toks:
+        cfk = store.commands_for_key.get(t)
+        if cfk is None:
+            cfk = store.commands_for_key[t] = CommandsForKey(t)
+        cfk.update(tid, InternalStatus.PREACCEPTED)
+queries = [(q[0], q[0], q[1], q[2], q[3]) for q in make_queries(1000, B, KEYSPACE, M)]
+dev.deps_query_batch_attributed(safe, queries, [DepsBuilder() for _ in queries])
+dev.deps_query_batch_attributed(safe, queries, [DepsBuilder() for _ in queries])
+print(f"wide_entries={len(dev.deps.wide_entries)} buckets={len(dev.deps.bucket_entries)} "
+      f"bucketed_q={dev.n_bucketed_queries} dispatches={dev.n_dispatches}", file=sys.stderr)
+
+def phase(label, fn, reps=3):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = fn(); ts.append(time.perf_counter() - t0)
+    print(f"{label:26s} {min(ts)*1e3:9.1f} ms", file=sys.stderr)
+    return out
+
+qnp_packed = [(sb, wit, toks, rngs, tid) for (tid, sb, wit, toks, rngs) in queries]
+q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
+qnp = phase("pack", lambda: dk.pack_query_matrix(qnp_packed, q_m))
+qcw = phase("bucket_query_cols", lambda: dev._bucket_query_cols(qnp, q_m))
+qcols, wide_q = qcw
+print(f"wide queries: {wide_q.sum()}/{len(queries)}", file=sys.stderr)
+table = dev.deps.device_table()
+btable = dev.deps.bucket_device()
+span = dev.deps.SPAN
+rows = np.nonzero(~wide_q)[0].astype(np.int64)
+b_pad = _pow2_at_least(len(rows), 1)
+rows_p = np.concatenate([rows, np.full(b_pad - len(rows), rows[-1], np.int64)])
+qb = qcols[rows_p].reshape(b_pad, q_m * span)
+qmat_np = np.concatenate([qnp[rows_p], qb], axis=1)
+c = q_m * span * dev.deps.BUCKET_K + btable.wlo.shape[0]
+s = min(dev._batch_flat, b_pad * c)
+k_b = min(dev._batch_k, c)
+print(f"C={c} s={s} b_pad={b_pad}", file=sys.stderr)
+qmat = phase("upload", lambda: jax.block_until_ready(jax.numpy.asarray(qmat_np)))
+out = phase("bucketed kernel", lambda: jax.block_until_ready(
+    dk.bucketed_flat_jit(table, btable, qmat, q_m, span, s, k_b)))
+phase("download", lambda: np.asarray(out))
+
+handle = dev.deps_query_batch_begin(queries)
+res = phase("collect(joined)", lambda: dev._batch_collect(
+    dev.deps_query_batch_begin(queries)))
+b_idx, j_idx, overlap, ids, ivs, qnp2, qs = res
+print(f"pairs: {len(j_idx)}", file=sys.stderr)
+
+def attr():
+    builders = [DepsBuilder() for _ in queries]
+    dev._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs, qnp2, qs, builders)
+    return builders
+builders = phase("attribute", attr)
+def ball():
+    return [b.build() for b in builders]
+phase("build-all", ball)
+pr = cProfile.Profile(); pr.enable(); attr(); ball(); pr.disable()
+st = pstats.Stats(pr); st.sort_stats("tottime"); st.print_stats(14)

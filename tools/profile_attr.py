@@ -1,0 +1,41 @@
+import sys, time, cProfile, pstats
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from accord_tpu.ops.packing import enable_x64
+enable_x64()
+import jax
+from bench import build_workload, make_queries, BenchStore, BenchSafe
+from accord_tpu.local.device_index import DeviceState
+from accord_tpu.local.commands_for_key import InternalStatus, CommandsForKey
+from accord_tpu.primitives.keys import Keys, IntKey, Ranges, Range
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from accord_tpu.primitives.deps import DepsBuilder
+
+N, B, KEYSPACE, M = 100_000, 2048, 1_000_000, 8
+rng = np.random.default_rng(42)
+entries = build_workload(rng, N, KEYSPACE, M)
+store = BenchStore()
+floor_id = TxnId.create(1, 500_000, TxnKind.ExclusiveSyncPoint, Domain.Range, 1)
+store.redundant_before.add_redundant(
+    Ranges.of(*(Range(s, s + 50_000) for s in range(0, KEYSPACE // 2, 100_000))), floor_id)
+dev = DeviceState(store)
+safe = BenchSafe(store)
+for tid, toks, rngs in entries:
+    keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+    dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+    for t in toks:
+        cfk = store.commands_for_key.get(t)
+        if cfk is None:
+            cfk = store.commands_for_key[t] = CommandsForKey(t)
+        cfk.update(tid, InternalStatus.PREACCEPTED)
+queries = [(q[0], q[0], q[1], q[2], q[3]) for q in make_queries(1000, B, KEYSPACE, M)]
+dev.deps_query_batch_attributed(safe, queries, [DepsBuilder() for _ in queries])
+res = dev._batch_collect(dev.deps_query_batch_begin(queries))
+b_idx, j_idx, overlap, ids, ivs, qnp2, qs = res
+def attr():
+    builders = [DepsBuilder() for _ in queries]
+    dev._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs, qnp2, qs, builders)
+attr()
+pr = cProfile.Profile()
+pr.enable(); attr(); pr.disable()
+stats = pstats.Stats(pr); stats.sort_stats("cumulative"); stats.print_stats(25)

@@ -1,0 +1,88 @@
+"""Phase breakdown of the headline deps-scan path (VERDICT r04 ask #4):
+pack / upload / kernel / download / parse / geometry / attribute, measured
+separately on the real chip so optimization targets the true bottleneck."""
+import sys, time, json
+sys.path.insert(0, "/root/repo")
+import numpy as np
+from accord_tpu.ops.packing import enable_x64
+enable_x64()
+import jax, jax.numpy as jnp
+from bench import build_workload, make_queries, BenchStore, BenchSafe
+from accord_tpu.local.device_index import DeviceState, _pow2_at_least
+from accord_tpu.local.commands_for_key import InternalStatus, CommandsForKey
+from accord_tpu.primitives.keys import Keys, IntKey, Ranges, Range
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from accord_tpu.primitives.deps import DepsBuilder
+from accord_tpu.ops import deps_kernel as dk
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+B = 2048
+KEYSPACE, M = 1_000_000, 8
+rng = np.random.default_rng(42)
+entries = build_workload(rng, N, KEYSPACE, M)
+store = BenchStore()
+floor_id = TxnId.create(1, 500_000, TxnKind.ExclusiveSyncPoint, Domain.Range, 1)
+store.redundant_before.add_redundant(
+    Ranges.of(*(Range(s, s + 50_000) for s in range(0, KEYSPACE // 2, 100_000))), floor_id)
+dev = DeviceState(store)
+safe = BenchSafe(store)
+t0 = time.time()
+for tid, toks, rngs in entries:
+    keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+    dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+    for t in toks:
+        cfk = store.commands_for_key.get(t)
+        if cfk is None:
+            cfk = store.commands_for_key[t] = CommandsForKey(t)
+        cfk.update(tid, InternalStatus.PREACCEPTED)
+print(f"build {time.time()-t0:.1f}s  capacity={dev.deps.capacity}", file=sys.stderr)
+
+queries = [(q[0], q[0], q[1], q[2], q[3]) for q in make_queries(1000, B, KEYSPACE, M)]
+# warm (learn k/s + compile)
+dev.deps_query_batch_attributed(safe, queries, [DepsBuilder() for _ in queries])
+dev.deps_query_batch_attributed(safe, queries, [DepsBuilder() for _ in queries])
+print(f"learned s={dev._batch_flat} k={dev._batch_k}", file=sys.stderr)
+
+packed = [(sb, wit, toks, rngs, tid) for (tid, sb, wit, toks, rngs) in queries]
+q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
+table = dev.deps.device_table()
+n = table.capacity
+s, k = min(dev._batch_flat, B * n), min(dev._batch_k, n)
+
+def phase(label, fn, reps=3):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); out = fn(); ts.append(time.perf_counter() - t0)
+    print(f"{label:24s} {min(ts)*1e3:9.1f} ms", file=sys.stderr)
+    return out
+
+qnp = phase("pack_query_matrix", lambda: dk.pack_query_matrix(packed, q_m))
+qmat = phase("upload(qmat)", lambda: jax.block_until_ready(jnp.asarray(qnp)))
+out_dev = phase("kernel(dispatch+wait)", lambda: jax.block_until_ready(
+    dk.calculate_deps_flat(table, qmat, q_m, s, k)))
+out = phase("download", lambda: np.asarray(out_dev))
+
+def collect_all():
+    handle = dev.deps_query_batch_begin(queries)
+    return dev._batch_collect(handle)
+res = phase("begin+collect(e2e)", collect_all)
+
+b_idx, j_idx, overlap, ids, ivs, qnp2, qs = res
+print(f"pairs after keep: {len(j_idx)}", file=sys.stderr)
+def attr():
+    builders = [DepsBuilder() for _ in queries]
+    dev._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs, qnp2, qs, builders)
+    return builders
+builders = phase("attribute", attr)
+def count(b):
+    d = b.build()
+    return sum(len(r) for r in d.key_deps._ranges_per_key) +         sum(len(r) for r in d.range_deps._per_range)
+t0 = time.perf_counter()
+n_deps = sum(count(b) for b in builders)
+print(f"build-all {1e3*(time.perf_counter()-t0):9.1f} ms", file=sys.stderr)
+print(f"deps total: {n_deps}", file=sys.stderr)
+
+def full():
+    builders = [DepsBuilder() for _ in queries]
+    dev.deps_query_batch_attributed(safe, queries, builders)
+phase("FULL batch e2e", full)
