@@ -60,6 +60,41 @@ def mark_shard_durable(safe: "SafeCommandStore", sync_id: TxnId,
     cleanup_store(safe)
 
 
+def mark_shard_stale(safe: "SafeCommandStore", stale_since, ranges: Ranges,
+                     precise: bool) -> None:
+    """The staleness escape hatch (ref: CommandStore.markShardStale
+    :539-560 + api/Agent.java:65): this replica can no longer procure the
+    history it needs for ``ranges`` — peers durably truncated it.  Mark the
+    ranges stale (reads refuse, RedundantBefore treats ids below as
+    pre-bootstrap-or-stale), tell the Agent, and re-bootstrap: the fence +
+    snapshot fetch re-covers the data, and the bootstrap watermark rising
+    to the fence clears the staleness (RedundantEntry.merge).
+
+    ``precise``: stale_since is the known executeAt bound of the lost
+    history (True) or just the txn's id when even the executeAt is gone
+    (False, the conservative bound)."""
+    store = safe.store
+    owned = store.ranges_for_epoch.all().intersecting(ranges)
+    # new staleness only: re-marking already-stale (or already
+    # re-bootstrapping — the fence watermark clears the stale flag the
+    # instant the bootstrap starts) ranges would re-trigger bootstraps on
+    # every fetch of every lost txn
+    already = store.redundant_before.stale_ranges(owned) \
+        .with_(store.bootstrapping)
+    fresh = owned.without(already)
+    if fresh.is_empty():
+        return
+    store.n_stale_marks += 1
+    store.redundant_before.add_stale(fresh, stale_since)
+    node = store.node
+    node.agent.on_stale(stale_since, fresh)
+    # the escape: re-bootstrap the stale ranges (ref: Agent.onStale's
+    # documented contract — the integrator re-bootstraps; here the store
+    # drives it directly, like the journal's restart gap fill)
+    from .bootstrap import Bootstrap
+    Bootstrap(store, fresh, max(2, node.epoch())).start()
+
+
 def on_durable_before_advance(safe: "SafeCommandStore") -> None:
     """A gossiped DurableBefore advance (SetGloballyDurable) may newly
     qualify commands for erasure."""

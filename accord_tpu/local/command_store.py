@@ -142,6 +142,7 @@ class CommandStore:
         # deferred so the snapshot's earlier appends install first
         self.bootstrapping: Ranges = Ranges.empty()
         self._bootstrap_waiters: List[Callable[[], None]] = []
+        self.n_stale_marks = 0      # diagnostics: staleness escape hatches
         self.reject_before: Optional[ReducingRangeMap] = None
         self._queue: List[Callable[[], None]] = []
         self._draining = False

@@ -616,29 +616,26 @@ def _finalize_key_batch(builders, bb, tt, dm, dl, dn, objs) -> None:
     txn_lists = {int(b): dep_objs[dbounds[i]:dbounds[i + 1]].tolist()
                  for i, b in enumerate(dep_bs[dstart].tolist())}
     # (b, token) groups over the (b, tok, dep)-ordered arrays
+    # (b, token) groups over the (b, tok, dep)-ordered rows, then one
+    # COLUMNAR KeyDeps per builder: np slices only, no per-group Python
     newg = np.ones(n, bool)
     newg[1:] = (bb[1:] != bb[:-1]) | (tt[1:] != tt[:-1])
     gstart = np.nonzero(newg)[0]
-    gb = bb[gstart].tolist()
-    gt = tt[gstart].tolist()
-    gbounds = gstart.tolist()
-    gbounds.append(n)
-    inv_l = inv.tolist()                  # ONE conversion; C-level slices
-    keys_of: Dict[int, List[int]] = {}
-    rows_of: Dict[int, List[List[int]]] = {}
-    cur_b, ks, rs = None, None, None
-    for i in range(len(gb)):
-        b = gb[i]
-        if b != cur_b:                    # groups arrive sorted by builder
-            cur_b = b
-            ks = keys_of[b] = []
-            rs = rows_of[b] = []
-        ks.append(gt[i])
-        rs.append(inv_l[gbounds[i]:gbounds[i + 1]])
-    for b, toks in keys_of.items():
-        builders[b].key.set_prebuilt(
-            KeyDeps(RoutingKeys(toks, _presorted=True), txn_lists[b],
-                    rows_of[b]))
+    g_b = bb[gstart]
+    g_t = tt[gstart]
+    gbounds = np.append(gstart, n)
+    newb_g = np.ones(len(gstart), bool)
+    newb_g[1:] = g_b[1:] != g_b[:-1]
+    bstart_g = np.nonzero(newb_g)[0]
+    bbounds_g = np.append(bstart_g, len(gstart))
+    for k_i in range(len(bstart_g)):
+        s0, s1 = bstart_g[k_i], bbounds_g[k_i + 1]
+        b = int(g_b[s0])
+        row_ptr = gbounds[s0:s1 + 1] - gbounds[s0]
+        dep_idx = inv[gbounds[s0]:gbounds[s1]]
+        builders[b].key.set_prebuilt(KeyDeps.from_columns(
+            RoutingKeys(g_t[s0:s1].tolist(), _presorted=True),
+            txn_lists[b], row_ptr, dep_idx))
 
 
 def _finalize_range_batch(builders, bb, lo, hi, dm, dl, dn, objs) -> None:
@@ -670,27 +667,20 @@ def _finalize_range_batch(builders, bb, lo, hi, dm, dl, dn, objs) -> None:
     newg[1:] = ((bb[1:] != bb[:-1]) | (lo[1:] != lo[:-1])
                 | (hi[1:] != hi[:-1]))
     gstart = np.nonzero(newg)[0]
-    gb = bb[gstart].tolist()
-    glo = lo[gstart].tolist()
-    ghi = hi[gstart].tolist()
-    gbounds = gstart.tolist()
-    gbounds.append(n)
-    inv_l = inv.tolist()
-    rngs_of: Dict[int, List[Range]] = {}
-    rows_of: Dict[int, List[List[int]]] = {}
-    cur_b, rs, rw = None, None, None
-    mk = Range
-    for i in range(len(gb)):
-        b = gb[i]
-        if b != cur_b:
-            cur_b = b
-            rs = rngs_of[b] = []
-            rw = rows_of[b] = []
-        rs.append(mk(glo[i], ghi[i]))
-        rw.append(inv_l[gbounds[i]:gbounds[i + 1]])
-    for b, rngs in rngs_of.items():
-        builders[b].range.set_prebuilt(
-            RangeDeps(rngs, txn_lists[b], rows_of[b]))
+    g_b = bb[gstart]
+    gbounds = np.append(gstart, n)
+    newb_g = np.ones(len(gstart), bool)
+    newb_g[1:] = g_b[1:] != g_b[:-1]
+    bstart_g = np.nonzero(newb_g)[0]
+    bbounds_g = np.append(bstart_g, len(gstart))
+    for k_i in range(len(bstart_g)):
+        s0, s1 = bstart_g[k_i], bbounds_g[k_i + 1]
+        b = int(g_b[s0])
+        row_ptr = gbounds[s0:s1 + 1] - gbounds[s0]
+        dep_idx = inv[gbounds[s0]:gbounds[s1]]
+        builders[b].range.set_prebuilt(RangeDeps.from_columns(
+            lo[gstart[s0:s1]], hi[gstart[s0:s1]], txn_lists[b],
+            row_ptr, dep_idx))
 
 
 def _changed(cols, order) -> np.ndarray:

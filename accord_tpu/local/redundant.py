@@ -65,10 +65,16 @@ class RedundantEntry:
         stale = self.stale_until_at_least
         if other.stale_until_at_least is not None:
             stale = max_timestamp(stale, other.stale_until_at_least)
+        boot = max(self.bootstrapped_at, other.bootstrapped_at)
+        # a bootstrap fence at/above the stale bound re-covers the data:
+        # staleness clears once the re-bootstrap begins (reads still defer
+        # behind the bootstrap gate until the snapshot lands — ref:
+        # CommandStore.java markShardStale + safeToRead)
+        if stale is not None and boot >= stale:
+            stale = None
         return RedundantEntry(
             max(self.redundant_before, other.redundant_before),
-            max(self.bootstrapped_at, other.bootstrapped_at),
-            stale)
+            boot, stale)
 
     def status_of(self, txn_id: TxnId) -> RedundantStatus:
         if self.stale_until_at_least is not None or txn_id < self.bootstrapped_at:
@@ -160,6 +166,40 @@ class RedundantBefore:
         if e is None:
             return TxnId.NONE
         return max(e.redundant_before, e.bootstrapped_at)
+
+    def _segment_ranges(self, pred, within: Ranges) -> Ranges:
+        """Subranges of ``within`` whose map segment satisfies ``pred``
+        (entry may be None for never-touched segments)."""
+        b = self._map.boundaries
+        vals = self._map.values
+        out = []
+        lo_bound = -(1 << 62)
+        hi_bound = 1 << 62
+        for i, v in enumerate(vals):
+            if not pred(v):
+                continue
+            seg_lo = b[i - 1] if i > 0 else lo_bound
+            seg_hi = b[i] if i < len(b) else hi_bound
+            out.append(Range(seg_lo, seg_hi))
+        if not out:
+            return Ranges.empty()
+        return Ranges.of(*out).intersecting(within)
+
+    def stale_ranges(self, within: Ranges) -> Ranges:
+        """Subranges of ``within`` currently marked stale (reads refuse,
+        execution skips) — ref: CommandStore.java safeToRead complement."""
+        return self._segment_ranges(
+            lambda v: v is not None and v.stale_until_at_least is not None,
+            within)
+
+    def live_expect_ranges(self, txn_id: TxnId, within: Ranges) -> Ranges:
+        """Subranges of ``within`` where ``txn_id`` is still LIVE — owned,
+        not pre-bootstrap, not stale, not shard-redundant: the ranges this
+        replica still expects to execute the txn over (ref:
+        RedundantBefore.everExpectToExecute / expectToExecute)."""
+        return self._segment_ranges(
+            lambda v: v is None
+            or v.status_of(txn_id) is RedundantStatus.LIVE, within)
 
     def min_floor_over(self, lo: int, hi: int) -> TxnId:
         """Conservative batch-global deps floor: the MIN deps_floor over

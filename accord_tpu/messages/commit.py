@@ -21,7 +21,8 @@ from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
 from ..utils import async_chain
 from .base import MessageType, Reply, TxnRequest
-from .read_data import ReadNack, ReadOk, ReadRedundant, merge_datas, read_on_store
+from .read_data import (ReadNack, ReadOk, ReadRedundant, ReadStale,
+                        merge_datas, read_on_store)
 
 
 class CommitKind(enum.Enum):
@@ -133,6 +134,8 @@ class Commit(TxnRequest):
                 lambda data, fail:
                 node.reply(from_id, reply_context,
                            ReadNack("Redundant" if isinstance(fail, ReadRedundant)
+                                    else "Unavailable"
+                                    if isinstance(fail, ReadStale)
                                     else "Failed") if fail is not None
                            else ReadOk(data)))
 

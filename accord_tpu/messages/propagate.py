@@ -92,9 +92,52 @@ class Propagate(Request):
                 commands.set_durability(safe, txn_id, ok.durability)
                 commands.set_truncated_apply(safe, txn_id)
 
+            def _maybe_mark_stale() -> bool:
+                """The staleness escape hatch (ref: Propagate.java:395-469):
+                peers durably truncated this txn over a PROVEN covering that
+                does NOT include our slice, we still expect to execute it
+                (live ranges, not pre-bootstrap/redundant/stale), and the
+                merged knowledge cannot reach PreApplied here — this
+                replica has been left unrecoverably behind for those
+                ranges.  Mark them stale (reads refuse, Agent notified,
+                re-bootstrap begins) and truncate the local copy so the
+                drain and progress log release it."""
+                from ..local.status import Durability
+                from ..local import cleanup
+                if status is not Status.Truncated \
+                        or ok.durability < Durability.Majority \
+                        or ok.truncated_covering is None:
+                    return False
+                cmd = safe.if_present(txn_id)
+                if cmd is None or cmd.is_truncated() \
+                        or cmd.has_been(Status.PreApplied) \
+                        or not txn_id.is_write():
+                    return False
+                from ..local.redundant import participant_slice
+                my_slice = participant_slice(
+                    safe.store.ranges_for_epoch.all(), cmd.participants())
+                # the cluster-truncated portion of OUR slice that we still
+                # expect to execute: knowledge for it is gone for good
+                gone = my_slice.intersecting(ok.truncated_covering)
+                live = safe.store.redundant_before.live_expect_ranges(
+                    txn_id, gone)
+                if live.is_empty():
+                    return False
+                if ok.execute_at is not None:
+                    cleanup.mark_shard_stale(safe, ok.execute_at, live,
+                                             precise=True)
+                else:
+                    # even the executeAt is erased: the conservative bound
+                    cleanup.mark_shard_stale(safe, txn_id, live,
+                                             precise=False)
+                commands.set_truncated_apply(safe, txn_id)
+                return True
+
             if ok.route is None or ok.partial_txn is None:
                 if _purge_eligible():
                     do_purge()
+                elif _maybe_mark_stale():
+                    pass
                 return
             # Sync points extend one epoch below: a dropped donor fetching a
             # bootstrap fence's outcome must be able to apply it over its
@@ -136,6 +179,8 @@ class Propagate(Request):
             # the pointless upgrade
             if _purge_eligible():
                 do_purge()
+                return
+            if _maybe_mark_stale():
                 return
             if status >= Status.Committed and ok.execute_at is not None \
                     and ok.partial_deps is not None \

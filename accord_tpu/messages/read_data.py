@@ -99,6 +99,12 @@ def read_on_store(safe: SafeCommandStore, txn_id: TxnId
     return out
 
 
+class ReadStale(RuntimeError):
+    """The store's data for a requested range is stale (the staleness
+    escape hatch fired; a re-bootstrap is in flight) — the read must go to
+    another replica (ref: CommandStore.safeToReadAt / markUnsafeToRead)."""
+
+
 def _begin_read(safe: SafeCommandStore, cmd,
                 out: async_chain.AsyncResult) -> None:
     node = safe.store.node
@@ -107,6 +113,12 @@ def _begin_read(safe: SafeCommandStore, cmd,
         out.set_success(None)
         return
     owned = safe.ranges(cmd.execute_at.epoch())
+    stale = safe.store.redundant_before.stale_ranges(owned)
+    if not stale.is_empty() and any(
+            stale.contains_token(k.token())
+            for k in partial_txn.read.keys().slice(owned)):
+        out.set_failure(ReadStale(f"stale ranges {stale} for {cmd.txn_id}"))
+        return
     keys = partial_txn.read.keys().slice(owned)
     chains = []
     for key in keys:
@@ -146,6 +158,8 @@ class ReadTxnData(TxnRequest):
                 lambda data, fail:
                 node.reply(from_id, reply_context,
                            ReadNack("Redundant" if isinstance(fail, ReadRedundant)
+                                    else "Unavailable"
+                                    if isinstance(fail, ReadStale)
                                     else "Failed") if fail is not None
                            else ReadOk(data)))
 

@@ -46,14 +46,46 @@ class KeyDeps:
     """token -> sorted unique [TxnId], CSR encoded
     (ref: accord/primitives/KeyDeps.java:150-170)."""
 
-    __slots__ = ("keys", "txn_ids", "_ranges_per_key")
+    __slots__ = ("keys", "txn_ids", "_rows", "_cols")
 
     def __init__(self, keys: RoutingKeys, txn_ids: List[TxnId],
                  per_key: List[List[int]]):
         # per_key[i] = sorted indices into txn_ids for keys[i]
         self.keys = keys
         self.txn_ids = txn_ids          # sorted unique
-        self._ranges_per_key = per_key  # CSR rows (index lists)
+        self._rows = per_key            # CSR rows (index lists)
+        self._cols = None
+
+    @classmethod
+    def from_columns(cls, keys: RoutingKeys, txn_ids: List[TxnId],
+                     row_ptr, dep_idx) -> "KeyDeps":
+        """Columnar CSR constructor (the device batch path): ``row_ptr``
+        int[K+1] offsets into ``dep_idx`` (indices into txn_ids) — exactly
+        the reference's primitive-array keysToTxnIds layout
+        (KeyDeps.java:150-170).  The Python list-of-lists rows materialize
+        lazily for host consumers; the columns ARE the wire-complete
+        relation set."""
+        out = cls.__new__(cls)
+        out.keys = keys
+        out.txn_ids = txn_ids
+        out._rows = None
+        out._cols = (row_ptr, dep_idx)
+        return out
+
+    @property
+    def _ranges_per_key(self) -> List[List[int]]:
+        if self._rows is None:
+            row_ptr, dep_idx = self._cols
+            dep_l = dep_idx.tolist()
+            rp = row_ptr.tolist()
+            self._rows = [dep_l[rp[i]:rp[i + 1]] for i in range(len(rp) - 1)]
+        return self._rows
+
+    def relation_count(self) -> int:
+        """Total (key, dep) relations — O(1) on columnar deps."""
+        if self._cols is not None:
+            return len(self._cols[1])
+        return sum(len(r) for r in self._ranges_per_key)
 
     # -- construction -------------------------------------------------------
     @classmethod
@@ -266,13 +298,50 @@ class RangeDeps:
     accord_tpu.ops.interval (CINTIA-style checkpointed interval index,
     ref: utils/CheckpointIntervalArray.java)."""
 
-    __slots__ = ("ranges", "txn_ids", "_per_range")
+    __slots__ = ("txn_ids", "_rngs", "_rows", "_cols")
 
     def __init__(self, ranges: List[Range], txn_ids: List[TxnId],
                  per_range: List[List[int]]):
-        self.ranges = ranges        # sorted by (start, end); may overlap
+        self._rngs = ranges         # sorted by (start, end); may overlap
         self.txn_ids = txn_ids      # sorted unique
-        self._per_range = per_range
+        self._rows = per_range
+        self._cols = None
+
+    @classmethod
+    def from_columns(cls, lo, hi, txn_ids: List[TxnId], row_ptr,
+                     dep_idx) -> "RangeDeps":
+        """Columnar CSR constructor (the device batch path): ranges as
+        int64 bound arrays + offsets/indices — the reference's primitive
+        long[]/int[] RangeDeps layout (RangeDeps.java:75-84).  Range
+        objects and Python rows materialize lazily for host consumers."""
+        out = cls.__new__(cls)
+        out.txn_ids = txn_ids
+        out._rngs = None
+        out._rows = None
+        out._cols = (lo, hi, row_ptr, dep_idx)
+        return out
+
+    @property
+    def ranges(self) -> List[Range]:
+        if self._rngs is None:
+            lo, hi, _rp, _di = self._cols
+            self._rngs = [Range(a, b) for a, b in zip(lo.tolist(),
+                                                      hi.tolist())]
+        return self._rngs
+
+    @property
+    def _per_range(self) -> List[List[int]]:
+        if self._rows is None:
+            _lo, _hi, row_ptr, dep_idx = self._cols
+            dep_l = dep_idx.tolist()
+            rp = row_ptr.tolist()
+            self._rows = [dep_l[rp[i]:rp[i + 1]] for i in range(len(rp) - 1)]
+        return self._rows
+
+    def relation_count(self) -> int:
+        if self._cols is not None:
+            return len(self._cols[3])
+        return sum(len(r) for r in self._per_range)
 
     @classmethod
     def none(cls) -> "RangeDeps":

@@ -359,7 +359,20 @@ class Cluster:
                               src, dst, repr(request))
         if action in (Action.DELIVER_WITH_FAILURE, Action.FAILURE) \
                 and callback_id:
-            self.queue.add(self._deliver_at(src, dst), lambda: (
+            # FAILURE is the fast-failure report (told so promptly, ref
+            # Cluster's Action.FAILURE): fire the callback after a tiny
+            # constant delay — far below link latency, so it exercises the
+            # fast-failure timing race a 1-RTT loss cannot, while staying
+            # asynchronous (an instant callback would re-enter the
+            # coordinator from inside its own send loop).
+            # DELIVER_WITH_FAILURE keeps the delivery-latency failure (the
+            # "delivered but reported failed" race).  The latency draw is
+            # taken either way so the FAILURE leg perturbs neither the
+            # random stream nor the link's in-order watermark.
+            linked_at = self._deliver_at(src, dst)
+            fail_at = self.queue.now + 10 if action is Action.FAILURE \
+                else linked_at
+            self.queue.add(fail_at, lambda: (
                 self.sinks[src].fail_callback(callback_id, dst)))
         if filtered:
             return

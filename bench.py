@@ -241,7 +241,7 @@ def bench_hot_keys():
     for batch in batches:
         builders = [DepsBuilder() for _ in batch]
         dev.deps_query_batch_attributed(safe, batch, builders)
-        n_deps += sum(sum(len(s) for s in b.key._map.values())
+        n_deps += sum(b.build().key_deps.relation_count()
                       for b in builders)
     deps_rate = B3 * 4 / (_t.time() - t0)
 
@@ -402,9 +402,10 @@ def main():
     phases = {"begin": 0.0, "collect": 0.0, "build": 0.0}
 
     def count_built(built):
-        return sum(sum(len(r) for r in d.key_deps._ranges_per_key)
-                   + sum(len(r) for r in d.range_deps._per_range)
-                   for d in built)
+        # built deps are columnar CSR (the reference's primitive-array
+        # KeyDeps/RangeDeps layout) — relation_count reads the columns
+        return sum(d.key_deps.relation_count()
+                   + d.range_deps.relation_count() for d in built)
 
     for rep in range(REPS):
         t0 = time.time()
