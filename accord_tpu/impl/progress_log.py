@@ -337,9 +337,26 @@ class SimpleProgressLog(api.ProgressLog):
             self.home[txn_id] = _HomeEntry(txn_id, cmd.route)
         self._arm()
 
-    def _refresh(self, txn_id: TxnId) -> None:
+    def _refresh(self, safe, txn_id: TxnId) -> None:
+        """Reset the investigation backoff ONLY on organic progress — the
+        status PHASE or durability advancing.  Ballot movement alone is the
+        signature of recovery attempts (ours or a peer's): AcceptInvalidate
+        and BeginRecovery rounds fire the accepted/stable hooks on every
+        futile pass, and resetting backoff on them locks wedged home
+        entries into an investigate -> ballot-bump -> reset spin that
+        floods the cluster with CheckStatus quorums (the seed-15 storm:
+        ~380 investigations per txn per minute)."""
         entry = self.home.get(txn_id)
-        if entry is not None and entry.progress is not _Progress.Investigating:
+        if entry is None or entry.progress is _Progress.Investigating:
+            return
+        cmd = safe.if_present(txn_id)
+        if cmd is None:
+            return
+        if (int(cmd.durability), int(cmd.save_status.status.phase)) > \
+                (entry.token.durability, entry.token.status_phase):
+            entry.token = entry.token.merge(ProgressToken(
+                int(cmd.durability), int(cmd.save_status.status.phase),
+                cmd.promised, entry.token.accepted))
             entry.observed_progress()
 
     # -- ProgressLog hooks ---------------------------------------------------
@@ -351,28 +368,28 @@ class SimpleProgressLog(api.ProgressLog):
 
     def accepted(self, safe, txn_id: TxnId) -> None:
         self._track_home(safe, txn_id)
-        self._refresh(txn_id)
+        self._refresh(safe, txn_id)
 
     def precommitted(self, safe, txn_id: TxnId) -> None:
-        self._refresh(txn_id)
+        self._refresh(safe, txn_id)
 
     def stable(self, safe, txn_id: TxnId) -> None:
         self._track_home(safe, txn_id)
-        self._refresh(txn_id)
+        self._refresh(safe, txn_id)
         # do NOT pop blocked here: a dep that reached Stable locally can
         # still wedge dependents if its Apply was lost — keep fetching its
         # outcome until it actually applies (durable_local) or is cleared
         # (ref: BlockingState waits for HasOutcome, not just committed)
 
     def ready_to_execute(self, safe, txn_id: TxnId) -> None:
-        self._refresh(txn_id)
+        self._refresh(safe, txn_id)
 
     def executed(self, safe, txn_id: TxnId) -> None:
-        self._refresh(txn_id)
+        self._refresh(safe, txn_id)
 
     def durable_local(self, safe, txn_id: TxnId) -> None:
         # applied locally; remains tracked until durable at a quorum
-        self._refresh(txn_id)
+        self._refresh(safe, txn_id)
         self.blocked.pop(txn_id, None)
 
     def durable(self, safe, txn_id: TxnId) -> None:

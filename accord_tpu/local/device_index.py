@@ -107,6 +107,12 @@ class _DepsMirror:
         self.status = np.full(capacity, dk.SLOT_FREE, np.int32)
         self.lo = np.full((capacity, max_intervals), dk.PAD_LO, np.int64)
         self.hi = np.full((capacity, max_intervals), dk.PAD_HI, np.int64)
+        # decided executeAt per slot (host-only; drives the VECTORIZED
+        # transitive-elision check in attribution)
+        self.emsb = np.zeros(capacity, np.int64)
+        self.elsb = np.zeros(capacity, np.int64)
+        self.enode = np.zeros(capacity, np.int32)
+        self.eknown = np.zeros(capacity, bool)
         self.slot_of: Dict[TxnId, int] = {}
         self.id_of: Dict[int, TxnId] = {}
         # parallel object column: obj[slot] is the TxnId living in the slot
@@ -283,6 +289,7 @@ class _DepsMirror:
         self.slot_of[txn_id] = slot
         self.id_of[slot] = txn_id
         self.obj[slot] = txn_id
+        self.eknown[slot] = False
         self.msb[slot] = to_i64(txn_id.msb)
         self.lsb[slot] = to_i64(txn_id.lsb)
         self.node[slot] = txn_id.node
@@ -300,6 +307,7 @@ class _DepsMirror:
             return
         self.id_of.pop(slot, None)
         self.obj[slot] = None
+        self.eknown[slot] = False
         self._bucket_remove(slot)
         self.status[slot] = dk.SLOT_FREE
         self.lo[slot] = dk.PAD_LO
@@ -319,6 +327,10 @@ class _DepsMirror:
         self.lo = _grow(self.lo, new, dk.PAD_LO)
         self.hi = _grow(self.hi, new, dk.PAD_HI)
         self.obj = _grow(self.obj, new, None)
+        self.emsb = _grow(self.emsb, new, 0)
+        self.elsb = _grow(self.elsb, new, 0)
+        self.enode = _grow(self.enode, new, 0)
+        self.eknown = _grow(self.eknown, new, False)
         self.free_slots.extend(range(new - 1, old - 1, -1))
         self.capacity = new
         self._device = None  # shape changed: full re-upload
@@ -733,6 +745,8 @@ class DeviceState:
         self.n_bucketed_queries = 0
         self.n_dispatches = 0       # kernel dispatches: n_queries /
         #                             n_dispatches = mean lived batch size
+        # store-level coalescing queue (enqueue_query/_flush_queries)
+        self._q_pending: List[tuple] = []
 
     # ------------------------------------------------------------------
     # registration hooks (called from local.commands transitions)
@@ -763,6 +777,11 @@ class DeviceState:
         else:
             new = max(cur, status)
         self.deps.set_status(slot, new)
+        if execute_at is not None:
+            self.deps.emsb[slot] = to_i64(execute_at.msb)
+            self.deps.elsb[slot] = to_i64(execute_at.lsb)
+            self.deps.enode[slot] = execute_at.node
+            self.deps.eknown[slot] = True
         if new == dk.SLOT_INVALIDATED and cur != dk.SLOT_INVALIDATED:
             # de-index: the bucket path excludes invalidated entries
             # structurally (the dense path excludes them by status)
@@ -875,7 +894,8 @@ class DeviceState:
         # vectorized dedupe/CSR, set_prebuilt per builder) — per-emit
         # Python runs only for the rare keys with elidable state
         kp, km = p_i[key_dep], m_i[key_dep]
-        msb_a, lsb_a, node_a, obj_a = ids
+        (msb_a, lsb_a, node_a, obj_a, status_a, xm_a, xl_a, xn_a,
+         xk_a) = ids
         if len(kp):
             tt = lo_p[kp, km]                 # key-domain footprint = point
             jj, bb = j_idx[kp], b_idx[kp]
@@ -892,9 +912,14 @@ class DeviceState:
             dmsb_k, dlsb_k, dnode_k = dmsb[keep], dlsb[keep], dnode[keep]
             # object resolution: pure take from the snapshot object column
             deps_k = obj_a[jj_k]
-            # tokens with ANYTHING elidable get the per-emit check; the
-            # common key goes through the batch finalize with no per-emit
-            # Python at all
+            # VECTORIZED transitive elision (the per-key skip rule,
+            # CommandsForKey.is_elided): transitively-known deps never
+            # emit; decided deps executing below the key's latest
+            # committed-write pivot (for this query's bound) are reached
+            # through that write's stable deps.  The pivot is looked up
+            # once per unique (token, query) on keys with anything
+            # elidable; the per-emit judgement is pure array compares over
+            # the mirror's status/executeAt snapshot — no per-emit Python
             uniq_t2, inv_t2 = np.unique(tt_k, return_inverse=True)
             tok_maybe = np.zeros(len(uniq_t2), bool)
             cfk_map = self.store.commands_for_key
@@ -902,23 +927,37 @@ class DeviceState:
                 cfk = cfk_map.get(t)
                 if cfk is not None and cfk.may_elide_any():
                     tok_maybe[i] = True
+            status_k = status_a[jj_k]
+            elide = status_k == dk.SLOT_TRANSITIVE
             flagged = tok_maybe[inv_t2]
-            plain = ~flagged
-            if plain.any():
-                _finalize_key_batch(builders, bb_k[plain], tt_k[plain],
-                                    dmsb_k[plain], dlsb_k[plain],
-                                    dnode_k[plain], deps_k[plain])
-            for idx in np.nonzero(flagged)[0].tolist():
-                b = int(bb_k[idx])
-                t = int(tt_k[idx])
-                dep_id = deps_k[idx]
-                ctx = elide_ctx(t, queries[b][1])
-                if ctx is not None:
-                    info = ctx[0].get(dep_id)
-                    if info is not None and \
-                            ctx[0].is_elided(info, queries[b][1], ctx[1]):
-                        continue
-                builders[b].add_key(t, dep_id)
+            if flagged.any():
+                f_idx = np.nonzero(flagged)[0]
+                bt = np.stack([bb_k[f_idx], tt_k[f_idx]], axis=1)
+                ubt, inv_bt = np.unique(bt, axis=0, return_inverse=True)
+                pv = np.zeros((len(ubt), 3), np.int64)
+                pv_ok = np.zeros(len(ubt), bool)
+                for i, (b, t) in enumerate(ubt.tolist()):
+                    ctx = elide_ctx(int(t), queries[b][1])
+                    if ctx is not None and ctx[1] is not Timestamp.NONE \
+                            and ctx[1] is not None:
+                        pv[i] = (to_i64(ctx[1].msb), to_i64(ctx[1].lsb),
+                                 ctx[1].node)
+                        pv_ok[i] = True
+                pm, pl, pn = (pv[inv_bt, 0], pv[inv_bt, 1], pv[inv_bt, 2])
+                jf = jj_k[f_idx]
+                sf = status_k[f_idx]
+                xm, xl, xn = xm_a[jf], xl_a[jf], xn_a[jf]
+                below = ((xm < pm) | ((xm == pm)
+                                      & ((xl < pl)
+                                         | ((xl == pl) & (xn < pn)))))
+                decided = ((sf >= dk.SLOT_COMMITTED)
+                           & (sf <= dk.SLOT_APPLIED) & xk_a[jf])
+                elide[f_idx] |= pv_ok[inv_bt] & decided & below
+            keep2 = ~elide
+            if keep2.any():
+                _finalize_key_batch(builders, bb_k[keep2], tt_k[keep2],
+                                    dmsb_k[keep2], dlsb_k[keep2],
+                                    dnode_k[keep2], deps_k[keep2])
 
         # range-domain deps: emit the dep∩query interval clip per pair —
         # batch-finalized (dedupe/sort/CSR in one vectorized pass; Range
@@ -954,6 +993,45 @@ class DeviceState:
             if len(rp):
                 _finalize_range_batch(builders, b_idx[rp], ilo, ihi,
                                       dmsb_r, dlsb_r, dnode_r, obj_a[jj_r])
+
+    # ------------------------------------------------------------------
+    # store-level coalescing (the lived batched path): queries arriving
+    # within one scheduler quantum fold into ONE kernel dispatch
+    # ------------------------------------------------------------------
+    def enqueue_query(self, query, builder, done) -> None:
+        """Queue one deps query for the next flush; ``done(failure, safe)``
+        fires after the builder is filled (``safe`` is the flush task's
+        exclusive store handle, live only within the callback).  All queries enqueued before the flush
+        task runs (i.e. during the same scheduler quantum — message bursts
+        land as same-timestamp tasks) share one kernel dispatch, so the
+        benched batched shape IS the lived shape (mean batch size =
+        n_queries / n_dispatches)."""
+        self._q_pending.append((query, builder, done))
+        if len(self._q_pending) == 1:
+            from .command_store import PreLoadContext
+            node = self.store.node
+            # one scheduler hop (zero sim-time) so every same-instant
+            # message's store task enqueues BEFORE the flush runs
+            node.scheduler.now(lambda: self.store.execute(
+                PreLoadContext.empty(), self._flush_queries))
+
+    def _flush_queries(self, safe) -> None:
+        batch = self._q_pending
+        self._q_pending = []
+        if not batch:
+            return
+        try:
+            handle = self.deps_query_batch_begin(
+                [q for q, _b, _d in batch], immediate=True,
+                prune_floors=True)
+            self.deps_query_batch_end_attributed(
+                safe, handle, [b for _q, b, _d in batch])
+        except BaseException as e:  # noqa: BLE001
+            for _q, _b, d in batch:
+                d(e, None)
+            return
+        for _q, _b, d in batch:
+            d(None, safe)
 
     def deps_query_batch(self, queries):
         """Batched deps scan: ONE kernel call for B concurrent queries (the
@@ -1123,7 +1201,8 @@ class DeviceState:
             # copies and the prefetch thread — the live mirror IS the
             # snapshot
             ids = (self.deps.msb, self.deps.lsb, self.deps.node,
-                   self.deps.obj)
+                   self.deps.obj, self.deps.status, self.deps.emsb,
+                   self.deps.elsb, self.deps.enode, self.deps.eknown)
             ivs = (self.deps.lo, self.deps.hi, self.deps.domain)
         else:
             # snapshot the mirror's id + interval columns: the mirror
@@ -1131,7 +1210,10 @@ class DeviceState:
             # and end would otherwise resolve this batch's indices to the
             # WRONG TxnId (or footprint)
             ids = (self.deps.msb.copy(), self.deps.lsb.copy(),
-                   self.deps.node.copy(), self.deps.obj.copy())
+                   self.deps.node.copy(), self.deps.obj.copy(),
+                   self.deps.status.copy(), self.deps.emsb.copy(),
+                   self.deps.elsb.copy(), self.deps.enode.copy(),
+                   self.deps.eknown.copy())
             ivs = (self.deps.lo.copy(), self.deps.hi.copy(),
                    self.deps.domain.copy())
         return (parts, ids, ivs, qnp, q_m, list(queries))
@@ -1204,14 +1286,18 @@ class DeviceState:
             s = min(-(-int(total * 1.25) // 16384) * 16384, nq * shard_n)
             self._batch_flat = max(self._batch_flat, s)
             q_m = part["q_m"]
+            # escalate k with 2x headroom: every distinct k is a fresh jit
+            # compilation, and a mid-run recompile costs seconds on TPU
             if part["kind"] == "sharded":
-                k = min(_pow2_at_least(int(blocks[:, 1].max())), shard_n)
+                k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
+                        shard_n)
                 self._batch_k = max(self._batch_k, k)
                 from ..parallel.sharded import sharded_calculate_deps_flat
                 out = np.asarray(sharded_calculate_deps_flat(
                     self.mesh, q_m, s, k)(part["table"], part["qmat"]))
             elif part["kind"] == "dense":
-                k = min(_pow2_at_least(int(blocks[:, 1].max())), shard_n)
+                k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
+                        shard_n)
                 self._batch_k = max(self._batch_k, k)
                 pr = part["prune"]
                 if pr is not None:
@@ -1221,7 +1307,7 @@ class DeviceState:
                     out = np.asarray(dk.calculate_deps_flat(
                         part["table"], part["qmat"], q_m, s, k))
             else:
-                k = min(_pow2_at_least(int(blocks[:, 1].max())),
+                k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
                         part["c"])
                 self._batch_k = max(self._batch_k, k)
                 pr = part["prune"]
@@ -1283,7 +1369,7 @@ class DeviceState:
         counts = np.bincount(b_idx, minlength=len(queries))
         row_ptr = np.zeros(len(queries) + 1, np.int64)
         np.cumsum(counts, out=row_ptr[1:])
-        msb, lsb, node, _obj = ids
+        msb, lsb, node = ids[0], ids[1], ids[2]
         return (row_ptr, msb[j_idx], lsb[j_idx], node[j_idx])
 
     def deps_query_batch_end_attributed(self, safe, handle, builders) -> None:

@@ -138,10 +138,21 @@ def main() -> None:
     # timer heap perpetually non-empty, so heap emptiness can't be the
     # condition), bounded by a grace window.
     grace_until = now_micros() + 2_000_000
-    while now_micros() < grace_until and proc.node is not None \
-            and proc.node._coordinating:
+    hard_stop = now_micros() + 30_000_000
+    while now_micros() < min(grace_until, hard_stop):
         scheduler.run_due()
+        busy = proc.node is not None and proc.node._coordinating
         deadline = scheduler.next_deadline()
+        if busy:
+            # live coordinations keep the grace window open (first-compile
+            # of the device kernels can dominate the first txn); the hard
+            # stop bounds a wedged coordination
+            grace_until = now_micros() + 2_000_000
+        else:
+            # coordinations may not have STARTED yet (handle() defers via
+            # scheduler.now()): only stop once nothing is due imminently
+            if deadline is None or deadline > now_micros() + 10_000:
+                break
         if deadline is None:
             break
         time.sleep(min(max(deadline - now_micros(), 0) / 1e6, 0.05))

@@ -32,7 +32,10 @@ from ..topology.topology import Topology
 from ..utils.random_source import RandomSource
 
 TOKEN_SPACE = 1 << 32
-REQUEST_TIMEOUT_MICROS = 1_000_000   # ref: Main.java 1s sweeper
+# ref: Main.java uses a 1s sweeper; a cold JAX node stalls for seconds per
+# first-compile of each kernel shape, so the wall-clock bound here is wider
+# (the sim cluster keeps its own simulated-time timeouts)
+REQUEST_TIMEOUT_MICROS = 20_000_000
 SWEEP_INTERVAL_MICROS = 200_000
 
 
@@ -280,6 +283,21 @@ class MaelstromProcess:
                 self.node, shard_cycle_micros=5_000_000,
                 global_cycle_micros=15_000_000)
             self.durability.start()
+        # warm-compile the device deps kernel BEFORE acking init: Maelstrom
+        # sends no work until init_ok, and a cold first compile (seconds)
+        # would otherwise race the 1s callback sweeper into spurious
+        # client-visible timeouts on the first txns
+        from ..primitives.timestamp import Domain, TxnKind
+        for store in self.node.command_stores.stores:
+            dev = getattr(store, "device", None)
+            if dev is None:
+                continue
+            tid = self.node.next_txn_id(TxnKind.Write, Domain.Key)
+            try:
+                dev.deps_query_batch(
+                    [(tid, tid, tid.kind().witnesses(), [0], [])])
+            except Exception:
+                pass   # warmup must never block startup
         self._reply_client(src, body["msg_id"], {"type": "init_ok"})
 
     # -- the list-append "txn" workload --------------------------------------

@@ -16,7 +16,7 @@ from ..primitives.deps import Deps, DepsBuilder, PartialDeps
 from ..primitives.keys import Range, Ranges, Route
 from ..primitives.timestamp import Timestamp, TxnId
 from ..primitives.txn import Txn
-from ..utils import invariants
+from ..utils import async_chain, invariants
 from .base import MessageType, Reply, TxnRequest
 
 
@@ -51,6 +51,43 @@ def calculate_partial_deps(safe: SafeCommandStore, txn_id: TxnId, keys,
 
     add_boundary_deps(safe, txn_id, keys, started_before, builder)
     return builder.build_partial(covering)
+
+
+def calculate_partial_deps_async(safe: SafeCommandStore, txn_id: TxnId,
+                                 keys, started_before: Timestamp,
+                                 covering: Ranges, done) -> None:
+    """The COALESCED deps scan: enqueue into the store's device query
+    queue and fire ``done(partial_deps, failure)`` after the shared flush
+    (all PreAccepts landing in the same scheduler quantum ride one kernel
+    dispatch).  Falls back to the synchronous path off-device."""
+    dev = safe.store.device
+    if dev is None:
+        try:
+            done(calculate_partial_deps(safe, txn_id, keys, started_before,
+                                        covering), None)
+        except BaseException as e:  # noqa: BLE001
+            done(None, e)
+        return
+    builder = DepsBuilder()
+    witnesses = txn_id.kind().witnesses()
+    query = dev.build_query(safe, txn_id, keys, started_before, witnesses)
+    store = safe.store
+
+    def finish(failure, flush_safe) -> None:
+        if failure is not None:
+            done(None, failure)
+            return
+        try:
+            add_boundary_deps(flush_safe, txn_id, keys, started_before,
+                              builder)
+            done(builder.build_partial(covering), None)
+        except BaseException as e:  # noqa: BLE001
+            done(None, e)
+
+    if query is None:
+        finish(None, safe)
+        return
+    dev.enqueue_query(query, builder, finish)
 
 
 def add_boundary_deps(safe: SafeCommandStore, txn_id: TxnId, keys,
@@ -130,23 +167,35 @@ class PreAccept(TxnRequest):
         min_epoch = self.min_epoch
 
         def map_fn(safe: SafeCommandStore):
+            """Returns a CHAIN of the store's reply: the deps scan rides
+            the store-level coalescer (one kernel dispatch per quantum
+            across every same-instant PreAccept on this store)."""
             owned = safe.store.ranges_for_epoch.all_between(min_epoch, self.max_epoch)
             partial_txn = txn.slice(owned, route.home_key is not None)
             progress_key = node.select_progress_key(txn_id, route)
             outcome, witnessed_at = commands.preaccept(
                 safe, txn_id, partial_txn, route, progress_key)
             if outcome is commands.AcceptOutcome.RejectedBallot:
-                return PreAcceptNack("Preempted")
+                return async_chain.success(PreAcceptNack("Preempted"))
             if outcome is commands.AcceptOutcome.Truncated:
-                return PreAcceptNack("Truncated")
+                return async_chain.success(PreAcceptNack("Truncated"))
             if outcome is commands.AcceptOutcome.Rejected:
-                return PreAcceptNack("Rejected", reject_floor=witnessed_at)
+                return async_chain.success(
+                    PreAcceptNack("Rejected", reject_floor=witnessed_at))
             if outcome is commands.AcceptOutcome.Redundant:
                 cmd = safe.get(txn_id)
                 witnessed_at = cmd.execute_at
-            deps = calculate_partial_deps(safe, txn_id, partial_txn.keys,
-                                          txn_id, owned)
-            return PreAcceptOk(txn_id, witnessed_at, deps)
+            out = async_chain.AsyncResult()
+
+            def on_deps(deps, failure):
+                if failure is not None:
+                    out.set_failure(failure)
+                else:
+                    out.set_success(PreAcceptOk(txn_id, witnessed_at, deps))
+
+            calculate_partial_deps_async(safe, txn_id, partial_txn.keys,
+                                         txn_id, owned, on_deps)
+            return out
 
         def reduce_fn(a, b):
             """(ref: PreAccept.java:140-156): max-merge witnessedAt, union deps."""
@@ -166,6 +215,12 @@ class PreAccept(TxnRequest):
             else:
                 node.reply(from_id, reply_context, result)
 
-        node.map_reduce_consume_local(
-            PreLoadContext.for_txn(txn_id), route.participants,
-            min_epoch, self.max_epoch, map_fn, reduce_fn, consume)
+        stores = node.command_stores.intersecting(
+            route.participants, min_epoch, self.max_epoch)
+        if not stores:
+            consume(None, None)
+            return
+        ctx = PreLoadContext.for_txn(txn_id)
+        chains = [s.execute(ctx, map_fn).flat_map(lambda inner: inner)
+                  for s in stores]
+        async_chain.reduce(chains, reduce_fn).begin(consume)

@@ -34,6 +34,7 @@ from ..utils.random_source import RandomSource
 from .cluster import Cluster
 from .kvstore import (KVDataStore, kv_ephemeral_read, kv_range_read, kv_txn)
 from .topology_factory import build_topology, mutate_electorates
+from .elle import CompositeVerifier, ListAppendCycleChecker
 from .verifier import StrictSerializabilityVerifier
 
 
@@ -46,6 +47,11 @@ class BurnResult:
         self.restarts = 0
         self.evictions = 0
         self.stats: Dict[str, int] = {}
+        # post-chaos quiescence gate (ref BurnTest.java:480-499): recovery
+        # traffic observed in a silent window after the drain, and whether
+        # every op resolved within the bounded drain
+        self.quiet_recovery_msgs = 0
+        self.drain_micros_used = 0
 
     def __repr__(self):
         return (f"BurnResult(ok={self.ops_ok}, failed={self.ops_failed}, "
@@ -58,7 +64,8 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
              workload_micros: int = 20_000_000,
              chaos: bool = True, churn: bool = True, restarts: bool = True,
              drain_micros: int = 120_000_000,
-             probe=None, probe_micros: int = 0) -> BurnResult:
+             probe=None, probe_micros: int = 0,
+             boundary_churn_only: bool = False) -> BurnResult:
     rs = RandomSource(seed)
     topology = build_topology(1, node_ids, rf, shards)
     cluster = Cluster(topology=topology, seed=rs.next_int(1 << 30),
@@ -66,7 +73,12 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
                       # journal-backed paging: terminal commands beyond this
                       # per-store count page out and reload on demand
                       paged_limit=150)
-    verifier = StrictSerializabilityVerifier()
+    # composite verification (ref: verify/CompositeVerifier.java): the
+    # real-time-anchored checker AND the independent Elle-style dependency-
+    # cycle checker both pass, or the run fails with the dissenting
+    # checker's witness
+    verifier = CompositeVerifier(StrictSerializabilityVerifier(),
+                                 ListAppendCycleChecker())
     result = BurnResult()
     wl = rs.fork()           # workload randomness
     net = rs.fork()          # chaos randomness
@@ -224,7 +236,22 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         current = cluster.topologies[-1]
         all_ids = list(node_ids)
         members = sorted(current.nodes())
-        roll = top.next_int(4)
+        roll = 4 + top.next_int(3) if boundary_churn_only \
+            else top.next_int(7)
+        if roll >= 4:
+            # arbitrary shard-boundary mutation (ref: TopologyRandomizer
+            # .java:427 SPLIT/MERGE/MOVE): one boundary changes while every
+            # other shard is untouched — the partial-bootstrap shapes a
+            # uniform ring re-split never produces
+            from .topology_factory import (merge_shards, move_boundary,
+                                           split_shard)
+            mut = (split_shard, merge_shards, move_boundary)[roll - 4]
+            topo = mut(current, top, current.epoch + 1)
+            cluster.add_topology(topo)
+            result.epochs += 1
+            cluster.queue.add(cluster.queue.now + 4_000_000
+                              + top.next_int(4_000_000), churn_once)
+            return
         if roll == 0 and len(members) < len(all_ids):
             # membership: add one node
             members = sorted(members + [top.pick(
@@ -266,7 +293,16 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     dur = rs.fork()
 
     def durability_round():
-        if cluster.queue.now > workload_micros + drain_micros // 2:
+        # runs through the drain (durability advancing is how home
+        # progress-log entries retire — stopping at drain/2 left
+        # legitimate not-yet-durable entries probing forever, which the
+        # quiescence gate would misread as a leak) but stands down once
+        # every client op resolved, so the drain loop's early-exit (all
+        # done AND queue empty) stays reachable
+        if cluster.queue.now > workload_micros + drain_micros:
+            return
+        if cluster.queue.now > workload_micros \
+                and all(op["done"] for op in outstanding):
             return
         nid = sorted(cluster.nodes)[dur.next_int(len(cluster.nodes))]
         sched = cluster.durability.get(nid)
@@ -333,6 +369,20 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         fn()
 
     result.ops_unresolved = sum(1 for op in outstanding if not op["done"])
+    result.drain_micros_used = max(0, cluster.queue.now - workload_micros)
+
+    # post-chaos QUIESCENCE GATE (ref: BurnTest.java:480-499): chaos and
+    # workload have stopped and every surviving op resolved — run a silent
+    # window and count recovery/fetch traffic.  A healthy cluster decays to
+    # idle; a slow liveness leak (progress logs grinding, recovery loops)
+    # shows up as sustained CheckStatus/BeginRecovery flow and fails the
+    # endurance legs' gate.
+    quiet_before = dict(cluster.stats)
+    cluster.run_for(10_000_000)
+    for verb in ("CheckStatus", "BeginRecovery", "WaitOnCommit",
+                 "InformOfTxnId", "AcceptInvalidate"):
+        result.quiet_recovery_msgs += (cluster.stats.get(verb, 0)
+                                       - quiet_before.get(verb, 0))
 
     # final reads: quorum-read every key from a live member and pin finals
     member = sorted(cluster.topologies[-1].nodes())[0]
@@ -350,6 +400,16 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
                              f"{cluster.failures[:3]}")
     verifier.verify()
     result.stats = dict(cluster.stats)
+    # lived kernel batching: mean deps-scan batch size across all stores
+    # (store-level coalescing; 1.0 would mean every query dispatched alone)
+    nq = nd = 0
+    for node in cluster.nodes.values():
+        for s in node.command_stores.unsafe_all_stores():
+            if s.device is not None:
+                nq += s.device.n_queries
+                nd += s.device.n_dispatches
+    result.stats["device_queries"] = nq
+    result.stats["device_dispatches"] = nd
     return result
 
 

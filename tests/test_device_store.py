@@ -187,3 +187,32 @@ def test_batched_attributed_equals_host(seed):
                 checked += 1
             safe.complete()
     assert checked >= 3
+
+
+def test_store_level_coalescing_batches_bursts():
+    """PreAccept deps scans arriving in one scheduler quantum share a
+    kernel dispatch (DeviceState.enqueue_query): a burst of concurrent
+    txns must yield mean batch size n_queries / n_dispatches > 1."""
+    from accord_tpu.sim.cluster import Cluster
+    from accord_tpu.sim.kvstore import KVDataStore, kv_txn
+    from accord_tpu.sim.topology_factory import build_topology
+    cluster = Cluster(topology=build_topology(1, (1, 2, 3), 3, 2), seed=3,
+                      data_store_factory=KVDataStore)
+    out = []
+    for i in range(24):
+        # same key neighborhood, all submitted before any scheduling runs:
+        # replicas receive same-instant PreAccept bursts
+        cluster.nodes[1 + (i % 3)].coordinate(
+            kv_txn([10 * (i % 4)], {10 * (i % 4): (f"v{i}",)})).begin(
+            lambda r, f: out.append((r, f)))
+    cluster.run_until_quiescent()
+    assert all(f is None for _r, f in out), out[:3]
+    nq = nd = 0
+    for node in cluster.nodes.values():
+        for s in node.command_stores.unsafe_all_stores():
+            if s.device is not None:
+                nq += s.device.n_queries
+                nd += s.device.n_dispatches
+    assert nq > 0 and nd > 0
+    mean = nq / nd
+    assert mean > 1.05, f"no coalescing happened: {nq} queries / {nd} dispatches"

@@ -1,0 +1,116 @@
+"""The independent second checker (sim.elle.ListAppendCycleChecker) and
+the composite: planted anomalies where ONE checker alone is blind and the
+other convicts — the reason the burn runs both
+(ref: verify/ElleVerifier.java + verify/CompositeVerifier.java)."""
+
+import pytest
+
+from accord_tpu.sim.elle import CompositeVerifier, ListAppendCycleChecker
+from accord_tpu.sim.verifier import (HistoryViolation,
+                                     StrictSerializabilityVerifier)
+
+
+def _feed(checker, ops, finals):
+    """ops = [(start, end, reads{k: prefix}, appends{k: values})]"""
+    ids = [checker.begin() for _ in ops]
+    for op_id, (start, end, reads, appends) in zip(ids, ops):
+        checker.on_result(op_id, start, end, reads, appends)
+    for k, v in finals.items():
+        checker.set_final(k, tuple(v))
+    return ids
+
+
+def test_clean_history_passes_both():
+    ops = [
+        (0, 10, {1: ()}, {1: ("a",)}),
+        (20, 30, {1: ("a",)}, {1: ("b",)}),
+        (40, 50, {1: ("a", "b")}, {}),
+    ]
+    finals = {1: ("a", "b")}
+    for mk in (ListAppendCycleChecker, StrictSerializabilityVerifier):
+        c = mk()
+        _feed(c, ops, finals)
+        c.verify()
+    c = CompositeVerifier(StrictSerializabilityVerifier(),
+                          ListAppendCycleChecker())
+    _feed(c, ops, finals)
+    c.verify()
+
+
+def test_g1c_wr_cycle_caught_by_cycle_checker():
+    """A pure write-read cycle among CONCURRENT txns (identical real-time
+    windows, so no real-time evidence): T1 appends x=a and reads y's
+    prefix including T2's append; T2 appends y=b and reads x's prefix
+    including T1's append — each read the other's write while both also
+    wrote, an unserializable wr cycle."""
+    ops = [
+        (0, 100, {20: ("b",)}, {10: ("a",)}),   # T1: wrote x, read y incl b
+        (0, 100, {10: ("a",)}, {20: ("b",)}),   # T2: wrote y, read x incl a
+    ]
+    finals = {10: ("a",), 20: ("b",)}
+    elle = ListAppendCycleChecker()
+    _feed(elle, ops, finals)
+    with pytest.raises(HistoryViolation, match="G1c"):
+        elle.verify()
+    comp = CompositeVerifier(StrictSerializabilityVerifier(),
+                             ListAppendCycleChecker())
+    _feed(comp, ops, finals)
+    with pytest.raises(HistoryViolation):
+        comp.verify()
+
+
+def test_write_skew_gsingle_convicted():
+    """Classic write-skew: both read the other's key's OLD prefix while
+    appending to their own — two rw edges (G2); concurrent windows."""
+    ops = [
+        (0, 100, {20: ()}, {10: ("a",)}),
+        (0, 100, {10: ()}, {20: ("b",)}),
+    ]
+    finals = {10: ("a",), 20: ("b",)}
+    elle = ListAppendCycleChecker()
+    _feed(elle, ops, finals)
+    with pytest.raises(HistoryViolation, match="G"):
+        elle.verify()
+
+
+def test_stale_read_realtime_anomaly_needs_the_other_checker():
+    """The dissent case the composite exists for: T2 STARTS after T1
+    COMPLETED yet observes an older prefix.  Pure data-dependency analysis
+    is blind (the edges are acyclic: both reads hang off the writers);
+    only the real-time-anchored checker convicts — and through the
+    composite, the run still fails."""
+    ops = [
+        (0, 10, {}, {1: ("a",)}),
+        (15, 25, {}, {1: ("b",)}),
+        (30, 40, {1: ("a", "b")}, {}),   # T1: fresh read, done by 40
+        (50, 60, {1: ("a",)}, {}),       # T2: starts at 50, reads STALE
+    ]
+    finals = {1: ("a", "b")}
+    elle = ListAppendCycleChecker()
+    _feed(elle, ops, finals)
+    elle.verify()          # blind by design: no real-time axis
+    strict = StrictSerializabilityVerifier()
+    _feed(strict, ops, finals)
+    with pytest.raises(HistoryViolation):
+        strict.verify()
+    comp = CompositeVerifier(ListAppendCycleChecker(),
+                             StrictSerializabilityVerifier())
+    _feed(comp, ops, finals)
+    with pytest.raises(HistoryViolation, match="StrictSerializability"):
+        comp.verify()
+
+
+def test_phantom_read_convicted_as_g1a():
+    ops = [(0, 10, {1: ("ghost",)}, {})]
+    finals = {1: ("a",)}
+    elle = ListAppendCycleChecker()
+    _feed(elle, ops, finals)
+    with pytest.raises(HistoryViolation, match="G1a"):
+        elle.verify()
+
+
+def test_burn_runs_composite():
+    """The live burn wires the composite (both checkers see every op)."""
+    from accord_tpu.sim.burn import run_burn
+    r = run_burn(1, n_ops=40)
+    assert r.ops_unresolved == 0 and r.ops_ok > 0

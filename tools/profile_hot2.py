@@ -1,0 +1,55 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+from accord_tpu.ops.packing import enable_x64
+enable_x64()
+import numpy as np
+import bench
+from accord_tpu.local.device_index import DeviceState
+from accord_tpu.local.commands_for_key import CommandsForKey, InternalStatus
+from accord_tpu.primitives.deps import DepsBuilder
+from accord_tpu.primitives.keys import Keys, IntKey, Range, Ranges
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+N3, B3, HOT = 100_000, 256, 128
+rng = np.random.default_rng(9)
+store = bench.BenchStore()
+dev = DeviceState(store)
+safe = bench.BenchSafe(store)
+hlcs = np.sort(rng.choice(np.arange(1, 2_000_000), size=N3, replace=False))
+floor_hlc = int(hlcs[int(N3 * 0.9)])
+floor_id = TxnId.create(1, floor_hlc, TxnKind.ExclusiveSyncPoint, Domain.Range, 1)
+entries = []
+for i in range(N3):
+    hlc = int(hlcs[i])
+    status = InternalStatus.APPLIED if hlc < floor_hlc else (
+        InternalStatus.COMMITTED if rng.random() < 0.3 else InternalStatus.PREACCEPTED)
+    kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
+    tid = TxnId.create(1, hlc, kind, Domain.Key, 1 + i % 5)
+    toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
+    entries.append((tid, status, toks))
+for tid, status, toks in entries:
+    dev.register(tid, int(status), Keys([IntKey(t) for t in toks]))
+    if status >= InternalStatus.COMMITTED:
+        dev.update_status(tid, int(status), execute_at=tid)
+    for t in toks:
+        cfk = store.commands_for_key.get(t)
+        if cfk is None:
+            cfk = store.commands_for_key[t] = CommandsForKey(t)
+        cfk.update(tid, status, execute_at=tid if status >= InternalStatus.COMMITTED else None)
+store.redundant_before.add_redundant(Ranges.of(Range(0, HOT)), floor_id)
+queries = []
+for b in range(B3 * 4):
+    bound = TxnId.create(1, int(rng.integers(2_000_000, 3_000_000)), TxnKind.Write, Domain.Key, 1)
+    toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
+    queries.append((bound, bound, bound.kind().witnesses(), toks, []))
+batches = [queries[i * B3:(i + 1) * B3] for i in range(4)]
+t0 = time.time()
+dev.deps_query_batch_attributed(safe, batches[0], [DepsBuilder() for _ in batches[0]])
+print(f"warmup {time.time()-t0:.1f}s s={dev._batch_flat} k={dev._batch_k}", file=sys.stderr)
+for bi, batch in enumerate(batches):
+    t0 = time.time()
+    builders = [DepsBuilder() for _ in batch]
+    dev.deps_query_batch_attributed(safe, batch, builders)
+    t1 = time.time()
+    nd = sum(b.build().key_deps.relation_count() for b in builders)
+    print(f"batch {bi}: attr={1e3*(t1-t0):.0f}ms count={1e3*(time.time()-t1):.0f}ms deps={nd} s={dev._batch_flat} k={dev._batch_k}", file=sys.stderr)
