@@ -747,6 +747,10 @@ class DeviceState:
         #                             n_dispatches = mean lived batch size
         # store-level coalescing queue (enqueue_query/_flush_queries)
         self._q_pending: List[tuple] = []
+        # per-kernel wall timing (SURVEY §5: structured per-kernel timing):
+        # kind -> [calls, seconds]; dispatch_* covers host pack + upload +
+        # enqueue, wait_* the download join, host_* the host-side passes
+        self.kernel_times: Dict[str, List[float]] = {}
 
     # ------------------------------------------------------------------
     # registration hooks (called from local.commands transitions)
@@ -1107,6 +1111,8 @@ class DeviceState:
         def dispatch(kind, rows):
             """rows: np int64 array of query indices for this part, padded
             to a pow2 batch by repeating the last row (pads map to -1)."""
+            import time as _time
+            _t0 = _time.perf_counter()
             b_pad = _pow2_at_least(len(rows), 1)
             rows_p = np.concatenate(
                 [rows, np.full(b_pad - len(rows), rows[-1], np.int64)])
@@ -1163,6 +1169,7 @@ class DeviceState:
                             shard_n=table.capacity, s=s, k=k, c=c,
                             span=span, prune=prune)
             self.n_dispatches += 1
+            self._ktime("dispatch_" + kind, _t0)
             box: Dict[str, object] = {"dev": out_dev}
             part["box"] = box
             if not immediate:
@@ -1242,9 +1249,19 @@ class DeviceState:
                 cols[:, :, off] = np.where(found, row_of[idxc], -1)
         return cols, wide_q
 
+    def _ktime(self, kind: str, t0: float) -> None:
+        import time as _time
+        cell = self.kernel_times.get(kind)
+        if cell is None:
+            cell = self.kernel_times[kind] = [0, 0.0]
+        cell[0] += 1
+        cell[1] += _time.perf_counter() - t0
+
     def _collect_part(self, part):
         """Download + parse one kernel part; re-run once when the learned
         flat capacity overflowed.  Returns (global b_idx, j_idx)."""
+        import time as _time
+        _t0 = _time.perf_counter()
         box = part["box"]
         th = part.get("th")
         nq = part["nq"]
@@ -1321,6 +1338,7 @@ class DeviceState:
                         part["span"], s, k))
             parsed = parse(out, s, k)
         b_local, j_idx = parsed
+        self._ktime("wait_" + part["kind"], _t0)
         gmap = part["gmap"]
         b_global = gmap[b_local]
         keep = b_global >= 0                      # drop pad rows
@@ -1337,8 +1355,10 @@ class DeviceState:
         registrations interleaved between begin and end must not shift the
         queried snapshot."""
         (parts, ids, ivs, qnp, q_m, queries) = handle
+        import time as _time
         nq = len(queries)
         outs = [self._collect_part(p) for p in parts]
+        _tg = _time.perf_counter()
         b_idx = np.concatenate([o[0] for o in outs]) if outs else \
             np.zeros(0, np.int64)
         j_idx = np.concatenate([o[1] for o in outs]) if outs else \
@@ -1356,6 +1376,7 @@ class DeviceState:
         b_idx, j_idx, overlap = b_idx[keep], j_idx[keep], overlap[keep]
         self.n_queries += len(queries)
         self.n_kernel_deps += len(j_idx)
+        self._ktime("host_geometry", _tg)
         return b_idx, j_idx, overlap, ids, ivs, qnp, queries
 
     def deps_query_batch_end(self, handle):
@@ -1375,10 +1396,13 @@ class DeviceState:
     def deps_query_batch_end_attributed(self, safe, handle, builders) -> None:
         """Collect a dispatched batch and fold each query's deps into its
         builder with full host-path semantics (floors/elision/attribution)."""
+        import time as _time
         b_idx, j_idx, overlap, ids, ivs, qnp, queries = \
             self._batch_collect(handle)
+        _ta = _time.perf_counter()
         self._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs, qnp,
                               queries, builders)
+        self._ktime("host_attribute", _ta)
 
     # ------------------------------------------------------------------
     # the drain (device replacement of listener fan-out)

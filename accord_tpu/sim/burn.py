@@ -403,13 +403,18 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     # lived kernel batching: mean deps-scan batch size across all stores
     # (store-level coalescing; 1.0 would mean every query dispatched alone)
     nq = nd = 0
+    kt: Dict[str, float] = {}
     for node in cluster.nodes.values():
         for s in node.command_stores.unsafe_all_stores():
             if s.device is not None:
                 nq += s.device.n_queries
                 nd += s.device.n_dispatches
+                for k, (_c, sec) in s.device.kernel_times.items():
+                    kt[k] = kt.get(k, 0.0) + sec
     result.stats["device_queries"] = nq
     result.stats["device_dispatches"] = nd
+    for k, sec in kt.items():
+        result.stats[f"kernel_wall_ms_{k}"] = round(1e3 * sec, 1)
     return result
 
 

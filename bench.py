@@ -460,7 +460,7 @@ def main():
     M = 8
     B = 2048 if on_tpu else 128
     BATCHES = max(1, 10_000 // B) + (0 if (10_000 % B == 0) else 1)
-    REPS = 5
+    REPS = 7   # median over 7: the tunnel's RTT weather swings single reps
     PIPELINE = 2   # batches in flight (deps_query_batch_begin/end)
     rng = np.random.default_rng(42)
 
@@ -595,6 +595,8 @@ def main():
         "vs_baseline_kind": "host-numpy",
     }))
     pb = {k: 1e3 * v / n_phase_batches for k, v in phases.items()}
+    kt = {k: f"{1e3 * sec / max(calls, 1):.1f}ms x{calls}"
+          for k, (calls, sec) in sorted(dev.kernel_times.items())}
     print(f"# device={jax.devices()[0].platform} N={N} B={B} "
           f"queries_per_rep={B * BATCHES} reps={REPS}\n"
           f"# dev_median={dev_med:.1f}/s dev_min={dev_min:.1f}/s "
@@ -603,6 +605,7 @@ def main():
           f"double-buffering): begin(pack+upload+dispatch)={pb['begin']:.1f} "
           f"collect(download+parse+geometry+attribute)={pb['collect']:.1f} "
           f"csr_freeze={pb['build']:.1f}\n"
+          f"# kernel timing (wall mean per call): {kt}\n"
           f"# index: bucketed_queries={dev.n_bucketed_queries} "
           f"dispatches={dev.n_dispatches} "
           f"wide_entries={len(dev.deps.wide_entries)} "
