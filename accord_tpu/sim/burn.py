@@ -52,6 +52,7 @@ class BurnResult:
         # every op resolved within the bounded drain
         self.quiet_recovery_msgs = 0
         self.drain_micros_used = 0
+        self.kernel_wall: Dict[str, float] = {}   # wall timings (not seeded)
 
     def __repr__(self):
         return (f"BurnResult(ok={self.ops_ok}, failed={self.ops_failed}, "
@@ -413,8 +414,9 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
                     kt[k] = kt.get(k, 0.0) + sec
     result.stats["device_queries"] = nq
     result.stats["device_dispatches"] = nd
-    for k, sec in kt.items():
-        result.stats[f"kernel_wall_ms_{k}"] = round(1e3 * sec, 1)
+    # wall-clock timings live OUTSIDE stats: stats must stay a pure
+    # function of the seed (the determinism double-run compares it)
+    result.kernel_wall = {k: round(1e3 * sec, 1) for k, sec in kt.items()}
     return result
 
 
