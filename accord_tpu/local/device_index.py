@@ -747,6 +747,8 @@ class DeviceState:
         #                             n_dispatches = mean lived batch size
         # store-level coalescing queue (enqueue_query/_flush_queries)
         self._q_pending: List[tuple] = []
+        # token -> (cfk version, may_elide_any) memo for attribution
+        self._elidable_cache: Dict[int, tuple] = {}
         # per-kernel wall timing (SURVEY §5: structured per-kernel timing):
         # kind -> [calls, seconds]; dispatch_* covers host pack + upload +
         # enqueue, wait_* the download join, host_* the host-side passes
@@ -927,10 +929,24 @@ class DeviceState:
             uniq_t2, inv_t2 = np.unique(tt_k, return_inverse=True)
             tok_maybe = np.zeros(len(uniq_t2), bool)
             cfk_map = self.store.commands_for_key
+            ecache = self._elidable_cache
             for i, t in enumerate(uniq_t2.tolist()):
                 cfk = cfk_map.get(t)
-                if cfk is not None and cfk.may_elide_any():
-                    tok_maybe[i] = True
+                if cfk is None:
+                    continue
+                # version-keyed memo: may_elide_any flips only when a
+                # committed write or unwitnessable lands on the key, both
+                # monotone counters — the common spread key resolves to a
+                # single dict hit instead of the CFK probe
+                ver = (len(cfk._committed_write_execs),
+                       cfk._n_unwitnessable)
+                hit = ecache.get(t)
+                if hit is not None and hit[0] == ver:
+                    tok_maybe[i] = hit[1]
+                else:
+                    m = cfk.may_elide_any()
+                    ecache[t] = (ver, m)
+                    tok_maybe[i] = m
             status_k = status_a[jj_k]
             elide = status_k == dk.SLOT_TRANSITIVE
             flagged = tok_maybe[inv_t2]
