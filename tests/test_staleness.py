@@ -198,3 +198,53 @@ def test_stale_reads_refuse_until_rebootstrap():
     assert out[0][1] is None
     assert out[0][0].reads == {10: ("a",)}
     assert cluster.failures == []
+
+
+def test_stale_hatch_inside_burn_keeps_strict_ser():
+    """The hatch under live chaos: mid-burn, forge the peers-durably-erased
+    condition on one node's store (the data-loss injection); the run must
+    mark stale, re-bootstrap, keep serving, and the composite verifier must
+    still pass at the end."""
+    from accord_tpu.sim.burn import run_burn
+
+    hit = {"stores": 0}
+
+    def probe(cluster):
+        from accord_tpu.local.status import SaveStatus as SS
+        for nid in sorted(cluster.nodes):
+            node = cluster.nodes[nid]
+            if not getattr(node, "alive", True):
+                continue
+            for s in node.command_stores.stores:
+                for tid, cmd in list(s.commands.items()):
+                    if not (tid.is_write() and cmd.partial_txn is not None
+                            and cmd.route is not None
+                            and cmd.execute_at is not None
+                            and cmd.save_status.status >= Status.Stable
+                            and not cmd.is_truncated()):
+                        continue
+                    from accord_tpu.local.redundant import participant_slice
+                    my_slice = participant_slice(
+                        s.ranges_for_epoch.all(), cmd.participants())
+                    if my_slice.is_empty():
+                        continue
+                    if cmd.save_status.status >= Status.PreApplied:
+                        cmd.save_status = SaveStatus.Stable
+                    ok = CheckStatusOk(
+                        SaveStatus.TruncatedApply, Ballot.ZERO, Ballot.ZERO,
+                        cmd.execute_at, Durability.Majority, cmd.route,
+                        None, truncated_covering=Ranges.of(my_slice[0]))
+                    Propagate(tid, cmd.route.participants, ok).process(
+                        node, node.node_id, None)
+                    hit["stores"] += 1
+                    hit["store"] = s
+                    return   # one injection is the test
+
+    result = run_burn(31, n_ops=120, workload_micros=15_000_000,
+                      probe=probe, probe_micros=8_000_000)
+    assert hit["stores"] == 1, "injection never found a target"
+    # partial covering excludes the purge path: the hatch itself must have
+    # fired on the injected store
+    assert hit["store"].n_stale_marks >= 1, "escape hatch never fired"
+    assert result.ops_unresolved == 0
+    assert result.ops_ok >= 2 * result.ops_failed, result
