@@ -13,8 +13,10 @@ from ..primitives.deps import PartialDeps
 from ..primitives.keys import Route
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
+from ..utils import async_chain
 from .base import MessageType, Reply, TxnRequest
-from .preaccept import calculate_partial_deps
+from .preaccept import (calculate_partial_deps,
+                        calculate_partial_deps_async)
 
 
 class AcceptReply(Reply):
@@ -73,16 +75,27 @@ class Accept(TxnRequest):
                 safe, txn_id, self.ballot, route, partial_txn.keys,
                 progress_key, self.execute_at, partial_deps)
             if outcome is commands.AcceptOutcome.RejectedBallot:
-                return AcceptReply(superseded_by=superseded)
+                return async_chain.success(
+                    AcceptReply(superseded_by=superseded))
             if outcome is commands.AcceptOutcome.Redundant:
-                return AcceptReply(redundant=True)
+                return async_chain.success(AcceptReply(redundant=True))
             if outcome is commands.AcceptOutcome.Rejected:
-                return AcceptReply(rejected=True, reject_floor=superseded)
+                return async_chain.success(
+                    AcceptReply(rejected=True, reject_floor=superseded))
             # return deps witnessed up to executeAt for the coordinator's
-            # final merge (ref: Accept.java AcceptReply.deps)
-            deps = calculate_partial_deps(safe, txn_id, partial_txn.keys,
-                                          self.execute_at, owned)
-            return AcceptReply(deps=deps)
+            # final merge (ref: Accept.java AcceptReply.deps) — via the
+            # store-level coalescer (same-quantum Accepts share a dispatch)
+            out = async_chain.AsyncResult()
+
+            def on_deps(deps, failure):
+                if failure is not None:
+                    out.set_failure(failure)
+                else:
+                    out.set_success(AcceptReply(deps=deps))
+
+            calculate_partial_deps_async(safe, txn_id, partial_txn.keys,
+                                         self.execute_at, owned, on_deps)
+            return out
 
         def reduce_fn(a: AcceptReply, b: AcceptReply):
             if not a.is_ok():
@@ -99,9 +112,15 @@ class Accept(TxnRequest):
             else:
                 node.reply(from_id, reply_context, result)
 
-        node.map_reduce_consume_local(
-            PreLoadContext.for_txn(txn_id), route.participants,
-            self.min_epoch, self.max_epoch, map_fn, reduce_fn, consume)
+        stores = node.command_stores.intersecting(
+            route.participants, self.min_epoch, self.max_epoch)
+        if not stores:
+            consume(None, None)
+            return
+        ctx = PreLoadContext.for_txn(txn_id)
+        chains = [s.execute(ctx, map_fn).flat_map(lambda inner: inner)
+                  for s in stores]
+        async_chain.reduce(chains, reduce_fn).begin(consume)
 
 
 class AcceptInvalidate(TxnRequest):
