@@ -251,3 +251,51 @@ def test_device_floor_prune_matches_host_floors():
     for q, g in zip(qs, got):
         want = [t for t in _brute(entries, q) if t >= floor_id]
         assert g == want
+
+
+def test_bucketed_random_lifecycle_interleaving():
+    """Property run over random register / invalidate / free / query
+    interleavings: the bucket index (incl. invalidation de-indexing and
+    straggler spill) must agree with the dense kernel and with a host
+    brute force that drops invalidated entries, at every step."""
+    from accord_tpu.ops import deps_kernel as dk
+    rng = np.random.default_rng(31)
+    keyspace = 3_000
+    store, dev, safe = _mk_state()
+    live = {}         # tid -> (toks, rngs)
+    all_entries = []
+    hlc = 1
+    for step in range(300):
+        roll = rng.random()
+        if roll < 0.55 or not live:
+            tid_entries = _workload(rng, 1, keyspace, wide_frac=0.15,
+                                    hot_frac=0.15)
+            (tid, toks, rngs) = tid_entries[0]
+            tid = TxnId.create(1, hlc, tid.kind(), tid.domain(),
+                               1 + int(rng.integers(0, 5)))
+            hlc += int(rng.integers(1, 4))
+            keys = Ranges.of(*rngs) if rngs else \
+                Keys([IntKey(t) for t in toks])
+            dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+            live[tid] = (toks, rngs)
+            all_entries.append((tid, toks, rngs))
+        elif roll < 0.75:
+            tid = list(live)[int(rng.integers(0, len(live)))]
+            dev.update_status(tid, int(InternalStatus.INVALIDATED))
+            del live[tid]
+            all_entries = [e for e in all_entries if e[0] != tid]
+        else:
+            tid = list(live)[int(rng.integers(0, len(live)))]
+            dev.free(tid)
+            del live[tid]
+            all_entries = [e for e in all_entries if e[0] != tid]
+        if step % 60 == 59:
+            qs = _queries(rng, 12, keyspace, 10_000, wide_q_frac=0.1)
+            got = _raw_deps(dev, qs)
+            for q, g in zip(qs, got):
+                assert g == _brute(all_entries, q), f"step {step}"
+    # final cross-check vs the dense kernel
+    qs = _queries(rng, 20, keyspace, 10_000)
+    got = _raw_deps(dev, qs)
+    dev.BUCKETED = False
+    assert got == _raw_deps(dev, qs)
