@@ -164,8 +164,21 @@ class SimpleProgressLog(api.ProgressLog):
                 outcome, info = value
                 if outcome == "progressed":
                     if info is not None and info > entry.token:
+                        # organic progress = durability/phase advanced;
+                        # ballot-only movement is the signature of recovery
+                        # attempts (ours or the OTHER home replicas') — if
+                        # it reset the backoff, the replicas would re-arm
+                        # each other forever, mutually preempting ballots
+                        # at full scan cadence (the 1.4M-CheckStatus grind
+                        # on long windows)
+                        organic = (info.durability, info.status_phase) > \
+                            (entry.token.durability,
+                             entry.token.status_phase)
                         entry.token = entry.token.merge(info)
-                        entry.observed_progress()
+                        if organic:
+                            entry.observed_progress()
+                        else:
+                            entry.no_progress()
                     else:
                         entry.no_progress()
                 else:

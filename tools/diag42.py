@@ -1,0 +1,50 @@
+import sys, collections
+sys.path.insert(0, "/root/repo")
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_enable_x64', True)
+from accord_tpu.impl import progress_log as pl
+from accord_tpu.coordinate import recover as rec
+
+inv = collections.Counter()
+outcomes = collections.Counter()
+orig_inv = pl.SimpleProgressLog._investigate
+def pinv(self, entry):
+    inv[entry.txn_id] += 1
+    return orig_inv(self, entry)
+pl.SimpleProgressLog._investigate = pinv
+
+fetch = collections.Counter()
+orig_fetch = pl.SimpleProgressLog._fetch
+def pfetch(self, entry):
+    fetch[entry.txn_id] += 1
+    return orig_fetch(self, entry)
+pl.SimpleProgressLog._fetch = pfetch
+
+starts = collections.Counter()
+orig_start = rec.Recover._start
+def pstart(self):
+    starts[self.txn_id] += 1
+    return orig_start(self)
+rec.Recover._start = pstart
+
+orig_mr = rec.maybe_recover
+def pmr(node, txn_id, route, prev, txn=None):
+    chain = orig_mr(node, txn_id, route, prev, txn)
+    def tap(v, f):
+        if f is not None:
+            outcomes[type(f).__name__] += 1
+        elif isinstance(v, tuple):
+            outcomes[v[0]] += 1
+    chain.begin(tap)
+    return chain
+rec.maybe_recover = pmr
+
+from tests.test_burn import run_burn
+r = run_burn(42, n_ops=1000, workload_micros=120_000_000)
+print('ok', r.ops_ok, 'failed', r.ops_failed, 'cs', r.stats.get('CheckStatus',0), 'quiet', r.quiet_recovery_msgs)
+print('investigations total', sum(inv.values()), 'max/txn', max(inv.values(), default=0), 'entries', len(inv))
+print('fetches total', sum(fetch.values()), 'max/txn', max(fetch.values(), default=0), 'entries', len(fetch))
+print('recover starts total', sum(starts.values()), 'max', max(starts.values(), default=0))
+print('outcomes:', dict(outcomes.most_common(8)))
+for t, c in inv.most_common(3): print('  inv', t, c)
