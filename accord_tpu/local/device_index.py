@@ -25,6 +25,36 @@ small.  The host command records remain authoritative for execution: the
 kernel proposes the ready frontier, and each candidate is re-validated
 against its WaitingOn bitset before executing — any mirror divergence
 degrades to a no-op, never a wrong execution.
+
+Regime-adaptive dispatch: every batched deps scan is routed per flush to
+the cheapest of THREE routes, all of which feed the same snapshot, exact
+geometry, floors, elision and attribution code — the protocol never sees
+which route ran (results are bit-identical by construction):
+
+ - **host**: a vectorized numpy interval scan over only the LIVE TAIL
+   (slots above the batch-global RedundantBefore floor): token-sorted point
+   entries probed with searchsorted, flat range entries stabbed with one
+   broadcast.  Wins when the live working set is small relative to a device
+   round trip (the hot-key / durable-prefix-dominated regime, where 90%+ of
+   the table sits below the floor and RTTs dominate a ~10k-entry scan).
+ - **bucketed** (device): the CINTIA-analogue bucket index
+   (ops.deps_kernel.bucketed_flat) — O(candidates) per query.  Under a
+   mesh the bucket rows are row-sharded (parallel.sharded.
+   sharded_bucketed_flat) with device-side floor pruning.
+ - **dense** (device): the exact O(N) kernel — the fallback when footprint
+   distributions defeat bucketing (straggler spill, wide queries).  Under a
+   mesh it row-shards the slot table (sharded_calculate_deps_flat[_pruned]).
+
+The crossover is NOT hard-coded: a once-per-process micro-probe measures
+the device round-trip cost, the device per-element kernel cost and the
+host per-element scan cost (DeviceState._measure_route_calibration); the
+router compares a modeled host scan cost (live-above-floor working set,
+estimated O(1) per dispatch from _DepsMirror's incremental counters +
+RedundantBefore.version) against the modeled device cost and picks the
+cheaper side.  ``DeviceState.route_override`` pins a route for tests and
+benches; per-route dispatch counters (n_host_queries / n_bucketed_queries /
+n_dense_queries / n_mesh_queries) make routing regressions visible in
+every BENCH artifact.
 """
 
 from __future__ import annotations
@@ -67,6 +97,20 @@ def _scatter_rows(table: dk.DepsTable, idx, msb, lsb, node, kind, status,
         table.status.at[idx].set(status),
         table.lo.at[idx].set(lo),
         table.hi.at[idx].set(hi))
+
+
+_PZ = None
+
+
+def _prune_zeros():
+    """Replicated zero floor for the always-pruned sharded kernels: under
+    the unsigned ts_lt order nothing sorts below (0, 0, 0), so a zero
+    triple prunes nothing (same convention as calculate_deps' default)."""
+    global _PZ
+    if _PZ is None:
+        _PZ = (jnp.asarray(np.int64(0)), jnp.asarray(np.int64(0)),
+               jnp.asarray(np.int32(0)))
+    return _PZ
 
 
 def _grow(arr: np.ndarray, new_len: int, fill) -> np.ndarray:
@@ -128,23 +172,43 @@ class _DepsMirror:
         self.bucket_entries: List[List[Tuple[int, int, int]]] = []
         self.bucket_dirty: Set[int] = set()
         self.wide_entries: Set[Tuple[int, int, int]] = set()
-        self.wide_dirty = True
-        self._bhost = None                        # (blo, bhi, bslot) np
-        self._bdev = None                         # jnp triple
+        self._bhost = None                        # 7 host row arrays
+        self._bdev = None                         # jnp 7-tuple
+        self._bdev_pending: Set[int] = set()      # rows _bdev hasn't seen
         self._g_cap = 0
-        self._wdev = None                         # (wlo, whi, wslot) jnp
+        self._whost = None                        # 7 host wide arrays
+        self._whost_key = None
+        self._wdev = None                         # (wlo, whi, wslot...) jnp
+        self._wdev_key = None
+        self._bsh = None                          # mesh-sharded BucketTable
+        self._bsh_key = None
         self._sorted_bids = np.zeros(0, np.int64)
         self._row_of_sorted = np.zeros(0, np.int32)
         self._bids_stale = False
+        # -- routing state (see module docstring): incremental mutation /
+        # liveness counters + the cached floor stats and host-route index.
+        # ``version`` bumps on every slot mutation, ``bucket_version`` /
+        # ``wide_version`` on bucket-index mutations (they key the sharded
+        # bucket upload), ``n_live`` counts non-free non-invalidated slots
+        # exactly — together they make the live-above-floor estimate O(1)
+        # amortized per dispatch.
+        self.version = 0
+        self.bucket_version = 0
+        self.wide_version = 0
+        self.n_live = 0
+        self._fstats = None                       # cached floor stats
+        self._hidx = None                         # cached host-route index
+        self._hidx_key = None
 
     # -- bucket index maintenance -------------------------------------------
     def _bucket_add(self, slot: int, lo: int, hi: int) -> None:
         if self.status[slot] == dk.SLOT_INVALIDATED:
             return   # structurally excluded (de-indexed on invalidation)
+        self.bucket_version += 1
         blo, bhi = lo >> self.BSHIFT, hi >> self.BSHIFT
         if bhi - blo + 1 > self.SPAN:
             self.wide_entries.add((lo, hi, slot))
-            self.wide_dirty = True
+            self.wide_version += 1
             return
         for bid in range(blo, bhi + 1):
             row = self.bucket_row.get(bid)
@@ -157,7 +221,7 @@ class _DepsMirror:
             if len(ents) >= self.BUCKET_K:
                 # overflow spill: the straggler list absorbs hot buckets
                 self.wide_entries.add((lo, hi, slot))
-                self.wide_dirty = True
+                self.wide_version += 1
             else:
                 ents.append((lo, hi, slot))
                 self.bucket_dirty.add(row)
@@ -165,6 +229,7 @@ class _DepsMirror:
     def _bucket_remove(self, slot: int) -> None:
         """De-index every interval of ``slot`` (called before the row's
         lo/hi are cleared on free)."""
+        self.bucket_version += 1
         row_lo, row_hi = self.lo[slot], self.hi[slot]
         for m in range(self.max_intervals):
             lo, hi = int(row_lo[m]), int(row_hi[m])
@@ -175,7 +240,7 @@ class _DepsMirror:
             if bhi - blo + 1 > self.SPAN:
                 if ent in self.wide_entries:
                     self.wide_entries.discard(ent)
-                    self.wide_dirty = True
+                    self.wide_version += 1
                 continue
             spilled = False
             for bid in range(blo, bhi + 1):
@@ -190,7 +255,7 @@ class _DepsMirror:
                 spilled = True
             if spilled and ent in self.wide_entries:
                 self.wide_entries.discard(ent)
-                self.wide_dirty = True
+                self.wide_version += 1
 
     def bid_rows(self) -> Tuple[np.ndarray, np.ndarray]:
         """(sorted bucket ids, dense row per id) for vectorized query->row
@@ -222,12 +287,15 @@ class _DepsMirror:
             bnode[r, i] = self.node[s]
             bkind[r, i] = self.kind[s]
 
-    def bucket_device(self) -> "dk.BucketTable":
-        """Sync the bucket index to the device (dirty-row scatter, like the
-        slot table) and return the BucketTable."""
+    def _sync_bucket_host(self) -> None:
+        """Bring the 7 host bucket-row arrays (``_bhost``) up to date with
+        ``bucket_entries`` — the single source both device consumers (the
+        single-device jnp copy and the mesh-sharded upload) build from, so
+        alternating consumers (the router switches routes between flushes)
+        never see each other's dirty-set consumption."""
         k = self.BUCKET_K
         g_cap = _pow2_at_least(max(len(self.bucket_entries), 1), 64)
-        if self._bdev is None or g_cap != self._g_cap:
+        if self._bhost is None or g_cap != self._g_cap:
             blo = np.full((g_cap, k), dk.PAD_LO, np.int64)
             bhi = np.full((g_cap, k), dk.PAD_HI, np.int64)
             bslot = np.full((g_cap, k), -1, np.int32)
@@ -239,23 +307,24 @@ class _DepsMirror:
             for r, ents in enumerate(self.bucket_entries):
                 if ents:
                     self._fill_bucket_row(self._bhost, r, ents)
-            self._bdev = tuple(jnp.asarray(a) for a in self._bhost)
             self._g_cap = g_cap
             self.bucket_dirty.clear()
+            self._bdev = None          # shape changed: full re-upload
+            self._bdev_pending.clear()
         elif self.bucket_dirty:
             rows = sorted(self.bucket_dirty)
             for r in rows:
                 self._fill_bucket_row(self._bhost, r, self.bucket_entries[r])
-            padded = _pow2_at_least(len(rows), 8)
-            idx = np.concatenate([np.array(rows, np.int32),
-                                  np.full(padded - len(rows), rows[-1],
-                                          np.int32)])
-            self._bdev = _scatter_bucket_rows(
-                self._bdev, jnp.asarray(idx),
-                tuple(a[idx] for a in self._bhost))
+            self._bdev_pending.update(rows)
             self.bucket_dirty.clear()
-        if self._wdev is None or self.wide_dirty:
-            w = _pow2_at_least(max(len(self.wide_entries), 1), 16)
+
+    def _sync_wide_host(self, floor: int):
+        """Host arrays for the wide/straggler entries, padded to a pow2 of
+        at least ``floor`` (the mesh caller passes its device count so the
+        wide dimension row-shards evenly)."""
+        w = _pow2_at_least(max(len(self.wide_entries), 1), floor)
+        key = (self.wide_version, w)
+        if self._whost is None or self._whost_key != key:
             wlo = np.full(w, dk.PAD_LO, np.int64)
             whi = np.full(w, dk.PAD_HI, np.int64)
             wslot = np.full(w, -1, np.int32)
@@ -271,12 +340,51 @@ class _DepsMirror:
                 wlsb[i] = self.lsb[s]
                 wnode[i] = self.node[s]
                 wkind[i] = self.kind[s]
-            self._wdev = (jnp.asarray(wlo), jnp.asarray(whi),
-                          jnp.asarray(wslot), jnp.asarray(wmsb),
-                          jnp.asarray(wlsb), jnp.asarray(wnode),
-                          jnp.asarray(wkind))
-            self.wide_dirty = False
+            self._whost = (wlo, whi, wslot, wmsb, wlsb, wnode, wkind)
+            self._whost_key = key
+        return self._whost
+
+    def bucket_device(self) -> "dk.BucketTable":
+        """Sync the bucket index to the (single) device — dirty-row scatter,
+        like the slot table — and return the BucketTable."""
+        self._sync_bucket_host()
+        if self._bdev is None:
+            self._bdev = tuple(jnp.asarray(a) for a in self._bhost)
+            self._bdev_pending.clear()
+        elif self._bdev_pending:
+            rows = sorted(self._bdev_pending)
+            padded = _pow2_at_least(len(rows), 8)
+            idx = np.concatenate([np.array(rows, np.int32),
+                                  np.full(padded - len(rows), rows[-1],
+                                          np.int32)])
+            self._bdev = _scatter_bucket_rows(
+                self._bdev, jnp.asarray(idx),
+                tuple(a[idx] for a in self._bhost))
+            self._bdev_pending.clear()
+        whost = self._sync_wide_host(16)
+        if self._wdev is None or self._wdev_key != self._whost_key:
+            self._wdev = tuple(jnp.asarray(a) for a in whost)
+            self._wdev_key = self._whost_key
         return dk.BucketTable(*self._bdev, *self._wdev)
+
+    def bucket_device_sharded(self, mesh) -> "dk.BucketTable":
+        """Mesh placement of the bucket index: bucket ROWS and the wide list
+        row-sharded across the mesh (the per-shard slices feed
+        parallel.sharded.sharded_bucketed_flat).  Any mutation triggers a
+        full sharded re-upload, keyed on the bucket/wide version counters —
+        same policy as device_table_sharded."""
+        self._sync_bucket_host()
+        d = int(np.prod(list(mesh.shape.values())))
+        whost = self._sync_wide_host(max(16, d))
+        key = (self.bucket_version, self.wide_version, self._g_cap,
+               whost[0].shape[0], tuple(dev.id for dev in mesh.devices.flat))
+        if self._bsh is not None and self._bsh_key == key:
+            return self._bsh
+        from ..parallel.sharded import shard_bucket_table
+        self._bsh = shard_bucket_table(
+            mesh, dk.BucketTable(*self._bhost, *whost))
+        self._bsh_key = key
+        return self._bsh
 
     # -- slot management ----------------------------------------------------
     def alloc(self, txn_id: TxnId) -> int:
@@ -299,6 +407,8 @@ class _DepsMirror:
         self.lo[slot] = dk.PAD_LO
         self.hi[slot] = dk.PAD_HI
         self._dirty.add(slot)
+        self.version += 1
+        self.n_live += 1
         return slot
 
     def free(self, txn_id: TxnId) -> None:
@@ -309,11 +419,14 @@ class _DepsMirror:
         self.obj[slot] = None
         self.eknown[slot] = False
         self._bucket_remove(slot)
+        if self.status[slot] != dk.SLOT_INVALIDATED:
+            self.n_live -= 1
         self.status[slot] = dk.SLOT_FREE
         self.lo[slot] = dk.PAD_LO
         self.hi[slot] = dk.PAD_HI
         self.free_slots.append(slot)
         self._dirty.add(slot)
+        self.version += 1
 
     def _grow_capacity(self) -> None:
         old = self.capacity
@@ -370,12 +483,165 @@ class _DepsMirror:
             row_hi[used] = hi_v
             used += 1
             self._dirty.add(slot)
+            self.version += 1
             self._bucket_add(slot, lo_v, hi_v)
 
     def set_status(self, slot: int, status: int) -> None:
-        if self.status[slot] != status:
+        cur = int(self.status[slot])
+        if cur != status:
+            if status == dk.SLOT_INVALIDATED and cur != dk.SLOT_FREE:
+                self.n_live -= 1
+                # liveness changed: the host-route index (which excludes
+                # dead slots STRUCTURALLY) is stale.  Live->live status
+                # moves deliberately do NOT bump: the index carries only
+                # geometry + liveness, and commit/apply churn between
+                # flushes would otherwise rebuild it every flush in
+                # exactly the hot regime the host route serves
+                self.version += 1
             self.status[slot] = status
             self._dirty.add(slot)
+
+    # -- host route (the third dispatch target; see module docstring) -------
+    def _above_floor_mask(self, floor_id) -> np.ndarray:
+        """bool[capacity]: packed id >= floor, under EXACTLY the kernel's
+        ts_lt order (unsigned on the two int64 words, then signed node)."""
+        from ..ops.packing import to_u64
+        fm = np.uint64(to_u64(to_i64(floor_id.msb)))
+        fl = np.uint64(to_u64(to_i64(floor_id.lsb)))
+        fn = np.int32(floor_id.node)
+        um = self.msb.astype(np.uint64)
+        ul = self.lsb.astype(np.uint64)
+        return ((um > fm) | ((um == fm)
+                            & ((ul > fl) | ((ul == fl) & (self.node >= fn)))))
+
+    def floor_stats(self, floor_id) -> Dict[str, float]:
+        """Estimated shape of the LIVE-above-floor working set: slot count,
+        point/range interval-entry counts and the point-token span.  Cached;
+        recomputed (one vectorized pass) only when the floor changes or the
+        mutation version drifts past 1/8 of the live set — between
+        recomputes the slot-count delta (``n_live`` is exact) scales the
+        entry estimates, so the router's read is O(1) per dispatch."""
+        fkey = floor_id if floor_id is not None and floor_id > TxnId.NONE \
+            else None
+        st = self._fstats
+        if st is None or st["floor"] != fkey or \
+                self.version - st["version"] > max(64, st["n_at"] >> 3):
+            live = (self.status >= 0) & (self.status != dk.SLOT_INVALIDATED)
+            if fkey is not None:
+                live &= self._above_floor_mask(fkey)
+            j = np.nonzero(live)[0]
+            lo, hi = self.lo[j], self.hi[j]
+            used = lo <= hi
+            pt = used & (lo == hi)
+            n_pt = int(pt.sum())
+            toks = lo[pt]
+            st = self._fstats = {
+                "floor": fkey, "version": self.version, "n_at": self.n_live,
+                "n_above": len(j), "n_pt": n_pt,
+                "n_rng": int(used.sum()) - n_pt,
+                "tok_lo": int(toks.min()) if n_pt else 0,
+                "tok_hi": int(toks.max()) if n_pt else 0}
+        grown = max(self.n_live - st["n_at"], 0)
+        per = (st["n_pt"] + st["n_rng"]) / max(st["n_at"], 1)
+        frac_pt = st["n_pt"] / max(st["n_pt"] + st["n_rng"], 1)
+        return {"n_above": st["n_above"] + grown,
+                "n_pt": st["n_pt"] + grown * per * frac_pt,
+                "n_rng": st["n_rng"] + grown * per * (1.0 - frac_pt),
+                "tok_lo": st["tok_lo"], "tok_hi": st["tok_hi"]}
+
+    def host_index(self, floor_id):
+        """(ptok, pslot, pcol, rlo, rhi, rslot, rcol): the live-above-floor
+        tail as a token-SORTED point-entry array plus a flat range-entry
+        table — the reference's own scan shape (CommandsForKey sorted
+        arrays + rangeCommands, ref: local/CommandsForKey.java:614-650),
+        rebuilt from the mirror whenever a mutation lands and cached
+        between flushes.  ``pcol``/``rcol`` record each entry's interval
+        column in its slot row, so probes yield exact emit triples and the
+        collect pass never rebuilds the overlap geometry."""
+        fkey = floor_id if floor_id is not None and floor_id > TxnId.NONE \
+            else None
+        key = (fkey, self.version)
+        if self._hidx is not None and self._hidx_key == key:
+            return self._hidx
+        live = (self.status >= 0) & (self.status != dk.SLOT_INVALIDATED)
+        if fkey is not None:
+            live &= self._above_floor_mask(fkey)
+        j = np.nonzero(live)[0]
+        lo, hi = self.lo[j], self.hi[j]
+        used = lo <= hi
+        pt = used & (lo == hi)
+        rr, cc = np.nonzero(pt)
+        ptok = lo[rr, cc]
+        order = np.argsort(ptok, kind="stable")
+        rr2, cc2 = np.nonzero(used & ~pt)
+        self._hidx = (ptok[order], j[rr][order], cc[order],
+                      lo[rr2, cc2], hi[rr2, cc2], j[rr2], cc2)
+        self._hidx_key = key
+        return self._hidx
+
+    def host_pairs(self, qnp: np.ndarray, q_m: int, floor_id):
+        """The host route's candidate generation: (b_idx, j_idx) pairs
+        satisfying the EXACT kernel predicate (liveness + floor structurally
+        via the index; witness / earlier / not-self as vectorized compares
+        identical to the device ts_lt), deduped per (query, slot), plus the
+        exact emit triples (pair row, entry interval column, query interval
+        column) the probes discovered — the same set np.nonzero over the
+        device routes' overlap matrix yields, so attribution sees identical
+        inputs and results are bit-identical by construction."""
+        ptok, pslot, pcol, rlo, rhi, rslot, rcol = self.host_index(floor_id)
+        lo = qnp[:, 7:7 + q_m]
+        hi = qnp[:, 7 + q_m:7 + 2 * q_m]
+        used = lo <= hi
+        qi, mi = np.nonzero(used)
+        flo = lo[qi, mi]
+        fhi = hi[qi, mi]
+        parts_b: List[np.ndarray] = []
+        parts_j: List[np.ndarray] = []
+        parts_m: List[np.ndarray] = []
+        parts_q: List[np.ndarray] = []
+        if len(ptok):
+            # token-sorted probe: every query interval (point OR range)
+            # selects the contiguous token slice it covers
+            l = np.searchsorted(ptok, flo, side="left")
+            r = np.searchsorted(ptok, fhi, side="right")
+            cnt = r - l
+            tot = int(cnt.sum())
+            if tot:
+                owner = np.repeat(np.arange(len(qi)), cnt)
+                starts = np.repeat(l, cnt)
+                offs = np.arange(tot) - np.repeat(np.cumsum(cnt) - cnt, cnt)
+                pos = starts + offs
+                parts_b.append(qi[owner])
+                parts_j.append(pslot[pos])
+                parts_m.append(pcol[pos])
+                parts_q.append(mi[owner])
+        if len(rlo) and len(qi):
+            ov = (rlo[None, :] <= fhi[:, None]) & (flo[:, None] <= rhi[None, :])
+            ii, jj = np.nonzero(ov)
+            parts_b.append(qi[ii])
+            parts_j.append(rslot[jj])
+            parts_m.append(rcol[jj])
+            parts_q.append(mi[ii])
+        empty = (np.zeros(0, np.int64), np.zeros(0, np.int64))
+        if not parts_b:
+            return empty + ((np.zeros(0, np.int64),) * 3,)
+        cb = np.concatenate(parts_b).astype(np.int64)
+        cj = np.concatenate(parts_j).astype(np.int64)
+        cm = np.concatenate(parts_m).astype(np.int64)
+        cq = np.concatenate(parts_q).astype(np.int64)
+        em, el, en = self.msb[cj], self.lsb[cj], self.node[cj]
+        keep = (qnp[cb, 3] >> self.kind[cj]) & 1 > 0
+        uem, ubm = em.astype(np.uint64), qnp[cb, 0].astype(np.uint64)
+        uel, ubl = el.astype(np.uint64), qnp[cb, 1].astype(np.uint64)
+        bn = qnp[cb, 2]
+        keep &= ((uem < ubm) | ((uem == ubm)
+                               & ((uel < ubl) | ((uel == ubl) & (en < bn)))))
+        keep &= ~((em == qnp[cb, 4]) & (el == qnp[cb, 5])
+                  & (en == qnp[cb, 6]))
+        cb, cj, cm, cq = cb[keep], cj[keep], cm[keep], cq[keep]
+        pair, p_i = np.unique(cb * np.int64(self.capacity) + cj,
+                              return_inverse=True)
+        return pair // self.capacity, pair % self.capacity, (p_i, cm, cq)
 
     # -- device sync --------------------------------------------------------
     def device_table_sharded(self, mesh) -> dk.DepsTable:
@@ -599,23 +865,47 @@ def _group_dedupe(cols):
     return order, first
 
 
-def _finalize_key_batch(builders, bb, tt, dm, dl, dn, objs) -> None:
+def _finalize_key_batch(builders, bb, tt, trank, ntok, dkey, ndep,
+                        objs) -> None:
     """Construct every builder's KeyDeps in ONE vectorized pass over the
-    batch's key emits — two lexsorts + shift-compares; per-builder Python
-    touches only group boundaries (the CSR freeze the reference does per
-    reply in KeyDeps.Builder, done batch-wide)."""
+    batch's key emits — integer-composite-key sorts + shift-compares;
+    per-builder Python touches only group boundaries (the CSR freeze the
+    reference does per reply in KeyDeps.Builder, done batch-wide).
+
+    ``trank``/``dkey`` are dense ranks of the token and of the dep's packed
+    id (caller-computed over the batch's unique tokens/slots), so the
+    (builder, token, dep) dedupe and the per-builder dep ordering are
+    single int64 argsorts instead of 5-column lexsorts — the r05 profile
+    put ~40% of hot-regime attribution in those lexsorts."""
     from ..primitives.deps import KeyDeps
     from ..primitives.keys import RoutingKeys
-    o, first = _group_dedupe((dn, dl, dm, tt, bb))
+    nb = int(bb.max()) + 1 if len(bb) else 1
+    if nb * ntok * ndep >= (1 << 62):    # composite would overflow int64
+        key1 = None                      # fall back to column lexsort
+    else:
+        key1 = (bb * ntok + trank) * np.int64(ndep) + dkey
+    if key1 is None:
+        o = np.lexsort((dkey, tt, bb))
+        first = np.ones(len(o), bool)
+        first[1:] = _changed((dkey, tt, bb), o)[1:]
+    else:
+        o = np.argsort(key1, kind="stable")
+        k1 = key1[o]
+        first = np.ones(len(o), bool)
+        first[1:] = k1[1:] != k1[:-1]
     o = o[first]
-    bb, tt, dm, dl, dn, objs = bb[o], tt[o], dm[o], dl[o], dn[o], objs[o]
+    bb, tt, dkey, objs = bb[o], tt[o], dkey[o], objs[o]
     n = len(bb)
-    # per-builder unique deps, ordered by packed id (== TxnId order)
-    o2 = np.lexsort((dn, dl, dm, bb))
+    # per-builder unique deps, ordered by packed id (== TxnId order; dkey
+    # ranks preserve it)
+    key2 = bb * np.int64(ndep) + dkey
+    o2 = np.argsort(key2, kind="stable")
+    k2 = key2[o2]
     b2 = bb[o2]
     newb = np.ones(n, bool)
     newb[1:] = b2[1:] != b2[:-1]
-    newd = newb | _changed((dm, dl, dn), o2)
+    newd = np.ones(n, bool)
+    newd[1:] = k2[1:] != k2[:-1]
     gid = np.cumsum(newd) - 1
     base = np.maximum.accumulate(np.where(newb, gid, 0))
     inv = np.empty(n, np.int64)
@@ -743,10 +1033,24 @@ class DeviceState:
         self.n_kernel_deps = 0
         self.n_mesh_queries = 0
         self.n_bucketed_queries = 0
+        self.n_dense_queries = 0
+        self.n_host_queries = 0
+        self.n_mesh_bucketed_queries = 0
         self.n_dispatches = 0       # kernel dispatches: n_queries /
         #                             n_dispatches = mean lived batch size
+        # routing controls (see module docstring): None = adaptive;
+        # "host" / "dense" pin a route; "device" = adaptive kernels but
+        # never the host route (the pre-routing behavior, used by kernel
+        # equivalence tests).  on_route(route, nq) observes every decision
+        # (utils.trace.Trace.record_route is the sim-side consumer).
+        self.route_override: Optional[str] = None
+        self.on_route = None
         # store-level coalescing queue (enqueue_query/_flush_queries)
         self._q_pending: List[tuple] = []
+        # batch-floor memo keyed on (RedundantBefore.version, window):
+        # repeated flushes over a stable watermark map resolve the prune
+        # floor with one dict hit instead of a segment walk
+        self._floor_memo: Optional[tuple] = None
         # token -> (cfk version, may_elide_any) memo for attribution
         self._elidable_cache: Dict[int, tuple] = {}
         # per-kernel wall timing (SURVEY §5: structured per-kernel timing):
@@ -846,7 +1150,7 @@ class DeviceState:
             return None
         return (txn_id, started_before, witnesses, q_toks, q_rngs)
 
-    def _attribute_batch(self, safe, b_idx, j_idx, overlap, ids, ivs, qnp,
+    def _attribute_batch(self, safe, b_idx, j_idx, pmq, ids, ivs, qnp,
                          queries, builders) -> None:
         """Fold a whole batch's kernel answer into the builders with the
         floors, elision and key/range attribution of the host path: the
@@ -886,12 +1190,10 @@ class DeviceState:
             return ctx
 
         q_m = (qnp.shape[1] - 7) // 2
-        lo_p = lo[j_idx]                               # [P, M]
-        hi_p = hi[j_idx]
-        qlo_p = qnp[b_idx, 7:7 + q_m]                  # [P, Q]
-        qhi_p = qnp[b_idx, 7 + q_m:7 + 2 * q_m]
-        # overlap [P, M, Q] arrives precomputed from the collect pass
-        p_i, m_i, q_i = np.nonzero(overlap)
+        # the exact (pair row, dep-interval col, query-interval col) emit
+        # triples arrive precomputed from the collect pass (host probes or
+        # np.nonzero over the kernel parts' overlap geometry)
+        p_i, m_i, q_i = pmq
         key_dep = (dom[j_idx] == int(Domain.Key))[p_i]
 
         # key-domain deps: emitted at the dep's own footprint point,
@@ -903,8 +1205,8 @@ class DeviceState:
         (msb_a, lsb_a, node_a, obj_a, status_a, xm_a, xl_a, xn_a,
          xk_a) = ids
         if len(kp):
-            tt = lo_p[kp, km]                 # key-domain footprint = point
             jj, bb = j_idx[kp], b_idx[kp]
+            tt = lo[jj, km]                   # key-domain footprint = point
             # vectorized RedundantBefore floor: dep >= floor(token),
             # lexicographic over the packed (msb, lsb, node) triples (the
             # same int64 ordering the kernel's ts_lt assumes)
@@ -915,7 +1217,6 @@ class DeviceState:
                        & ((dlsb > flsb)
                           | ((dlsb == flsb) & (dnode >= fnode)))))
             jj_k, bb_k, tt_k = jj[keep], bb[keep], tt[keep]
-            dmsb_k, dlsb_k, dnode_k = dmsb[keep], dlsb[keep], dnode[keep]
             # object resolution: pure take from the snapshot object column
             deps_k = obj_a[jj_k]
             # VECTORIZED transitive elision (the per-key skip rule,
@@ -952,11 +1253,18 @@ class DeviceState:
             flagged = tok_maybe[inv_t2]
             if flagged.any():
                 f_idx = np.nonzero(flagged)[0]
-                bt = np.stack([bb_k[f_idx], tt_k[f_idx]], axis=1)
-                ubt, inv_bt = np.unique(bt, axis=0, return_inverse=True)
-                pv = np.zeros((len(ubt), 3), np.int64)
-                pv_ok = np.zeros(len(ubt), bool)
-                for i, (b, t) in enumerate(ubt.tolist()):
+                # (builder, token) pairs as ONE int64 composite key over
+                # the token RANKS (np.unique(axis=0) on the raw 2-column
+                # stack cost ~250ms/1k queries in the hot regime — the
+                # void-dtype argsort dominated attribution)
+                ntok2 = len(uniq_t2)
+                key_bt = bb_k[f_idx] * np.int64(ntok2) + inv_t2[f_idx]
+                ubt_key, inv_bt = np.unique(key_bt, return_inverse=True)
+                pv = np.zeros((len(ubt_key), 3), np.int64)
+                pv_ok = np.zeros(len(ubt_key), bool)
+                ub_list = (ubt_key // ntok2).tolist()
+                ut_list = uniq_t2[ubt_key % ntok2].tolist()
+                for i, (b, t) in enumerate(zip(ub_list, ut_list)):
                     ctx = elide_ctx(int(t), queries[b][1])
                     if ctx is not None and ctx[1] is not Timestamp.NONE \
                             and ctx[1] is not None:
@@ -975,18 +1283,30 @@ class DeviceState:
                 elide[f_idx] |= pv_ok[inv_bt] & decided & below
             keep2 = ~elide
             if keep2.any():
+                jj_f = jj_k[keep2]
+                # dense dep ranks over the batch's unique slots, ordered by
+                # the packed id (same signed lexicographic order the old
+                # 5-column lexsort used) — the finalize sorts become single
+                # int64 argsorts
+                u_slots, slot_inv = np.unique(jj_f, return_inverse=True)
+                ordr = np.lexsort((node_a[u_slots], lsb_a[u_slots],
+                                   msb_a[u_slots]))
+                rank = np.empty(len(u_slots), np.int64)
+                rank[ordr] = np.arange(len(u_slots))
                 _finalize_key_batch(builders, bb_k[keep2], tt_k[keep2],
-                                    dmsb_k[keep2], dlsb_k[keep2],
-                                    dnode_k[keep2], deps_k[keep2])
+                                    inv_t2[keep2], len(uniq_t2),
+                                    rank[slot_inv], len(u_slots),
+                                    deps_k[keep2])
 
         # range-domain deps: emit the dep∩query interval clip per pair —
         # batch-finalized (dedupe/sort/CSR in one vectorized pass; Range
         # objects materialize once per unique clip)
         rp, rm, rq = p_i[~key_dep], m_i[~key_dep], q_i[~key_dep]
         if len(rp):
-            ilo = np.maximum(lo_p[rp, rm], qlo_p[rp, rq])
-            ihi = np.minimum(hi_p[rp, rm], qhi_p[rp, rq]) + 1
             jj_r = j_idx[rp]
+            bb_r = b_idx[rp]
+            ilo = np.maximum(lo[jj_r, rm], qnp[bb_r, 7 + rq])
+            ihi = np.minimum(hi[jj_r, rm], qnp[bb_r, 7 + q_m + rq]) + 1
             dmsb_r, dlsb_r, dnode_r = msb_a[jj_r], lsb_a[jj_r], node_a[jj_r]
             # batch-global RedundantBefore floor on range-domain deps (the
             # host analogue of the device prune, applied on EVERY attributed
@@ -1082,6 +1402,150 @@ class DeviceState:
     # dense scan is the better kernel anyway
     BUCKETED = True
 
+    # process-wide route calibration: {"rtt": s, "c_dev": s/elem,
+    # "c_host": s/elem}, measured once by a micro-probe (or injected by
+    # tests via set_route_calibration)
+    _CALIB = None
+
+    @classmethod
+    def set_route_calibration(cls, rtt: float, c_host: float,
+                              c_dev: float,
+                              rtt_mesh: Optional[float] = None) -> None:
+        cls._CALIB = {"rtt": rtt, "c_host": c_host, "c_dev": c_dev,
+                      "rtt_mesh": rtt_mesh if rtt_mesh is not None else rtt}
+
+    @staticmethod
+    def _measure_route_calibration():
+        """The once-per-process micro-probe behind the routing crossover:
+        measures (a) the device round-trip cost (tiny dispatch + download —
+        on a tunneled TPU this is the term that dominates small scans),
+        (b) the device per-element kernel cost (a mid-size dense scan minus
+        the round trip), (c) the host per-element cost of the vectorized
+        numpy predicate the host route runs.  No hard-coded thresholds:
+        the crossover IS these three numbers."""
+        import statistics as _st
+        import time as _time
+        x = jnp.arange(256, dtype=jnp.int64)
+        tiny = jax.jit(lambda a: a + 1)
+        np.asarray(tiny(x))                      # warm + compile
+        rtts = []
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            np.asarray(tiny(x))
+            rtts.append(_time.perf_counter() - t0)
+        rtt = _st.median(rtts)
+        # device per-element: dense flat kernel over a 8192x4 table, B=16
+        cap, b, m = 8192, 16, 4
+        table = dk.empty_table(cap, m)
+        qmat = jnp.asarray(np.zeros((b, 7 + 2 * m), np.int64))
+        np.asarray(dk.calculate_deps_flat(table, qmat, m, 256, 64))
+        runs = []
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            np.asarray(dk.calculate_deps_flat(table, qmat, m, 256, 64))
+            runs.append(_time.perf_counter() - t0)
+        elems = b * cap * m * m
+        c_dev = max(_st.median(runs) - rtt, 1e-9) / elems
+        # host per-element: the predicate compare chain over 64k entries
+        n = 1 << 16
+        a = np.arange(n, dtype=np.int64)
+        c = a[::-1].copy()
+        _ = ((a < c) | ((a == c) & (c < a))).sum()   # warm
+        t0 = _time.perf_counter()
+        reps = 4
+        for _ in range(reps):
+            _ = ((a < c) | ((a == c) & (c < a))).sum()
+        c_host = max((_time.perf_counter() - t0) / (reps * n), 1e-11)
+        return {"rtt": rtt, "c_dev": c_dev, "c_host": c_host}
+
+    @staticmethod
+    def _measure_mesh_rtt(mesh) -> float:
+        """Round-trip cost of ONE tiny shard_map dispatch over ``mesh`` —
+        the mesh analogue of the single-device rtt probe.  A shard_map
+        launch costs far more than a plain dispatch (per-device program
+        launches + collectives plumbing; on the virtual CPU test mesh it is
+        100x+ a single-device call), so pricing mesh routes with the
+        single-device rtt would send tiny sim scans to the mesh the model
+        claims is cheap."""
+        import statistics as _st
+        import time as _time
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.sharded import STORE_AXIS, _shard_map
+        d = int(np.prod(list(mesh.shape.values())))
+        arr = jax.device_put(np.zeros(8 * d, np.int64),
+                             NamedSharding(mesh, P(STORE_AXIS)))
+        fn = jax.jit(_shard_map(lambda a: a + 1, mesh,
+                                (P(STORE_AXIS),), P(STORE_AXIS)))
+        np.asarray(fn(arr))                      # warm + compile
+        rtts = []
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            np.asarray(fn(arr))
+            rtts.append(_time.perf_counter() - t0)
+        return _st.median(rtts)
+
+    def _calibration(self):
+        if DeviceState._CALIB is None:
+            DeviceState._CALIB = self._measure_route_calibration()
+        calib = DeviceState._CALIB
+        if self.mesh is not None and "rtt_mesh" not in calib:
+            calib["rtt_mesh"] = self._measure_mesh_rtt(self.mesh)
+        return calib
+
+    def _choose_route(self, qnp: np.ndarray, q_m: int, floor_id) -> str:
+        """Pick "host" or "device" for this flush by comparing the modeled
+        host-scan cost (live-above-floor working set from the mirror's
+        incremental stats) against the modeled device cost (round trips +
+        the cheaper kernel's element count).  Models, not thresholds: both
+        sides are priced in seconds from the calibration probe."""
+        calib = self._calibration()
+        st = self.deps.floor_stats(floor_id)
+        lo = qnp[:, 7:7 + q_m]
+        hi = qnp[:, 7 + q_m:7 + 2 * q_m]
+        used = lo <= hi
+        n_iv = int(used.sum())
+        nq = qnp.shape[0]
+        # host model: point candidates ~ covered-token-width x density,
+        # plus the [query-interval x range-entry] stab broadcast
+        span = max(st["tok_hi"] - st["tok_lo"] + 1, 1)
+        density = st["n_pt"] / span
+        w = np.where(used,
+                     np.minimum(hi, st["tok_hi"])
+                     - np.maximum(lo, st["tok_lo"]) + 1, 0)
+        est_pt = float(np.clip(w, 0, None).sum()) * density + n_iv * 8.0
+        est_host = est_pt + float(n_iv) * st["n_rng"]
+        # ~6 vectorized passes per candidate (predicate + dedupe sort),
+        # plus a fixed per-flush overhead (probe setup, unique, snapshots)
+        host_cost = calib["c_host"] * (6.0 * est_host + 50_000.0)
+        if self.deps._hidx_key != ((floor_id if floor_id is not None
+                                    and floor_id > TxnId.NONE else None),
+                                   self.deps.version):
+            # index rebuild: one vectorized pass over the live tail
+            host_cost += calib["c_host"] * 4.0 * (st["n_above"]
+                                                  + st["n_pt"] + st["n_rng"])
+        # device model: the cheaper kernel's PER-SHARD element count (wall
+        # clock of a parallel launch = the per-shard work).  The slot table
+        # row-shards, so dense work divides by d; the bucket probe matrix
+        # does NOT — every shard evaluates all nq x (q_m*span*K) bucket
+        # candidates against its row slice, only the wide list splits
+        rtt = calib["rtt"]
+        d = 1
+        if self.mesh is not None:
+            d = max(len(self.mesh.devices.flat), 1)
+            rtt = calib.get("rtt_mesh", rtt)
+        dense_elems = nq * self.deps.capacity * q_m \
+            * self.deps.max_intervals // d
+        if self.BUCKETED and \
+                len(self.deps.wide_entries) <= self.deps.WIDE_MAX:
+            buck_elems = nq * (q_m * self.deps.SPAN * self.deps.BUCKET_K
+                               + len(self.deps.wide_entries) // d)
+            dev_elems = min(dense_elems, buck_elems)
+        else:
+            dev_elems = dense_elems
+        dev_cost = 2.0 * rtt + calib["c_dev"] * dev_elems
+        return "host" if host_cost < dev_cost else "device"
+
     def deps_query_batch_begin(self, queries, immediate: bool = False,
                                prune_floors: bool = False):
         """Dispatch a batched deps scan WITHOUT waiting: one fused query
@@ -1107,28 +1571,49 @@ class DeviceState:
         # conservative batch-global RedundantBefore floor, applied ON
         # DEVICE (the exact floors still run in attribution): in durable-
         # prefix-dominated stores this keeps the CSR to the live tail
-        # instead of shipping redundant history.  Opt-in: the attributed
-        # (protocol) paths enable it; the raw-CSR path documents no floors
-        # and never prunes
+        # instead of shipping redundant history — on EVERY device route,
+        # sharded included (the r05 mesh path hard-disabled this).  Opt-in:
+        # the attributed (protocol) paths enable it; the raw-CSR path
+        # documents no floors and never prunes
         prune = None
+        floor_id = None
         rb = getattr(self.store, "redundant_before", None)
-        if prune_floors and rb is not None and self.mesh is None:
+        if prune_floors and rb is not None:
             lo_cols = qnp[:, 7:7 + q_m]
             hi_cols = qnp[:, 7 + q_m:7 + 2 * q_m]
             used = lo_cols <= hi_cols
             if used.any():
-                f = rb.min_floor_over(int(lo_cols[used].min()),
-                                      int(hi_cols[used].max()))
+                window = (rb.version, int(lo_cols[used].min()),
+                          int(hi_cols[used].max()))
+                if self._floor_memo is not None and \
+                        self._floor_memo[0] == window:
+                    f = self._floor_memo[1]
+                else:
+                    f = rb.min_floor_over(window[1], window[2])
+                    self._floor_memo = (window, f)
                 if f > TxnId.NONE:
+                    floor_id = f
                     prune = (jnp.asarray(to_i64(f.msb)),
                              jnp.asarray(to_i64(f.lsb)),
                              jnp.asarray(np.int32(f.node)))
 
-        def dispatch(kind, rows):
+        def dispatch(kind, rows, qcols=None):
             """rows: np int64 array of query indices for this part, padded
             to a pow2 batch by repeating the last row (pads map to -1)."""
             import time as _time
             _t0 = _time.perf_counter()
+            if kind == "host":
+                # the host route computes its (query, slot) pairs AND the
+                # exact emit triples right here — no device box, no
+                # download thread; the pairs feed the same attribution as
+                # every kernel part
+                b_h, j_h, pmq = self.deps.host_pairs(qnp, q_m, floor_id)
+                self.n_host_queries += len(rows)
+                self.n_dispatches += 1
+                self._ktime("dispatch_host", _t0)
+                parts.append({"kind": "host", "b": b_h, "j": j_h,
+                              "pmq": pmq})
+                return
             b_pad = _pow2_at_least(len(rows), 1)
             rows_p = np.concatenate(
                 [rows, np.full(b_pad - len(rows), rows[-1], np.int64)])
@@ -1143,12 +1628,40 @@ class DeviceState:
                 s = min(self._batch_flat, b_pad * (n // d))
                 k = min(self._batch_k, n // d)
                 qmat = jnp.asarray(qnp[rows_p])
-                from ..parallel.sharded import sharded_calculate_deps_flat
-                out_dev = sharded_calculate_deps_flat(
-                    self.mesh, q_m, s, k)(table, qmat)
+                from ..parallel.sharded import (
+                    sharded_calculate_deps_flat,
+                    sharded_calculate_deps_flat_pruned)
+                if prune is not None:
+                    out_dev = sharded_calculate_deps_flat_pruned(
+                        self.mesh, q_m, s, k)(table, qmat, *prune)
+                else:
+                    out_dev = sharded_calculate_deps_flat(
+                        self.mesh, q_m, s, k)(table, qmat)
                 self.n_mesh_queries += len(rows)
                 part.update(table=table, qmat=qmat, d=d, shard_n=n // d,
-                            s=s, k=k)
+                            s=s, k=k, prune=prune)
+            elif kind == "sharded_bucketed":
+                btable = self.deps.bucket_device_sharded(self.mesh)
+                d = int(np.prod(list(self.mesh.shape.values())))
+                span = self.deps.SPAN
+                # per-shard candidate ceiling: every touched bucket's K
+                # entries plus this shard's slice of the wide list
+                c = (q_m * span * self.deps.BUCKET_K
+                     + btable.wlo.shape[0] // d)
+                s = min(self._batch_flat, b_pad * c)
+                k = min(self._batch_k, c)
+                qb = qcols[rows_p].reshape(b_pad, q_m * span)
+                qmat = jnp.asarray(np.concatenate(
+                    [qnp[rows_p], qb], axis=1))
+                from ..parallel.sharded import sharded_bucketed_flat
+                pz = prune if prune is not None else _prune_zeros()
+                out_dev = sharded_bucketed_flat(
+                    self.mesh, q_m, span, s, k)(btable, qmat, *pz)
+                self.n_mesh_queries += len(rows)
+                self.n_mesh_bucketed_queries += len(rows)
+                part.update(btable=btable, qmat=qmat, d=d, shard_n=c,
+                            s=s, k=k, c=c, span=span, prune=prune,
+                            global_ids=True)
             elif kind == "dense":
                 table = self.deps.device_table()
                 n = table.capacity
@@ -1160,6 +1673,7 @@ class DeviceState:
                         table, qmat, *prune, q_m, s, k)
                 else:
                     out_dev = dk.calculate_deps_flat(table, qmat, q_m, s, k)
+                self.n_dense_queries += len(rows)
                 part.update(table=table, qmat=qmat, d=1, shard_n=n, s=s,
                             k=k, prune=prune)
             else:   # bucketed
@@ -1183,7 +1697,7 @@ class DeviceState:
                 self.n_bucketed_queries += len(rows)
                 part.update(table=table, btable=btable, qmat=qmat, d=1,
                             shard_n=table.capacity, s=s, k=k, c=c,
-                            span=span, prune=prune)
+                            span=span, prune=prune, global_ids=True)
             self.n_dispatches += 1
             self._ktime("dispatch_" + kind, _t0)
             box: Dict[str, object] = {"dev": out_dev}
@@ -1205,17 +1719,40 @@ class DeviceState:
                 part["th"] = th
             parts.append(part)
 
-        if self.mesh is not None:
-            dispatch("sharded", np.arange(nq, dtype=np.int64))
-        elif not self.BUCKETED or \
-                len(self.deps.wide_entries) > self.deps.WIDE_MAX:
-            dispatch("dense", np.arange(nq, dtype=np.int64))
+        all_rows = np.arange(nq, dtype=np.int64)
+        route = self.route_override
+        if route is None:
+            route = self._choose_route(qnp, q_m,
+                                       floor_id if prune_floors else None)
+        if self.on_route is not None:
+            self.on_route(route, nq)
+        else:
+            obs = getattr(self.store.node, "route_observer", None)
+            if obs is not None:
+                obs(self.store, route, nq)
+        degenerate = not self.BUCKETED or \
+            len(self.deps.wide_entries) > self.deps.WIDE_MAX
+        if route == "host":
+            dispatch("host", all_rows)
+        elif self.mesh is not None:
+            if route == "dense" or degenerate:
+                dispatch("sharded", all_rows)
+            else:
+                qcols, wide_q = self._bucket_query_cols(qnp, q_m)
+                narrow = np.nonzero(~wide_q)[0].astype(np.int64)
+                wide = np.nonzero(wide_q)[0].astype(np.int64)
+                if len(narrow):
+                    dispatch("sharded_bucketed", narrow, qcols)
+                if len(wide):
+                    dispatch("sharded", wide)
+        elif route == "dense" or degenerate:
+            dispatch("dense", all_rows)
         else:
             qcols, wide_q = self._bucket_query_cols(qnp, q_m)
             narrow = np.nonzero(~wide_q)[0].astype(np.int64)
             wide = np.nonzero(wide_q)[0].astype(np.int64)
             if len(narrow):
-                dispatch("bucketed", narrow)
+                dispatch("bucketed", narrow, qcols)
             if len(wide):
                 dispatch("dense", wide)
         if immediate:
@@ -1227,6 +1764,20 @@ class DeviceState:
                    self.deps.obj, self.deps.status, self.deps.emsb,
                    self.deps.elsb, self.deps.enode, self.deps.eknown)
             ivs = (self.deps.lo, self.deps.hi, self.deps.domain)
+        elif len(parts) == 1 and parts[0]["kind"] == "host":
+            # host route: the pairs are already known, so snapshot ONLY the
+            # referenced slots (a gather of ~live-tail rows instead of a
+            # full-capacity copy) and remap the pair/slot indices onto the
+            # compact snapshot.  np.unique is sorted, so the remap is
+            # monotonic and the CSR's ascending-slot order — and therefore
+            # every downstream byte — is unchanged
+            part = parts[0]
+            d = self.deps
+            u = np.unique(part["j"])
+            part["j"] = np.searchsorted(u, part["j"])
+            ids = (d.msb[u], d.lsb[u], d.node[u], d.obj[u], d.status[u],
+                   d.emsb[u], d.elsb[u], d.enode[u], d.eknown[u])
+            ivs = (d.lo[u], d.hi[u], d.domain[u])
         else:
             # snapshot the mirror's id + interval columns: the mirror
             # mutates in place, and a slot freed+reallocated between begin
@@ -1286,8 +1837,10 @@ class DeviceState:
         s, k = part["s"], part["k"]
 
         def parse(out, s, k):
-            """Per-shard blocks (total, maxc, row_end[B], entries[s]) with
-            shard-local slot indices; shard 0 alone when unsharded."""
+            """Per-shard blocks (total, maxc, row_end[B], entries[s]); slot
+            indices are shard-local for the slot-sharded kernels (offset by
+            the shard's slice) and GLOBAL for the bucket-indexed kernels
+            (entries embed global slot ids)."""
             blocks = out.reshape(d, 2 + nq + s)
             if int(blocks[:, 0].max()) > s or int(blocks[:, 1].max()) > k:
                 return None
@@ -1298,8 +1851,8 @@ class DeviceState:
                 counts = np.diff(row_end, prepend=0)
                 bs.append(np.repeat(np.arange(nq), counts))
                 js.append(blocks[i, 2 + nq:2 + nq + total].astype(np.int64)
-                          + (i * shard_n if part["kind"] != "bucketed"
-                             else 0))
+                          + (0 if part.get("global_ids")
+                             else i * shard_n))
             return np.concatenate(bs), np.concatenate(js)
 
         if th is not None:
@@ -1325,9 +1878,27 @@ class DeviceState:
                 k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
                         shard_n)
                 self._batch_k = max(self._batch_k, k)
-                from ..parallel.sharded import sharded_calculate_deps_flat
-                out = np.asarray(sharded_calculate_deps_flat(
-                    self.mesh, q_m, s, k)(part["table"], part["qmat"]))
+                from ..parallel.sharded import (
+                    sharded_calculate_deps_flat,
+                    sharded_calculate_deps_flat_pruned)
+                pr = part["prune"]
+                if pr is not None:
+                    out = np.asarray(sharded_calculate_deps_flat_pruned(
+                        self.mesh, q_m, s, k)(part["table"], part["qmat"],
+                                              *pr))
+                else:
+                    out = np.asarray(sharded_calculate_deps_flat(
+                        self.mesh, q_m, s, k)(part["table"], part["qmat"]))
+            elif part["kind"] == "sharded_bucketed":
+                k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
+                        part["c"])
+                self._batch_k = max(self._batch_k, k)
+                from ..parallel.sharded import sharded_bucketed_flat
+                pr = part["prune"]
+                pz = pr if pr is not None else _prune_zeros()
+                out = np.asarray(sharded_bucketed_flat(
+                    self.mesh, q_m, part["span"], s, k)(part["btable"],
+                                                        part["qmat"], *pz))
             elif part["kind"] == "dense":
                 k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
                         shard_n)
@@ -1366,19 +1937,39 @@ class DeviceState:
         host-side EXACT geometry pass over the coarse pairs — the kernel's
         bounding-box mask admits a query sitting inside a slot's interval
         gap; the vectorized overlap here drops those and hands the
-        surviving (pair, dep-interval, query-interval) triples to
-        attribution.  Re-runs use the table snapshot captured at begin —
-        registrations interleaved between begin and end must not shift the
-        queried snapshot."""
+        surviving (pair, dep-interval, query-interval) emit triples to
+        attribution.  The host route skips the geometry entirely: its
+        probes are exact, so its pairs and triples arrive precomputed.
+        Re-runs use the table snapshot captured at begin — registrations
+        interleaved between begin and end must not shift the queried
+        snapshot."""
         (parts, ids, ivs, qnp, q_m, queries) = handle
         import time as _time
         nq = len(queries)
+        if len(parts) == 1 and parts[0]["kind"] == "host":
+            part = parts[0]
+            b_idx, j_idx = part["b"], part["j"]
+            self.n_queries += nq
+            self.n_kernel_deps += len(j_idx)
+            return b_idx, j_idx, part["pmq"], ids, ivs, qnp, queries
         outs = [self._collect_part(p) for p in parts]
         _tg = _time.perf_counter()
         b_idx = np.concatenate([o[0] for o in outs]) if outs else \
             np.zeros(0, np.int64)
         j_idx = np.concatenate([o[1] for o in outs]) if outs else \
             np.zeros(0, np.int64)
+        # global (query, slot) dedupe: the in-kernel dedupe is per-part
+        # only — under the row-sharded bucket index one slot can surface
+        # from several shards.  np.unique's sorted order (b-major, slot
+        # ascending) matches the per-part CSR order, so results are
+        # byte-identical with or without this pass; it is skipped when a
+        # single already-unique part answered the batch (slot-sharded CSRs
+        # are unique by construction)
+        if len(j_idx) and (len(parts) > 1
+                           or parts[0]["kind"] == "sharded_bucketed"):
+            cap = np.int64(len(ids[0]))
+            pair = np.unique(b_idx * cap + j_idx)
+            b_idx, j_idx = pair // cap, pair % cap
         # exact geometry on the sparse pair list
         lo, hi, _dom = ivs
         lo_p, hi_p = lo[j_idx], hi[j_idx]                       # [P, M]
@@ -1388,12 +1979,18 @@ class DeviceState:
         overlap = (used[:, :, None]
                    & (lo_p[:, :, None] <= qhi_p[:, None, :])
                    & (qlo_p[:, None, :] <= hi_p[:, :, None]))   # [P, M, Q]
-        keep = overlap.any(axis=(1, 2))
-        b_idx, j_idx, overlap = b_idx[keep], j_idx[keep], overlap[keep]
-        self.n_queries += len(queries)
+        p_i, m_i, q_i = np.nonzero(overlap)
+        # drop pairs with no exact overlap (bounding-box false positives)
+        present = np.zeros(len(j_idx), bool)
+        present[p_i] = True
+        if not present.all():
+            new_pos = np.cumsum(present) - 1
+            b_idx, j_idx = b_idx[present], j_idx[present]
+            p_i = new_pos[p_i]
+        self.n_queries += nq
         self.n_kernel_deps += len(j_idx)
         self._ktime("host_geometry", _tg)
-        return b_idx, j_idx, overlap, ids, ivs, qnp, queries
+        return b_idx, j_idx, (p_i, m_i, q_i), ids, ivs, qnp, queries
 
     def deps_query_batch_end(self, handle):
         """Raw packed-CSR collection (no floors/attribution) — the transport
@@ -1468,6 +2065,20 @@ class DeviceState:
             self.drain.active[slot] = False
             self.drain.clear_deps(slot)
 
+    def _mesh_tick_pays(self, n: int) -> bool:
+        """Regime-adaptive drain tick: row-shard the frontier sweep only
+        when the modeled per-shard matvec saving (n^2 work split d ways)
+        beats the extra shard_map launch cost — the same calibration the
+        deps router uses.  Tiny in-flight sets (the common sim/tick shape)
+        otherwise pay a 100x launch premium per tick on the virtual CPU
+        mesh; at-scale dense drains still shard."""
+        calib = self._calibration()
+        d = max(len(self.mesh.devices.flat), 1)
+        single = 2.0 * calib["rtt"] + calib["c_dev"] * float(n) * n
+        mesh = 2.0 * calib.get("rtt_mesh", calib["rtt"]) \
+            + calib["c_dev"] * float(n) * n / d
+        return mesh < single
+
     # Coalescing quantum for drain ticks (simulated/real micros): many dep
     # transitions land per tick, so the per-tick adjacency upload + kernel
     # sweep amortizes across a whole antichain instead of firing per event.
@@ -1498,7 +2109,8 @@ class DeviceState:
             # large in-flight set: sparse gather sweep (no [N, N] anywhere)
             ready = np.asarray(drk.ready_frontier_ell(state))[: len(live)]
         elif self.mesh is not None and \
-                state.status.shape[0] % len(self.mesh.devices.flat) == 0:
+                state.status.shape[0] % len(self.mesh.devices.flat) == 0 \
+                and self._mesh_tick_pays(state.status.shape[0]):
             # live mesh path: the frontier sweep row-shards across devices
             # (the fixpoint analogue is parallel.sharded.sharded_drain)
             from ..parallel.sharded import sharded_ready_frontier

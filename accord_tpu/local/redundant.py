@@ -91,12 +91,18 @@ class RedundantEntry:
 
 
 class RedundantBefore:
-    """Range-keyed redundancy watermarks (ref: local/RedundantBefore.java:49)."""
+    """Range-keyed redundancy watermarks (ref: local/RedundantBefore.java:49).
 
-    __slots__ = ("_map",)
+    ``version`` increments on every watermark mutation: the deps-scan router
+    (local/device_index.py) keys its incremental live-above-floor estimate on
+    it, so detecting "the floor moved" is O(1) per dispatch instead of a
+    re-derivation of the floor map."""
+
+    __slots__ = ("_map", "version")
 
     def __init__(self):
         self._map: ReducingRangeMap = ReducingRangeMap.empty()
+        self.version = 0
 
     def add_redundant(self, ranges: Ranges, redundant_before: TxnId) -> None:
         """Advance the SHARD-applied watermark (ref: markShardDurable)."""
@@ -111,6 +117,7 @@ class RedundantBefore:
 
     def _merge(self, ranges: Ranges, entry: RedundantEntry) -> None:
         self._map = self._map.add(ranges, entry, lambda a, b: a.merge(b))
+        self.version += 1
 
     def shard_redundant_ranges(self, txn_id: TxnId,
                                within: Ranges) -> Ranges:

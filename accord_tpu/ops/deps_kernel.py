@@ -168,15 +168,25 @@ from functools import partial
 
 def _compact_topk(dep_mask: jnp.ndarray, k: int):
     """Mask -> (idx int32[B, k] ascending slot indices padded with -1,
-    counts int32[B]) via top_k — the TPU-native compaction shared by every
-    indices path.  score = n - col for set bits, 0 otherwise, so top_k
-    yields ascending column order among hits and pads with zeros."""
+    counts int32[B]) — the compaction shared by every indices path.
+
+    On TPU this is top_k (score = n - col for set bits, 0 otherwise, so
+    top_k yields ascending column order among hits and pads with zeros).
+    XLA's CPU top_k lowers to a pathological ~10x-slower loop than its
+    sort, so the CPU backend (the virtual test/bench mesh) compacts by
+    sorting set-bit columns ascending instead — identical output, chosen
+    at trace time."""
     n = dep_mask.shape[1]
     col = jnp.arange(n, dtype=jnp.int32)
-    scores = jnp.where(dep_mask, n - col, 0)
-    top, _ = jax.lax.top_k(scores, k)
-    idx = jnp.where(top > 0, n - top, -1)
     counts = jnp.sum(dep_mask, axis=1, dtype=jnp.int32)
+    if jax.default_backend() == "cpu":
+        cols = jnp.where(dep_mask, col, jnp.int32(n))
+        cols = jax.lax.slice_in_dim(jnp.sort(cols, axis=1), 0, min(k, n), axis=1)
+        idx = jnp.where(cols < n, cols, -1)
+    else:
+        scores = jnp.where(dep_mask, n - col, 0)
+        top, _ = jax.lax.top_k(scores, k)
+        idx = jnp.where(top > 0, n - top, -1)
     return idx, counts
 
 
@@ -318,17 +328,27 @@ def _entry_pred(query: DepsQuery, ov, slot, emsb, elsb, enode, ekind,
 
 
 def bucketed_flat(table: DepsTable, buckets: BucketTable, qmat: jnp.ndarray,
-                  m: int, span: int, s: int, k: int, prune=None) -> jnp.ndarray:
+                  m: int, span: int, s: int, k: int, prune=None,
+                  row_offset=None) -> jnp.ndarray:
     """Bucket-indexed batched deps scan -> packed CSR (header(total, maxc),
     row_end[B], entries[s]) — same layout as flat_csr_local, d=1.
 
     ``qmat`` carries the standard query columns plus m*span bucket-row
     columns (int64, -1 = no bucket) appended by the host packer.  ``table``
     is unused on the device (kept in the signature so dispatch snapshots
-    stay uniform across kernels); all predicate data rides in ``buckets``."""
+    stay uniform across kernels; may be None); all predicate data rides in
+    ``buckets``.  ``row_offset`` translates GLOBAL bucket rows to this
+    shard's local rows under a row-sharded BucketTable (shard_map passes
+    ``axis_index * local_rows``): rows outside the local slice become -1
+    (no bucket here) — the union over shards covers every global row."""
     query = query_from_qmat(qmat, m)
     b = qmat.shape[0]
     qbuck = qmat[:, 7 + 2 * m:].astype(jnp.int32)          # [B, m*span]
+    if row_offset is not None:
+        n_local = buckets.blo.shape[0]
+        local = qbuck - row_offset
+        qbuck = jnp.where((qbuck >= 0) & (local >= 0) & (local < n_local),
+                          local, -1)
     g = jnp.clip(qbuck, 0)
     has = qbuck >= 0                                        # [B, m*span]
     # bucket candidates: every entry of every touched bucket, each checked
@@ -375,14 +395,23 @@ def bucketed_flat(table: DepsTable, buckets: BucketTable, qmat: jnp.ndarray,
     # compact the unique survivors to the row's first k columns via top_k
     # (scattering all B*C candidate positions directly is pathologically
     # slow on TPU; the top_k keeps the scatter at B*k elements) — unique
-    # survivors keep ascending slot order because scores descend with col
+    # survivors keep ascending slot order because scores descend with col.
+    # On the CPU backend top_k itself is the pathology (~10x a sort), so
+    # the virtual-mesh path sorts set columns ascending instead — same
+    # output, chosen at trace time
     c = hit.shape[1]
     k = min(k, c)
     col = jnp.arange(c, dtype=jnp.int32)
-    scores = jnp.where(uniq, c - col, 0)
-    top, tidx = jax.lax.top_k(scores, k)                    # [B, k]
-    vals = jnp.take_along_axis(hit, tidx, axis=1)
-    valid = top > 0
+    if jax.default_backend() == "cpu":
+        cols = jnp.where(uniq, col, jnp.int32(c))
+        cols = jax.lax.slice_in_dim(jnp.sort(cols, axis=1), 0, k, axis=1)
+        vals = jnp.take_along_axis(hit, jnp.minimum(cols, c - 1), axis=1)
+        valid = cols < c
+    else:
+        scores = jnp.where(uniq, c - col, 0)
+        top, tidx = jax.lax.top_k(scores, k)                # [B, k]
+        vals = jnp.take_along_axis(hit, tidx, axis=1)
+        valid = top > 0
     pos = starts[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
     pos = jnp.where(valid & (pos < s), pos, s)
     flat = jnp.full(s + 1, -1, jnp.int32).at[pos.reshape(-1)] \

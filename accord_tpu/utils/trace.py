@@ -28,9 +28,29 @@ class Trace:
             return
         self.events.append((next(self._clock), sim_now, kind, src, dst, what))
 
+    def record_route(self, sim_now: int, node_id: int, store_id: int,
+                     route: str, nq: int) -> None:
+        """One deps-scan routing decision (DeviceState.on_route): the
+        coarse route ("host", "device" — kernel picked downstream, or a
+        pinned "dense") that served a flush of ``nq`` queries — the
+        observable trail regime-routing regressions show up in (src =
+        node, dst = store; exact kernel mix lives in the DeviceState
+        n_*_queries counters)."""
+        self.record(sim_now, "DEPS_ROUTE", node_id, store_id,
+                    f"{route} x{nq}")
+
     # -- queries -------------------------------------------------------------
     def for_txn(self, needle: str) -> List[Tuple[int, int, str, int, int, str]]:
         return [e for e in self.events if needle in e[5]]
+
+    def route_counts(self) -> Dict[str, int]:
+        """route -> total queries routed, summed over DEPS_ROUTE events."""
+        out: Dict[str, int] = {}
+        for _lc, _t, kind, _s, _d, what in self.events:
+            if kind == "DEPS_ROUTE":
+                route, _x, n = what.rpartition(" x")
+                out[route] = out.get(route, 0) + int(n)
+        return out
 
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = {}

@@ -22,7 +22,12 @@ repetitions; the reported value is the MEDIAN (min on stderr);
 insert+query interleaving (live table maintenance) is measured separately
 and reported on stderr.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — as the
+LAST stdout line, via a single buffered writer (Emitter) that also carries
+every ``# CONFIG`` row: the r05 artifact lost its headline because stderr
+printed after the stdout headline pushed it out of the driver's tail window
+(VERDICT Weak #2).  The writer fails loudly (exit 2) if the headline metric
+never landed.
 """
 
 import json
@@ -31,6 +36,51 @@ import sys
 import time
 
 import numpy as np
+
+
+class Emitter:
+    """Single buffered writer for the bench's record: diagnostics and
+    ``# CONFIG`` rows buffer to stderr, the headline JSON is emitted as the
+    FINAL stdout line at flush, and a missing headline is a hard failure —
+    the driver-captured artifact can never again silently drop the round's
+    one number."""
+
+    def __init__(self):
+        self._notes = []
+        self._configs = []
+        self._headline = None
+
+    def note(self, text: str) -> None:
+        self._notes.append(text)
+
+    def config(self, row: dict) -> None:
+        self._configs.append(row)
+
+    def headline(self, row: dict) -> None:
+        self._headline = row
+        # insurance copy NOW: the secondary config benches run for minutes
+        # after the primary measurement, and a driver-side SIGKILL midway
+        # must not lose the round's one number.  flush_and_check re-emits
+        # it as the FINAL stdout line, which is the copy the driver's
+        # tail-parser sees on a clean exit
+        print(json.dumps(row))
+        sys.stdout.flush()
+
+    def flush_and_check(self) -> None:
+        for t in self._notes:
+            print(t, file=sys.stderr)
+        for row in self._configs:
+            print("# CONFIG " + json.dumps(row), file=sys.stderr)
+        sys.stderr.flush()
+        if not (isinstance(self._headline, dict)
+                and self._headline.get("metric")
+                and self._headline.get("value") is not None):
+            print(json.dumps({"error": "BENCH FAILED: headline metric "
+                                       "absent from artifact"}))
+            sys.stdout.flush()
+            raise SystemExit(2)
+        print(json.dumps(self._headline))
+        sys.stdout.flush()
 
 
 def build_workload(rng, n, keyspace, max_iv):
@@ -373,12 +423,16 @@ def bench_hot_keys():
              "deps_found": n_deps, "build_rate": round(build_rate, 0),
              "baseline_qps": round(host_rate3, 1),
              "baseline_pairs": base_pairs,
+             "routes": {"host": dev.n_host_queries,
+                        "bucketed": dev.n_bucketed_queries,
+                        "dense": dev.n_dense_queries,
+                        "mesh": dev.n_mesh_queries},
              "note": "low-live-set regime: 90% of the 100k is below the "
-                     "durable floor, so the host bisect over ~10k live "
-                     "entries outruns the device round trips; the device "
-                     "side also performs CFK elision the baseline skips "
-                     "(225 vs 322 deps/query).  The at-scale regime is "
-                     "the headline metric."},
+                     "durable floor, so the adaptive router serves the "
+                     "scan from the host tail (same floors/elision/"
+                     "attribution, bit-identical results) instead of "
+                     "paying device round trips per flush; the routes "
+                     "field records the actual dispatch mix."},
             {"config": 3,
              "metric": "hot_chain_drain_100k_ell_txns_per_sec",
              "value": round(ell_rate, 1), "unit": "txn/s",
@@ -430,23 +484,42 @@ def config4_child():
         toks = [shard * SHARD_WIDTH + int(t)
                 for t in rng.integers(0, SHARD_WIDTH, 2)]
         queries.append((bound, bound, bound.kind().witnesses(), toks, []))
-    dev.deps_query_batch_attributed(safe, queries,     # warmup + compile
-                                    [DepsBuilder() for _ in queries])
-    t1 = _t.time()
-    reps = 4
-    for _i in range(reps):
+    def timed(route, reps=4):
+        """Median-free quick rate for one pinned (or adaptive) route:
+        warmup (compile + learn s/k + build the host index) then reps."""
+        dev.route_override = route
         dev.deps_query_batch_attributed(safe, queries,
                                         [DepsBuilder() for _ in queries])
-    q_rate = B4 * reps / (_t.time() - t1)
+        t1 = _t.time()
+        for _i in range(reps):
+            dev.deps_query_batch_attributed(safe, queries,
+                                            [DepsBuilder() for _ in queries])
+        return B4 * reps / (_t.time() - t1)
+
+    # the headline value is the ADAPTIVE router's rate; the pinned rates
+    # record what each mesh kernel and the host tail deliver on the same
+    # store, so the mesh-parity margin is visible in every artifact
+    mesh_bucketed_rate = timed("device")
+    assert dev.n_mesh_bucketed_queries > 0, \
+        "config4 never exercised the sharded bucketed kernel"
+    mesh_dense_rate = timed("dense")
+    host_rate = timed("host")
+    routes = []
+    dev.on_route = lambda route, nq: routes.append(route)
+    q_rate = timed(None)
     print(json.dumps({
         "config": 4,
         "metric": "mesh8_64shard_replay_query_txns_per_sec",
         "value": round(q_rate, 1), "unit": "txn/s",
+        "routed": sorted(set(routes)),
+        "mesh_bucketed_qps": round(mesh_bucketed_rate, 1),
+        "mesh_dense_qps": round(mesh_dense_rate, 1),
+        "host_route_qps": round(host_rate, 1),
         "replay_register_rate": round(replay_rate, 1),
         "mesh_devices": 8, "platform": "cpu-mesh (v5e-8 not reachable)"}))
 
 
-def main():
+def main(em: Emitter):
     from accord_tpu.ops.packing import enable_x64
     enable_x64()
     import jax
@@ -585,7 +658,7 @@ def main():
     host_rate = statistics.median(host_rates)
     host_spread = max(host_rates) / min(host_rates)
 
-    print(json.dumps({
+    em.headline({
         "metric": "preaccept_deps_calc_txns_per_sec_100k_inflight"
                   if on_tpu else
                   "preaccept_deps_calc_txns_per_sec_20k_inflight_cpu",
@@ -593,48 +666,52 @@ def main():
         "unit": "txn/s",
         "vs_baseline": round(dev_med / host_rate, 2),
         "vs_baseline_kind": "host-numpy",
-    }))
+    })
     pb = {k: 1e3 * v / n_phase_batches for k, v in phases.items()}
     kt = {k: f"{1e3 * sec / max(calls, 1):.1f}ms x{calls}"
           for k, (calls, sec) in sorted(dev.kernel_times.items())}
-    print(f"# device={jax.devices()[0].platform} N={N} B={B} "
-          f"queries_per_rep={B * BATCHES} reps={REPS}\n"
-          f"# dev_median={dev_med:.1f}/s dev_min={dev_min:.1f}/s "
-          f"spread={max(rates) / min(rates):.2f}x\n"
-          f"# phase breakdown (ms/batch of {B}, wall, phases overlap via "
-          f"double-buffering): begin(pack+upload+dispatch)={pb['begin']:.1f} "
-          f"collect(download+parse+geometry+attribute)={pb['collect']:.1f} "
-          f"csr_freeze={pb['build']:.1f}\n"
-          f"# kernel timing (wall mean per call): {kt}\n"
-          f"# index: bucketed_queries={dev.n_bucketed_queries} "
-          f"dispatches={dev.n_dispatches} "
-          f"wide_entries={len(dev.deps.wide_entries)} "
-          f"buckets={len(dev.deps.bucket_entries)}\n"
-          f"# build={build_rate:.0f} reg/s live_insert+query={live_rate:.0f} op/s\n"
-          f"# baseline=host indexed scan (numpy-vectorized reference "
-          f"semantics) {host_rate:.1f} q/s median of 5x{len(hq)} queries, "
-          f"spread={host_spread:.2f}x; vs_baseline_kind=host-numpy: the JVM "
-          f"baseline is unavailable (zero-egress env cannot resolve the "
-          f"reference's gradle deps)\n"
-          f"# methodology (r05): device side runs the live protocol store "
-          f"through the bucketed device interval index (CINTIA-analogue) "
-          f"with floors + elision + attribution + CSR freeze; baseline "
-          f"materializes (key, dep) pair lists (CSR freeze not charged to "
-          f"the baseline — generous)",
-          file=sys.stderr)
+    em.note(
+        f"# device={jax.devices()[0].platform} N={N} B={B} "
+        f"queries_per_rep={B * BATCHES} reps={REPS}\n"
+        f"# dev_median={dev_med:.1f}/s dev_min={dev_min:.1f}/s "
+        f"spread={max(rates) / min(rates):.2f}x\n"
+        f"# phase breakdown (ms/batch of {B}, wall, phases overlap via "
+        f"double-buffering): begin(pack+upload+dispatch)={pb['begin']:.1f} "
+        f"collect(download+parse+geometry+attribute)={pb['collect']:.1f} "
+        f"csr_freeze={pb['build']:.1f}\n"
+        f"# kernel timing (wall mean per call): {kt}\n"
+        f"# index: host_queries={dev.n_host_queries} "
+        f"bucketed_queries={dev.n_bucketed_queries} "
+        f"dense_queries={dev.n_dense_queries} "
+        f"mesh_queries={dev.n_mesh_queries} "
+        f"mesh_bucketed_queries={dev.n_mesh_bucketed_queries} "
+        f"dispatches={dev.n_dispatches} "
+        f"wide_entries={len(dev.deps.wide_entries)} "
+        f"buckets={len(dev.deps.bucket_entries)}\n"
+        f"# build={build_rate:.0f} reg/s live_insert+query={live_rate:.0f} op/s\n"
+        f"# baseline=host indexed scan (numpy-vectorized reference "
+        f"semantics) {host_rate:.1f} q/s median of 5x{len(hq)} queries, "
+        f"spread={host_spread:.2f}x; vs_baseline_kind=host-numpy: the JVM "
+        f"baseline is unavailable (zero-egress env cannot resolve the "
+        f"reference's gradle deps)\n"
+        f"# methodology (r06): every deps flush is ROUTED adaptively "
+        f"(host tail scan / bucketed CINTIA-analogue / dense kernel; see "
+        f"# index counters) with floors + elision + attribution + CSR "
+        f"freeze on every route; baseline materializes (key, dep) pair "
+        f"lists (CSR freeze not charged to the baseline — generous)")
 
-    # -- BASELINE configs[0]/[1]/[3]/[4]: secondary metrics (stderr; the
-    #    driver contract keeps stdout to the ONE headline JSON line) --------
+    # -- BASELINE configs[0]/[1]/[3]/[4]: secondary metrics (buffered; the
+    #    driver contract keeps stdout to the ONE headline JSON line, last) --
     try:
         for row in bench_maelstrom_configs():
-            print("# CONFIG " + json.dumps(row), file=sys.stderr)
+            em.config(row)
     except Exception as e:   # secondary metric must not sink the headline
-        print(f"# CONFIG 0/1 failed: {e!r}", file=sys.stderr)
+        em.note(f"# CONFIG 0/1 failed: {e!r}")
     try:
         for row in bench_hot_keys():
-            print("# CONFIG " + json.dumps(row), file=sys.stderr)
+            em.config(row)
     except Exception as e:
-        print(f"# CONFIG 3 failed: {e!r}", file=sys.stderr)
+        em.note(f"# CONFIG 3 failed: {e!r}")
     try:
         import os
         import subprocess
@@ -649,12 +726,11 @@ def main():
             capture_output=True, text=True, timeout=420)
         for line in child.stdout.splitlines():
             if line.strip().startswith("{"):
-                print("# CONFIG " + line.strip(), file=sys.stderr)
+                em.config(json.loads(line.strip()))
         if child.returncode != 0:
-            print(f"# CONFIG 4 failed: {child.stderr[-400:]}",
-                  file=sys.stderr)
+            em.note(f"# CONFIG 4 failed: {child.stderr[-400:]}")
     except Exception as e:
-        print(f"# CONFIG 4 failed: {e!r}", file=sys.stderr)
+        em.note(f"# CONFIG 4 failed: {e!r}")
 
 
 if __name__ == "__main__":
@@ -668,4 +744,19 @@ if __name__ == "__main__":
         _jax.config.update("jax_enable_x64", True)
         config4_child()
     else:
-        main()
+        _em = Emitter()
+        try:
+            main(_em)
+        except BaseException:
+            # flush whatever was recorded, then let the REAL failure's
+            # traceback propagate (a bare flush in a finally would replace
+            # it with the less informative missing-headline SystemExit)
+            try:
+                _em.flush_and_check()
+            except SystemExit:
+                pass
+            raise
+        else:
+            # the buffered record is the artifact: CONFIG rows + the
+            # headline as the LAST stdout line, or a loud exit(2)
+            _em.flush_and_check()

@@ -17,3 +17,39 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+# -- shared DeviceState test fixture --------------------------------------
+# The routing/mesh/perf tiers all drive a bare DeviceState against the
+# minimal store surface its attribution touches; one definition here keeps
+# the store contract in a single place (a new required store attribute is
+# a one-line change, not a five-file hunt).
+
+
+class DeviceTestStore:
+    def __init__(self):
+        from accord_tpu.local.redundant import RedundantBefore
+        self.commands_for_key = {}
+        self.redundant_before = RedundantBefore()
+
+    class node:
+        scheduler = None
+
+
+class DeviceTestSafe:
+    def __init__(self, store):
+        self.store = store
+
+    def redundant_before(self):
+        return self.store.redundant_before
+
+
+def make_device_state(mesh="auto"):
+    """(store, DeviceState, safe) — ``mesh=None`` pins the single-device
+    path under the test mesh; "auto" keeps DeviceState's own choice."""
+    from accord_tpu.local.device_index import DeviceState
+    store = DeviceTestStore()
+    dev = DeviceState(store)
+    if mesh is None:
+        dev.mesh = None
+    return store, dev, DeviceTestSafe(store)

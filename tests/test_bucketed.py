@@ -11,35 +11,21 @@ import numpy as np
 import pytest
 
 from accord_tpu.local.commands_for_key import InternalStatus
-from accord_tpu.local.device_index import DeviceState, _DepsMirror
-from accord_tpu.local.redundant import RedundantBefore
+from accord_tpu.local.device_index import _DepsMirror
 from accord_tpu.primitives.deps import DepsBuilder
 from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges
 from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
 
 
-class _Store:
-    def __init__(self):
-        self.commands_for_key = {}
-        self.redundant_before = RedundantBefore()
-
-    class node:
-        scheduler = None
-
-
-class _Safe:
-    def __init__(self, store):
-        self.store = store
-
-    def redundant_before(self):
-        return self.store.redundant_before
+from tests.conftest import make_device_state
 
 
 def _mk_state():
-    store = _Store()
-    dev = DeviceState(store)
-    dev.mesh = None          # pin the single-device path under the test mesh
-    return store, dev, _Safe(store)
+    # pin the single-device path under the test mesh; these tests target
+    # the device kernels — host-route equivalence lives in test_routing.py
+    store, dev, safe = make_device_state(mesh=None)
+    dev.route_override = "device"
+    return store, dev, safe
 
 
 def _workload(rng, n, keyspace, hot_frac=0.0, wide_frac=0.0):

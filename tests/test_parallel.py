@@ -93,14 +93,19 @@ def test_sharded_drain_matches_unsharded(mesh):
 
 def test_live_protocol_uses_mesh_sharded_scan():
     """Under the conftest's 8-device CPU mesh, DeviceState auto-shards the
-    deps table: EVERY live deps scan must go through the shard_map path
-    (n_mesh_queries == n_queries), proving the mesh is a protocol-path
-    capability, not a sidecar (round-3 verdict gap #2)."""
+    deps table: with the device route pinned (the adaptive router may
+    legitimately serve tiny sim scans from the host tail), EVERY live deps
+    scan must go through the shard_map path (n_mesh_queries == n_queries),
+    proving the mesh is a protocol-path capability, not a sidecar
+    (round-3 verdict gap #2)."""
     from accord_tpu.sim.cluster import Cluster
     from accord_tpu.sim.kvstore import KVDataStore, kv_txn
     from accord_tpu.sim.topology_factory import build_topology
     cluster = Cluster(topology=build_topology(1, (1, 2, 3), 3, 4), seed=9,
                       data_store_factory=KVDataStore, device_mode=True)
+    for node in cluster.nodes.values():
+        for s in node.command_stores.stores:
+            s.device.route_override = "device"
     out = []
     for i in range(8):
         cluster.nodes[1 + (i % 3)].coordinate(
@@ -114,3 +119,90 @@ def test_live_protocol_uses_mesh_sharded_scan():
             total += s.device.n_queries
             mesh += s.device.n_mesh_queries
     assert total > 0 and mesh == total, (mesh, total)
+
+
+def _mirror_store(rng, n, keyspace, wide_frac=0.1):
+    """A _DepsMirror-backed DeviceState populated with a mixed live +
+    invalidated workload (mesh left at the conftest default)."""
+    from accord_tpu.local.commands_for_key import InternalStatus
+    from accord_tpu.primitives.keys import IntKey, Keys, Ranges
+    from tests.conftest import make_device_state
+
+    store, dev, _safe = make_device_state()
+    hlcs = rng.choice(np.arange(1, 20 * n), size=n, replace=False)
+    for i in range(n):
+        kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
+        if rng.random() < wide_frac:
+            s = int(rng.integers(0, keyspace // 2))
+            toks, rngs = [], [Range(s, s + keyspace // 3)]
+            dom = Domain.Range
+        elif rng.random() < 0.5:
+            toks = [int(t) for t in rng.integers(0, keyspace,
+                                                 rng.integers(1, 4))]
+            rngs, dom = [], Domain.Key
+        else:
+            s = int(rng.integers(0, keyspace - 60))
+            toks, rngs = [], [Range(s, s + int(rng.integers(1, 60)))]
+            dom = Domain.Range
+        tid = TxnId.create(1, int(hlcs[i]), kind, dom, 1 + i % 5)
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+        if rng.random() < 0.1:
+            dev.update_status(tid, int(InternalStatus.INVALIDATED))
+    return store, dev
+
+
+def _mesh_queries(rng, nq, keyspace, n):
+    qs = []
+    for _ in range(nq):
+        bound = TxnId.create(1, int(rng.integers(20 * n, 40 * n)),
+                             TxnKind.Write, Domain.Key, 1)
+        toks = [int(t) for t in rng.integers(0, keyspace, 2)]
+        s = int(rng.integers(0, keyspace - 40))
+        qs.append((bound, bound, bound.kind().witnesses(), toks,
+                   [Range(s, s + 40)]))
+    return qs
+
+
+@pytest.mark.parametrize("prune", [False, True])
+def test_sharded_bucketed_and_pruned_match_single_device(mesh, prune):
+    """The mesh-sharded bucketed kernel (row-sharded BucketTable +
+    replicated floor) and the pruned sharded dense kernel must produce the
+    SAME packed CSR as the single-device device route, bit for bit, through
+    the full dispatch/collect/dedupe stack."""
+    from accord_tpu.primitives.keys import Range as _Range, Ranges
+    from accord_tpu.primitives.timestamp import TxnKind as _K
+
+    rng = np.random.default_rng(61 if prune else 59)
+    keyspace = 4_000
+    store, dev = _mirror_store(rng, 250, keyspace)
+    if prune:
+        floor = TxnId.create(1, 2_000, _K.ExclusiveSyncPoint, Domain.Range,
+                             1)
+        store.redundant_before.add_redundant(
+            Ranges.of(_Range(-(1 << 60), 1 << 60)), floor)
+        assert store.redundant_before.min_floor_over(0, keyspace) > \
+            TxnId.NONE
+    qs = _mesh_queries(rng, 24, keyspace, 250)
+
+    def run(route, mesh_on):
+        dev.route_override = route
+        saved = dev.mesh
+        dev.mesh = mesh if mesh_on else None
+        try:
+            h = dev.deps_query_batch_begin(qs, immediate=True,
+                                           prune_floors=prune)
+            return dev.deps_query_batch_end(h)
+        finally:
+            dev.mesh = saved
+
+    single = run("device", mesh_on=False)
+    sharded = run("device", mesh_on=True)
+    assert dev.n_mesh_bucketed_queries > 0, \
+        "the sharded bucketed kernel never ran"
+    sharded_dense = run("dense", mesh_on=True)
+    for got, name in ((sharded, "sharded"), (sharded_dense,
+                                             "sharded_dense")):
+        for a, b in zip(single, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)

@@ -1,0 +1,112 @@
+"""Perf smoke: the low-live-set regime must route to the host path.
+
+Guards against silently re-pessimizing BASELINE config 3 (hot-128 keys,
+90% of the table below the durable floor): with a round-trip cost
+representative of a tunneled accelerator injected into the calibration,
+the router must serve the scan from the host tail — and the result must
+still be bit-identical to the device kernels.  Fast (-m 'not slow'): a 2k
+txn store, one flush per route."""
+
+import numpy as np
+
+from accord_tpu.local.commands_for_key import InternalStatus
+from accord_tpu.local.device_index import DeviceState
+from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+from tests.conftest import make_device_state
+
+HOT = 128
+N = 2_000
+
+
+def _hot_store():
+    rng = np.random.default_rng(13)
+    store, dev, _safe = make_device_state()
+    hlcs = np.sort(rng.choice(np.arange(1, 20 * N), size=N, replace=False))
+    floor_hlc = int(hlcs[int(N * 0.9)])
+    for i in range(N):
+        status = InternalStatus.APPLIED if int(hlcs[i]) < floor_hlc \
+            else InternalStatus.PREACCEPTED
+        tid = TxnId.create(1, int(hlcs[i]), TxnKind.Write, Domain.Key,
+                           1 + i % 5)
+        toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
+        dev.register(tid, int(status), Keys([IntKey(t) for t in toks]))
+    floor_id = TxnId.create(1, floor_hlc, TxnKind.ExclusiveSyncPoint,
+                            Domain.Range, 1)
+    store.redundant_before.add_redundant(Ranges.of(Range(0, HOT)), floor_id)
+    qs = []
+    for _ in range(64):
+        bound = TxnId.create(1, int(rng.integers(20 * N, 40 * N)),
+                             TxnKind.Write, Domain.Key, 1)
+        toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
+        qs.append((bound, bound, bound.kind().witnesses(), toks, []))
+    return store, dev, qs
+
+
+def test_router_picks_host_in_low_live_set_regime():
+    saved = DeviceState._CALIB
+    # a tunneled-accelerator round trip (the regime config 3 runs in); the
+    # host/device per-element costs are this machine's own measurements
+    meas = DeviceState._measure_route_calibration()
+    DeviceState.set_route_calibration(rtt=2e-3, c_host=meas["c_host"],
+                                      c_dev=meas["c_dev"])
+    try:
+        store, dev, qs = _hot_store()
+        routes = []
+        dev.on_route = lambda route, nq: routes.append((route, nq))
+        handle = dev.deps_query_batch_begin(qs, immediate=True,
+                                            prune_floors=True)
+        host_out = dev.deps_query_batch_end(handle)
+        assert routes and routes[0][0] == "host", routes
+        assert dev.n_host_queries == len(qs)
+        # identical to the pinned device kernels on the same store
+        for route in ("device", "dense"):
+            dev.route_override = route
+            h = dev.deps_query_batch_begin(qs, immediate=True,
+                                           prune_floors=True)
+            got = dev.deps_query_batch_end(h)
+            for a, b in zip(host_out, got):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=route)
+        # route counters are disjoint and complete
+        assert dev.n_host_queries + dev.n_bucketed_queries \
+            + dev.n_dense_queries + dev.n_mesh_queries == dev.n_queries
+    finally:
+        DeviceState._CALIB = saved
+
+
+def test_at_scale_shape_routes_to_device():
+    """The inverse guard: with the same tunneled-RTT calibration, a query
+    batch whose modeled host scan dwarfs two round trips (large live range
+    set x many query intervals) must stay on the device kernels."""
+    saved = DeviceState._CALIB
+    meas = DeviceState._measure_route_calibration()
+    DeviceState.set_route_calibration(rtt=2e-3, c_host=meas["c_host"],
+                                      c_dev=meas["c_dev"])
+    try:
+        rng = np.random.default_rng(17)
+        store, dev, _safe = make_device_state()
+        keyspace = 500_000
+        hlcs = rng.choice(np.arange(1, 500_000), size=4_000, replace=False)
+        for i in range(4_000):
+            s = int(rng.integers(0, keyspace - 64))
+            tid = TxnId.create(1, int(hlcs[i]), TxnKind.Write, Domain.Range,
+                               1 + i % 5)
+            dev.register(tid, int(InternalStatus.PREACCEPTED),
+                         Ranges.of(Range(s, s + int(rng.integers(1, 64)))))
+        qs = []
+        for _ in range(256):
+            bound = TxnId.create(1, int(rng.integers(600_000, 700_000)),
+                                 TxnKind.Write, Domain.Key, 1)
+            ivs = [Range(int(s), int(s) + 64) for s in
+                   rng.integers(0, keyspace - 64, 4)]
+            qs.append((bound, bound, bound.kind().witnesses(), [], ivs))
+        routes = []
+        dev.on_route = lambda route, nq: routes.append(route)
+        dev.deps_query_batch_end(
+            dev.deps_query_batch_begin(qs, immediate=True))
+        assert routes == ["device"], routes
+        assert dev.n_host_queries == 0
+    finally:
+        DeviceState._CALIB = saved

@@ -1,0 +1,188 @@
+"""Routing equivalence: the regime-adaptive dispatch layer must be invisible
+to the protocol.  Property-style seeded runs generate mixed point/range
+footprints over live + redundant (below-floor) + invalidated tables and
+assert that every route — host, bucketed, dense, and the mesh-sharded
+kernels — returns bit-identical packed-CSR dep sets and identical attributed
+(floors + elision + key/range attribution) builder output, with floor
+pruning on and off.  A host brute force anchors the shared answer so an
+error common to all routes cannot hide."""
+
+import numpy as np
+import pytest
+
+from accord_tpu.local.commands_for_key import InternalStatus
+from accord_tpu.primitives.deps import DepsBuilder
+from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+from tests.conftest import make_device_state
+
+ROUTES = ("host", "device", "dense")
+
+
+def _build(seed, n=220, keyspace=6_000):
+    rng = np.random.default_rng(seed)
+    store, dev, safe = make_device_state()
+    entries = []
+    hlcs = rng.choice(np.arange(1, 40 * n), size=n, replace=False)
+    for i in range(n):
+        kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
+        r = rng.random()
+        if r < 0.12:       # straggler: wide interval
+            s = int(rng.integers(0, keyspace // 2))
+            toks, rngs = [], [Range(s, s + keyspace // 3)]
+            dom = Domain.Range
+        elif r < 0.5:
+            toks = [int(t) for t in rng.integers(0, keyspace,
+                                                 rng.integers(1, 4))]
+            rngs, dom = [], Domain.Key
+        else:
+            s = int(rng.integers(0, keyspace - 70))
+            toks = []
+            rngs = [Range(s, s + int(rng.integers(1, 70)))]
+            dom = Domain.Range
+        tid = TxnId.create(1, int(hlcs[i]), kind, dom,
+                           1 + int(rng.integers(0, 5)))
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+        alive = True
+        if rng.random() < 0.08:
+            dev.update_status(tid, int(InternalStatus.INVALIDATED))
+            alive = False
+        if alive:
+            entries.append((tid, toks, rngs))
+    # a floor covering the WHOLE key space so min_floor_over engages the
+    # device prune and the host route's structural floor
+    floor = TxnId.create(1, int(10 * n), TxnKind.ExclusiveSyncPoint,
+                         Domain.Range, 1)
+    store.redundant_before.add_redundant(
+        Ranges.of(Range(-(1 << 60), 1 << 60)), floor)
+    qs = []
+    for _ in range(28):
+        bound = TxnId.create(1, int(rng.integers(40 * n, 80 * n)),
+                             TxnKind.Write, Domain.Key, 1)
+        toks, rngs = [], []
+        for _ in range(int(rng.integers(1, 4))):
+            r = rng.random()
+            if r < 0.15:    # wide query (dense sub-batch fallback)
+                s = int(rng.integers(0, keyspace // 2))
+                rngs.append(Range(s, s + keyspace // 3))
+            elif r < 0.6:
+                toks.append(int(rng.integers(0, keyspace)))
+            else:
+                s = int(rng.integers(0, keyspace - 70))
+                rngs.append(Range(s, s + int(rng.integers(1, 70))))
+        qs.append((bound, bound, bound.kind().witnesses(), toks, rngs))
+    return store, dev, safe, entries, floor, qs
+
+
+def _brute(entries, q, floor=None):
+    bound, _self_id, witnesses, toks, rngs = q
+    out = set()
+    for tid, etoks, erngs in entries:
+        if not (tid < bound):
+            continue
+        if floor is not None and tid < floor:
+            continue
+        if not witnesses.test(tid.kind()):
+            continue
+        hit = any(t in etoks or any(r.contains_token(t) for r in erngs)
+                  for t in toks)
+        if not hit:
+            for r in rngs:
+                if any(r.contains_token(t) for t in etoks) or \
+                        any(er.start < r.end and r.start < er.end
+                            for er in erngs):
+                    hit = True
+                    break
+        if hit:
+            out.add(tid)
+    return sorted(out)
+
+
+def _csr(dev, qs, prune):
+    h = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=prune)
+    return dev.deps_query_batch_end(h)
+
+
+def _attributed(dev, safe, qs, prune):
+    builders = [DepsBuilder() for _ in qs]
+    h = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=prune)
+    dev.deps_query_batch_end_attributed(safe, h, builders)
+    out = []
+    for b in builders:
+        deps = b.build()
+        out.append(([(k, tuple(deps.key_deps.txn_ids_for(k)))
+                     for k in deps.key_deps.keys.tokens()],
+                    [(r.start, r.end, tuple(deps.range_deps.txn_ids[j]
+                                            for j in row))
+                     for r, row in zip(deps.range_deps.ranges,
+                                       deps.range_deps._per_range)]))
+    return out
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_all_routes_bit_identical(seed):
+    """host == bucketed/dense split == dense == sharded (mesh) CSR output,
+    pruned and unpruned, on random mixed footprints — anchored by a host
+    brute force over the live entries."""
+    store, dev, safe, entries, floor, qs = _build(seed)
+    from accord_tpu.ops.packing import unpack_txn_id
+    for prune in (False, True):
+        outs = {}
+        for route in ROUTES:
+            dev.route_override = route
+            outs["mesh_" + route] = _csr(dev, qs, prune)
+        if dev.mesh is not None:    # single-device kernels as well
+            saved = dev.mesh
+            dev.mesh = None
+            for route in ROUTES:
+                dev.route_override = route
+                outs["single_" + route] = _csr(dev, qs, prune)
+            dev.mesh = saved
+        base_name = "mesh_host"
+        base = outs[base_name]
+        for name, got in outs.items():
+            for a, b in zip(base, got):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"seed={seed} prune={prune} "
+                            f"{name} != {base_name}")
+        # anchor against brute force (dedupe route-common bugs)
+        row_ptr, msb, lsb, node = base
+        for b, q in enumerate(qs):
+            sl = slice(int(row_ptr[b]), int(row_ptr[b + 1]))
+            got = sorted(unpack_txn_id(m, l, n)
+                         for m, l, n in zip(msb[sl], lsb[sl], node[sl]))
+            want = _brute(entries, q, floor if prune else None)
+            assert got == want, f"seed={seed} prune={prune} query {b}"
+
+
+@pytest.mark.parametrize("seed", [7, 31])
+def test_all_routes_identical_attributed(seed):
+    """The protocol-complete path (floors + elision + attribution into
+    DepsBuilder) must not depend on the route either."""
+    store, dev, safe, entries, floor, qs = _build(seed)
+    for prune in (False, True):
+        base = None
+        for route in ROUTES:
+            dev.route_override = route
+            got = _attributed(dev, safe, qs, prune)
+            if base is None:
+                base = got
+            else:
+                assert got == base, \
+                    f"seed={seed} prune={prune} route={route}"
+
+
+def test_adaptive_route_is_invisible():
+    """Whatever the adaptive chooser picks (route_override=None) must equal
+    the pinned routes — the router can only change cost, never results."""
+    store, dev, safe, entries, floor, qs = _build(97)
+    dev.route_override = "dense"
+    want = _csr(dev, qs, True)
+    dev.route_override = None
+    got = _csr(dev, qs, True)
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert dev.n_queries == len(qs) * 2
