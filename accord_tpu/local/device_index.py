@@ -45,6 +45,40 @@ which route ran (results are bit-identical by construction):
    distributions defeat bucketing (straggler spill, wide queries).  Under a
    mesh it row-shards the slot table (sharded_calculate_deps_flat[_pruned]).
 
+Device-fault tolerance (the degradation ladder): the accelerator is a
+FAILURE DOMAIN, not a trusted coprocessor.  Every device-boundary operation
+(kernel launch, upload, result download, capacity grow) can fail — really
+(XlaRuntimeError / transfer error / HBM OOM) or injected (utils.faults'
+seedable device-fault registry, the accelerator-side analogue of the sim's
+network nemesis).  Because all routes are bit-identical, failure handling
+is CORRECTNESS-PRESERVING by construction:
+
+    device route -> quarantine -> host route -> compaction -> backpressure
+
+ - any device-boundary exception during a flush quarantines the device
+   routes and FAILS THE IN-FLIGHT FLUSH OVER to the host route — the
+   protocol sees the same bytes, one flush later than the kernel would
+   have delivered them;
+ - while quarantined every flush (and drain tick) is pinned to host; the
+   quarantine expires after an exponential-backoff flush count with
+   deterministic jitter, then ONE probe flush re-tries the device route —
+   success restores it, failure re-quarantines deeper;
+ - paranoia mode (utils.faults.PARANOIA or DeviceState.paranoia)
+   shadow-verifies every device flush against the host route and treats a
+   mismatch as a device fault — the detector for silent result corruption
+   (the stale_result fault class);
+ - a configurable device-memory budget (``device_budget_slots``, also env
+   ACCORD_TPU_DEVICE_BUDGET_SLOTS) backpressures ``_grow_capacity``: at
+   the budget the mirror COMPACTS (frees slots wholly below the global
+   RedundantBefore floor — exactly the entries every attributed scan
+   would drop) instead of doubling, and if compaction cannot make room the
+   store degrades PINNED-TO-HOST (degraded-but-live) with a loud one-shot
+   event rather than dying.
+
+Quarantine/fallback/compaction counters ride the bench ``# index:`` line,
+``Cluster.stats`` (DeviceFault.*) and the structured trace
+(utils.trace record_fault / record_quarantine).
+
 The crossover is NOT hard-coded: a once-per-process micro-probe measures
 the device round-trip cost, the device per-element kernel cost and the
 host per-element scan cost (DeviceState._measure_route_calibration); the
@@ -71,6 +105,8 @@ from ..ops import drain_kernel as drk
 from ..ops.packing import to_i64
 from ..primitives.keys import Range, Ranges
 from ..primitives.timestamp import Domain, Kinds, Timestamp, TxnId
+from ..utils import faults
+from ..utils.random_source import RandomSource
 
 _MIN_CAPACITY = 64
 _MIN_INTERVALS = 4
@@ -143,6 +179,10 @@ class _DepsMirror:
                  max_intervals: int = _MIN_INTERVALS):
         self.capacity = capacity
         self.max_intervals = max_intervals
+        # owning DeviceState (set by DeviceState.__init__): consulted before
+        # any capacity grow so the HBM budget can compact-instead-of-double
+        # (see DeviceState._approve_grow)
+        self.owner = None
         self.msb = np.zeros(capacity, np.int64)
         self.lsb = np.zeros(capacity, np.int64)
         self.node = np.zeros(capacity, np.int32)
@@ -348,6 +388,8 @@ class _DepsMirror:
         """Sync the bucket index to the (single) device — dirty-row scatter,
         like the slot table — and return the BucketTable."""
         self._sync_bucket_host()
+        if self._bdev is None or self._bdev_pending:
+            faults.check("transfer", "bucket upload")
         if self._bdev is None:
             self._bdev = tuple(jnp.asarray(a) for a in self._bhost)
             self._bdev_pending.clear()
@@ -363,6 +405,7 @@ class _DepsMirror:
             self._bdev_pending.clear()
         whost = self._sync_wide_host(16)
         if self._wdev is None or self._wdev_key != self._whost_key:
+            faults.check("transfer", "wide upload")
             self._wdev = tuple(jnp.asarray(a) for a in whost)
             self._wdev_key = self._whost_key
         return dk.BucketTable(*self._bdev, *self._wdev)
@@ -429,6 +472,10 @@ class _DepsMirror:
         self.version += 1
 
     def _grow_capacity(self) -> None:
+        if self.owner is not None and not self.owner._approve_grow(self):
+            # HBM backpressure: compaction made room under the budget —
+            # the caller's free_slots.pop() proceeds without doubling
+            return
         old = self.capacity
         new = old * 2
         self.msb = _grow(self.msb, new, 0)
@@ -650,6 +697,7 @@ class _DepsMirror:
         is single-device; on the virtual CPU mesh correctness is the point,
         and a real multi-chip deployment would shard the scatter too)."""
         if self._device is None or self._dirty:
+            faults.check("transfer", "sharded slot upload")
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
             from ..parallel.sharded import STORE_AXIS
@@ -664,6 +712,8 @@ class _DepsMirror:
         return self._device
 
     def device_table(self) -> dk.DepsTable:
+        if self._device is None or self._dirty:
+            faults.check("transfer", "slot upload")
         if self._device is None:
             self._device = dk.DepsTable(
                 jnp.asarray(self.msb), jnp.asarray(self.lsb),
@@ -1006,6 +1056,7 @@ class DeviceState:
     def __init__(self, store):
         self.store = store
         self.deps = _DepsMirror()
+        self.deps.owner = self
         self.drain = _DrainMirror()
         self._tick_scheduled = False
         # mesh mode: with >1 jax device (the virtual 8-device CPU test mesh,
@@ -1057,6 +1108,43 @@ class DeviceState:
         # kind -> [calls, seconds]; dispatch_* covers host pack + upload +
         # enqueue, wait_* the download join, host_* the host-side passes
         self.kernel_times: Dict[str, List[float]] = {}
+        # -- device-fault tolerance (module docstring: degradation ladder) --
+        # shadow-verify every device flush against the host route when True
+        # (or when utils.faults.PARANOIA is set process-wide)
+        self.paranoia = False
+        # OOM backpressure terminal state: all flushes/ticks pinned to host
+        self.host_pinned = False
+        # device-memory budget in table slots (None = unbounded); at the
+        # budget _grow_capacity compacts below the RedundantBefore floor
+        # instead of doubling, then degrades to host_pinned if still full
+        import os as _os
+        self.device_budget_slots: Optional[int] = (
+            int(_os.environ.get("ACCORD_TPU_DEVICE_BUDGET_SLOTS", "0"))
+            or None)
+        # quarantine state machine: consecutive device-boundary failures
+        # (the backoff exponent) and remaining quarantined flushes; jitter
+        # is seeded from (node, store) so the backoff schedule is
+        # deterministic yet desynchronized across the replicas of a shard
+        # — a cluster-wide device fault must not re-probe in lockstep
+        self._dev_backoff = 0
+        self._dev_quar_flushes = 0
+        node_id = getattr(getattr(store, "node", None), "node_id", 0)
+        self._jitter = RandomSource(
+            0xFA17 ^ (node_id << 16) ^ getattr(store, "store_id", 0))
+        # fault observability: on_fault(event, detail) if set, else the
+        # node-level observer the sim cluster wires (node.fault_observer)
+        self.on_fault = None
+        self.n_device_faults = 0
+        self.n_quarantines = 0
+        self.n_fallback_queries = 0    # queries served by host fallback/pin
+        self.n_reprobes = 0
+        self.n_restores = 0
+        self.n_shadow_checks = 0
+        self.n_shadow_mismatches = 0
+        self.n_compactions = 0
+        self.n_compacted_slots = 0
+        self.n_oom_degraded = 0
+        self.n_host_ticks = 0          # drain ticks swept on host fallback
 
     # ------------------------------------------------------------------
     # registration hooks (called from local.commands transitions)
@@ -1111,6 +1199,146 @@ class DeviceState:
 
     def index_size(self) -> int:
         return len(self.deps.slot_of)
+
+    # ------------------------------------------------------------------
+    # device-fault tolerance: quarantine state machine + HBM backpressure
+    # (module docstring: the degradation ladder)
+    # ------------------------------------------------------------------
+    _BACKOFF_BASE = 4      # flushes quarantined after the first failure
+    _BACKOFF_MAX = 256     # quarantine ceiling (flushes)
+
+    def _paranoid(self) -> bool:
+        return self.paranoia or faults.PARANOIA
+
+    def _fault_event(self, event: str, detail: str = "") -> None:
+        obs = self.on_fault
+        if obs is None:
+            obs = getattr(getattr(self.store, "node", None),
+                          "fault_observer", None)
+        if obs is not None:
+            obs(self.store, event, detail)
+
+    def _device_fault(self, exc_or_kind, detail: str = "") -> None:
+        """Record one device-boundary failure and quarantine the device
+        routes: exponential backoff in FLUSHES (deterministic per-store
+        jitter so co-faulted stores don't re-probe in lockstep)."""
+        kind = exc_or_kind if isinstance(exc_or_kind, str) \
+            else faults.kind_of(exc_or_kind)
+        self.n_device_faults += 1
+        self._fault_event("fault." + kind, detail)
+        self.n_quarantines += 1
+        self._dev_backoff = min(self._dev_backoff + 1, 8)
+        base = min(self._BACKOFF_BASE << (self._dev_backoff - 1),
+                   self._BACKOFF_MAX)
+        self._dev_quar_flushes = base + self._jitter.next_int(
+            max(base // 2, 1))
+        self._fault_event(
+            "quarantine", f"{kind} backoff={self._dev_quar_flushes}")
+
+    def _restore_device(self) -> None:
+        """A probe flush succeeded end-to-end: the device routes are
+        healthy again."""
+        self._dev_backoff = 0
+        self._dev_quar_flushes = 0
+        self.n_restores += 1
+        self._fault_event("restore")
+
+    def _approve_grow(self, mirror: _DepsMirror) -> bool:
+        """HBM capacity backpressure: called by _DepsMirror._grow_capacity
+        before doubling.  True = grow as usual; False = compaction made
+        room under the budget (free_slots is non-empty), don't grow.  When
+        compaction cannot make room the store degrades PINNED-TO-HOST
+        (loud one-shot event) and the HOST arrays still grow — the
+        protocol stays live, the device stops receiving uploads."""
+        new = mirror.capacity * 2
+        breach = (self.device_budget_slots is not None
+                  and new > self.device_budget_slots)
+        if not breach and faults.should_fire("hbm_oom"):
+            self.n_device_faults += 1
+            self._fault_event("fault.hbm_oom", f"grow to {new}")
+            breach = True
+        if not breach:
+            return True
+        freed = self._compact_below_floor()
+        self.n_compactions += 1
+        self.n_compacted_slots += freed
+        self._fault_event("oom.compact",
+                          f"freed={freed} capacity={mirror.capacity}")
+        if mirror.free_slots:
+            return False
+        if not self.host_pinned:
+            # the one-shot loud degrade: host route only from here on
+            self.host_pinned = True
+            self.n_oom_degraded += 1
+            self._fault_event("oom.degrade",
+                              f"capacity={mirror.capacity} -> {new}")
+        return True
+
+    def _compact_below_floor(self) -> int:
+        """Floor-driven compaction: free every live slot whose TxnId sits
+        below the RedundantBefore floor over EVERY interval of its own
+        footprint.  Safe by the same contract as free(): the attributed
+        scan drops a dep below the floor of every token it could emit at,
+        on every route — its effect is covered by the watermark.  A Python
+        sweep: this is the rare emergency path (budget breach / OOM), not
+        a hot path."""
+        rb = getattr(self.store, "redundant_before", None)
+        if rb is None:
+            return 0
+        d = self.deps
+        freed = 0
+        for s in np.nonzero(d.status != dk.SLOT_FREE)[0].tolist():
+            tid = d.id_of.get(s)
+            if tid is None:
+                continue
+            row_lo, row_hi = d.lo[s], d.hi[s]
+            covered = False
+            for m in range(d.max_intervals):
+                lo_v, hi_v = int(row_lo[m]), int(row_hi[m])
+                if lo_v > hi_v:
+                    continue
+                if tid < rb.min_floor_over(lo_v, hi_v):
+                    covered = True
+                else:
+                    covered = False
+                    break
+            if covered:
+                d.free(tid)
+                freed += 1
+        return freed
+
+    def _host_ready_slots(self) -> np.ndarray:
+        """Host replacement of the drain frontier sweep (the bottom rung of
+        the degradation ladder) — EXACTLY drain_kernel.ready_frontier's
+        rule over the drain mirror's sparse adjacency: a Stable row is
+        ready unless some dep is live, non-applied, and gating (undecided,
+        executing earlier, or the row awaits all deps).  Python-loop over
+        the in-flight set: this path runs only quarantined/degraded."""
+        dr = self.drain
+        m64 = (1 << 64) - 1
+        out = []
+        for i in np.nonzero((dr.status == dk.SLOT_STABLE) & dr.active)[0]:
+            i = int(i)
+            ei = (int(dr.exec_msb[i]) & m64, int(dr.exec_lsb[i]) & m64,
+                  int(dr.exec_node[i]))
+            awaits = bool(dr.awaits_all[i])
+            blocked = False
+            for j in dr.deps_of[i]:
+                stj = int(dr.status[j])
+                if stj in (dk.SLOT_FREE, dk.SLOT_INVALIDATED,
+                           dk.SLOT_APPLIED):
+                    continue
+                if stj < dk.SLOT_COMMITTED or awaits:
+                    blocked = True      # undecided always gates
+                    break
+                ej = (int(dr.exec_msb[j]) & m64, int(dr.exec_lsb[j]) & m64,
+                      int(dr.exec_node[j]))
+                if ej < ei:             # executes before i: gates
+                    blocked = True
+                    break
+            if not blocked:
+                out.append(i)
+        return np.array(out, np.int64)
 
     # ------------------------------------------------------------------
     # the deps query (device replacement of map_reduce_active fold)
@@ -1614,13 +1842,15 @@ class DeviceState:
                 parts.append({"kind": "host", "b": b_h, "j": j_h,
                               "pmq": pmq})
                 return
+            dk.launch_check(kind)
             b_pad = _pow2_at_least(len(rows), 1)
             rows_p = np.concatenate(
                 [rows, np.full(b_pad - len(rows), rows[-1], np.int64)])
             gmap = np.concatenate(
                 [rows, np.full(b_pad - len(rows), -1, np.int64)])
             part: Dict[str, object] = {"kind": kind, "gmap": gmap,
-                                       "nq": b_pad, "q_m": q_m}
+                                       "nq": b_pad, "q_m": q_m,
+                                       "immediate": immediate}
             if kind == "sharded":
                 table = self.deps.device_table_sharded(self.mesh)
                 d = int(np.prod(list(self.mesh.shape.values())))
@@ -1720,41 +1950,74 @@ class DeviceState:
             parts.append(part)
 
         all_rows = np.arange(nq, dtype=np.int64)
-        route = self.route_override
-        if route is None:
-            route = self._choose_route(qnp, q_m,
-                                       floor_id if prune_floors else None)
+        # -- route health gating (module docstring: degradation ladder) --
+        # while OOM-degraded or quarantined, every flush is pinned to the
+        # host route (the route choice isn't even priced); when a
+        # quarantine expires, the next device-bound flush is the PROBE —
+        # its success restores the device routes, its failure re-
+        # quarantines deeper
+        probing = False
+        forced = None
+        if self.host_pinned:
+            forced = "host-pinned"
+        elif self._dev_quar_flushes > 0:
+            self._dev_quar_flushes -= 1
+            forced = "host-fallback"
+        if forced is not None:
+            route = "host"
+            self.n_fallback_queries += nq
+        else:
+            route = self.route_override
+            if route is None:
+                route = self._choose_route(qnp, q_m,
+                                           floor_id if prune_floors
+                                           else None)
+            if route != "host" and self._dev_backoff > 0:
+                probing = True
+                self.n_reprobes += 1
+                self._fault_event("reprobe", f"route={route}")
+        observed = forced or route
         if self.on_route is not None:
-            self.on_route(route, nq)
+            self.on_route(observed, nq)
         else:
             obs = getattr(self.store.node, "route_observer", None)
             if obs is not None:
-                obs(self.store, route, nq)
+                obs(self.store, observed, nq)
         degenerate = not self.BUCKETED or \
             len(self.deps.wide_entries) > self.deps.WIDE_MAX
-        if route == "host":
-            dispatch("host", all_rows)
-        elif self.mesh is not None:
-            if route == "dense" or degenerate:
-                dispatch("sharded", all_rows)
+        try:
+            if route == "host":
+                dispatch("host", all_rows)
+            elif self.mesh is not None:
+                if route == "dense" or degenerate:
+                    dispatch("sharded", all_rows)
+                else:
+                    qcols, wide_q = self._bucket_query_cols(qnp, q_m)
+                    narrow = np.nonzero(~wide_q)[0].astype(np.int64)
+                    wide = np.nonzero(wide_q)[0].astype(np.int64)
+                    if len(narrow):
+                        dispatch("sharded_bucketed", narrow, qcols)
+                    if len(wide):
+                        dispatch("sharded", wide)
+            elif route == "dense" or degenerate:
+                dispatch("dense", all_rows)
             else:
                 qcols, wide_q = self._bucket_query_cols(qnp, q_m)
                 narrow = np.nonzero(~wide_q)[0].astype(np.int64)
                 wide = np.nonzero(wide_q)[0].astype(np.int64)
                 if len(narrow):
-                    dispatch("sharded_bucketed", narrow, qcols)
+                    dispatch("bucketed", narrow, qcols)
                 if len(wide):
-                    dispatch("sharded", wide)
-        elif route == "dense" or degenerate:
-            dispatch("dense", all_rows)
-        else:
-            qcols, wide_q = self._bucket_query_cols(qnp, q_m)
-            narrow = np.nonzero(~wide_q)[0].astype(np.int64)
-            wide = np.nonzero(wide_q)[0].astype(np.int64)
-            if len(narrow):
-                dispatch("bucketed", narrow, qcols)
-            if len(wide):
-                dispatch("dense", wide)
+                    dispatch("dense", wide)
+        except faults.DEVICE_EXCEPTIONS as e:
+            # device-boundary failure at dispatch: quarantine and fail the
+            # WHOLE flush over to the always-correct host route (mixed
+            # host+device part lists are not a thing the collector sees)
+            parts.clear()
+            self._device_fault(e, f"dispatch: {e}")
+            self.n_fallback_queries += nq
+            probing = False
+            dispatch("host", all_rows)
         if immediate:
             # synchronous caller (deps_query, B=1): collect follows on the
             # next line with no interleaved mutation, so skip the snapshot
@@ -1790,7 +2053,9 @@ class DeviceState:
                    self.deps.eknown.copy())
             ivs = (self.deps.lo.copy(), self.deps.hi.copy(),
                    self.deps.domain.copy())
-        return (parts, ids, ivs, qnp, q_m, list(queries))
+        fmeta = {"floor_id": floor_id, "probing": probing,
+                 "immediate": immediate}
+        return (parts, ids, ivs, qnp, q_m, list(queries), fmeta)
 
     def _bucket_query_cols(self, qnp: np.ndarray, q_m: int):
         """Vectorized query->bucket-row mapping: int64[NQ, q_m, SPAN] dense
@@ -1855,6 +2120,7 @@ class DeviceState:
                              else i * shard_n))
             return np.concatenate(bs), np.concatenate(js)
 
+        faults.check("transfer", "result download")
         if th is not None:
             th.join()
             err = box.get("err")
@@ -1925,6 +2191,14 @@ class DeviceState:
                         part["span"], s, k))
             parsed = parse(out, s, k)
         b_local, j_idx = parsed
+        # stale/corrupted-result injection: perturb the slot indices the
+        # kernel answered with.  Only where the detector actually runs —
+        # paranoia shadow-verify on an IMMEDIATE flush (the protocol path);
+        # injecting silent corruption with no detector would just be
+        # breaking the program, not testing it.
+        if part.get("immediate") and self._paranoid() and len(j_idx) \
+                and faults.should_fire("stale_result"):
+            j_idx = (j_idx + np.int64(1)) % np.int64(self.deps.capacity)
         self._ktime("wait_" + part["kind"], _t0)
         gmap = part["gmap"]
         b_global = gmap[b_local]
@@ -1942,8 +2216,17 @@ class DeviceState:
         probes are exact, so its pairs and triples arrive precomputed.
         Re-runs use the table snapshot captured at begin — registrations
         interleaved between begin and end must not shift the queried
-        snapshot."""
-        (parts, ids, ivs, qnp, q_m, queries) = handle
+        snapshot.
+
+        Device-boundary failures here (transfer/download, injected or real)
+        quarantine the device routes and fail the flush over to the host
+        route; in paranoia mode the surviving device answer is additionally
+        shadow-verified against the host route and any mismatch is treated
+        as a device fault (both correctness-preserving: all routes are
+        bit-identical by construction).  The host fallback/shadow scan runs
+        against the live mirror — exact under the immediate (protocol)
+        path, where no mutation can interleave between begin and end."""
+        (parts, ids, ivs, qnp, q_m, queries, fmeta) = handle
         import time as _time
         nq = len(queries)
         if len(parts) == 1 and parts[0]["kind"] == "host":
@@ -1952,7 +2235,11 @@ class DeviceState:
             self.n_queries += nq
             self.n_kernel_deps += len(j_idx)
             return b_idx, j_idx, part["pmq"], ids, ivs, qnp, queries
-        outs = [self._collect_part(p) for p in parts]
+        try:
+            outs = [self._collect_part(p) for p in parts]
+        except faults.DEVICE_EXCEPTIONS as e:
+            self._device_fault(e, f"collect: {e}")
+            return self._host_fallback_collect(handle)
         _tg = _time.perf_counter()
         b_idx = np.concatenate([o[0] for o in outs]) if outs else \
             np.zeros(0, np.int64)
@@ -1987,10 +2274,42 @@ class DeviceState:
             new_pos = np.cumsum(present) - 1
             b_idx, j_idx = b_idx[present], j_idx[present]
             p_i = new_pos[p_i]
+        if self._paranoid() and fmeta["immediate"]:
+            # shadow-verify: the exact (query, slot) pair set must match
+            # the host route's byte-for-byte; a mismatch means the device
+            # answered wrong (stale/corrupted result) — quarantine it and
+            # serve the host answer
+            self.n_shadow_checks += 1
+            b_h, j_h, pmq_h = self.deps.host_pairs(qnp, q_m,
+                                                   fmeta["floor_id"])
+            cap = np.int64(self.deps.capacity)
+            if not np.array_equal(np.unique(b_idx * cap + j_idx),
+                                  np.unique(b_h * cap + j_h)):
+                self.n_shadow_mismatches += 1
+                self._device_fault("stale_result", "shadow mismatch")
+                self.n_fallback_queries += nq
+                self.n_queries += nq
+                self.n_kernel_deps += len(j_h)
+                return b_h, j_h, pmq_h, ids, ivs, qnp, queries
+        if fmeta["probing"]:
+            self._restore_device()   # the probe flush succeeded end-to-end
         self.n_queries += nq
         self.n_kernel_deps += len(j_idx)
         self._ktime("host_geometry", _tg)
         return b_idx, j_idx, (p_i, m_i, q_i), ids, ivs, qnp, queries
+
+    def _host_fallback_collect(self, handle):
+        """Serve a flush whose device parts failed mid-collect from the
+        host route (identical bytes by the routing invariant)."""
+        (_parts, ids, ivs, qnp, q_m, queries, fmeta) = handle
+        nq = len(queries)
+        b_h, j_h, pmq_h = self.deps.host_pairs(qnp, q_m, fmeta["floor_id"])
+        self.n_host_queries += nq
+        self.n_fallback_queries += nq
+        self.n_dispatches += 1
+        self.n_queries += nq
+        self.n_kernel_deps += len(j_h)
+        return b_h, j_h, pmq_h, ids, ivs, qnp, queries
 
     def deps_query_batch_end(self, handle):
         """Raw packed-CSR collection (no floors/attribution) — the transport
@@ -2104,21 +2423,39 @@ class DeviceState:
             if sweep_due:
                 self.drain.sweep_free()
             return
-        state, live = self.drain.state()
-        if isinstance(state, drk.EllDrainState):
-            # large in-flight set: sparse gather sweep (no [N, N] anywhere)
-            ready = np.asarray(drk.ready_frontier_ell(state))[: len(live)]
-        elif self.mesh is not None and \
-                state.status.shape[0] % len(self.mesh.devices.flat) == 0 \
-                and self._mesh_tick_pays(state.status.shape[0]):
-            # live mesh path: the frontier sweep row-shards across devices
-            # (the fixpoint analogue is parallel.sharded.sharded_drain)
-            from ..parallel.sharded import sharded_ready_frontier
-            ready = np.asarray(
-                sharded_ready_frontier(self.mesh)(state))[: len(live)]
-        else:
-            ready = np.asarray(drk.ready_frontier(state))[: len(live)]
-        cand_slots = live[ready & self.drain.active[live]]
+        # the drain is a device boundary too: while quarantined/degraded
+        # the frontier sweeps on host, and a device failure mid-tick
+        # quarantines + falls back to the host sweep (same rule, same
+        # candidates — the per-candidate WaitingOn re-validation below
+        # makes any residual divergence a no-op, never a wrong execution)
+        cand_slots = None
+        if not (self.host_pinned or self._dev_quar_flushes > 0):
+            try:
+                dk.launch_check("drain")
+                state, live = self.drain.state()
+                faults.check("transfer", "drain download")
+                if isinstance(state, drk.EllDrainState):
+                    # large in-flight set: sparse gather sweep (no [N, N])
+                    ready = np.asarray(
+                        drk.ready_frontier_ell(state))[: len(live)]
+                elif self.mesh is not None and \
+                        state.status.shape[0] % \
+                        len(self.mesh.devices.flat) == 0 \
+                        and self._mesh_tick_pays(state.status.shape[0]):
+                    # live mesh path: the frontier sweep row-shards across
+                    # devices (fixpoint analogue: parallel.sharded.
+                    # sharded_drain)
+                    from ..parallel.sharded import sharded_ready_frontier
+                    ready = np.asarray(
+                        sharded_ready_frontier(self.mesh)(state))[: len(live)]
+                else:
+                    ready = np.asarray(drk.ready_frontier(state))[: len(live)]
+                cand_slots = live[ready & self.drain.active[live]]
+            except faults.DEVICE_EXCEPTIONS as e:
+                self._device_fault(e, f"drain tick: {e}")
+        if cand_slots is None:
+            self.n_host_ticks += 1
+            cand_slots = self._host_ready_slots()
         if len(cand_slots) != 0:
             cands = sorted(
                 (self.drain.id_of[int(s)] for s in cand_slots

@@ -44,6 +44,16 @@ from ..primitives.timestamp import Kinds, Timestamp, TxnId
 from .packing import (ensure_x64, masked_ts_max, to_i64, ts_eq, ts_lt,
                       unpack_txn_id)
 
+def launch_check(what: str = "") -> None:
+    """Device-boundary fault hook for every (un-jitted) kernel dispatch
+    wrapper: raises utils.faults.KernelLaunchFault when a kernel-launch
+    fault is armed.  Lives here — next to the kernels — so the injection
+    surface and the thing it simulates stay in one place; a production
+    process with nothing armed pays one dict miss."""
+    from ..utils import faults
+    faults.check("kernel_launch", what)
+
+
 PAD_LO = np.int64(np.iinfo(np.int64).max)   # empty interval: lo > hi
 PAD_HI = np.int64(np.iinfo(np.int64).min)
 

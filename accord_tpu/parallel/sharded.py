@@ -55,6 +55,8 @@ def make_mesh(n_devices: int = None) -> Mesh:
 
 def shard_table(mesh: Mesh, table: DepsTable) -> DepsTable:
     """Place the slot dimension across the mesh; capacity must divide evenly."""
+    from ..utils import faults
+    faults.check("transfer", "shard_table upload")
     s1 = NamedSharding(mesh, P(STORE_AXIS))
     s2 = NamedSharding(mesh, P(STORE_AXIS, None))
     return DepsTable(
@@ -306,6 +308,8 @@ def sharded_bucketed_flat(mesh: Mesh, m: int, span: int, s: int, k: int):
 def shard_bucket_table(mesh: Mesh, buckets: BucketTable) -> BucketTable:
     """Place a BucketTable's bucket-row and wide dimensions across the mesh
     (row counts must divide the device count evenly)."""
+    from ..utils import faults
+    faults.check("transfer", "shard_bucket_table upload")
     s2 = NamedSharding(mesh, P(STORE_AXIS, None))
     s1 = NamedSharding(mesh, P(STORE_AXIS))
     return BucketTable(*[jax.device_put(a, s2) for a in buckets[:7]],

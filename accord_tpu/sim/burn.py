@@ -66,7 +66,38 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
              chaos: bool = True, churn: bool = True, restarts: bool = True,
              drain_micros: int = 120_000_000,
              probe=None, probe_micros: int = 0,
-             boundary_churn_only: bool = False) -> BurnResult:
+             boundary_churn_only: bool = False,
+             device_faults: Optional[str] = None,
+             device_fault_p: float = 0.05) -> BurnResult:
+    if device_faults is not None:
+        # DEVICE-FAULT NEMESIS: arm the accelerator-boundary fault
+        # registry (utils.faults) for the whole run — one fault class, or
+        # "all".  The fault stream is seeded from the run seed WITHOUT
+        # touching ``rs``, so the protocol/chaos randomness — and therefore
+        # deps_found and every client-visible outcome — is byte-identical
+        # to the fault-free run at the same seed (the quarantine ->
+        # host-fallback ladder in local.device_index absorbs every fault).
+        # Paranoia mode rides along: it is the detector for stale_result.
+        from ..utils import faults
+        kinds = sorted(faults.DEVICE_FAULT_KINDS) if device_faults == "all" \
+            else [device_faults]
+        frng = RandomSource((seed << 8) ^ 0xFA17)
+        prior_paranoia = faults.PARANOIA
+        try:
+            for k in kinds:
+                faults.inject_device_fault(k, device_fault_p, frng.fork())
+            faults.PARANOIA = True
+            return run_burn(seed, n_ops=n_ops, n_keys=n_keys,
+                            node_ids=node_ids, rf=rf, shards=shards,
+                            workload_micros=workload_micros, chaos=chaos,
+                            churn=churn, restarts=restarts,
+                            drain_micros=drain_micros, probe=probe,
+                            probe_micros=probe_micros,
+                            boundary_churn_only=boundary_churn_only)
+        finally:
+            faults.PARANOIA = prior_paranoia
+            for k in kinds:
+                faults.clear_device_faults(k)
     rs = RandomSource(seed)
     topology = build_topology(1, node_ids, rf, shards)
     cluster = Cluster(topology=topology, seed=rs.next_int(1 << 30),
@@ -403,17 +434,25 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     result.stats = dict(cluster.stats)
     # lived kernel batching: mean deps-scan batch size across all stores
     # (store-level coalescing; 1.0 would mean every query dispatched alone)
-    nq = nd = 0
+    nq = nd = ndeps = nfb = 0
     kt: Dict[str, float] = {}
     for node in cluster.nodes.values():
         for s in node.command_stores.unsafe_all_stores():
             if s.device is not None:
                 nq += s.device.n_queries
                 nd += s.device.n_dispatches
+                ndeps += s.device.n_kernel_deps
+                nfb += s.device.n_fallback_queries
                 for k, (_c, sec) in s.device.kernel_times.items():
                     kt[k] = kt.get(k, 0.0) + sec
     result.stats["device_queries"] = nq
     result.stats["device_dispatches"] = nd
+    # total exact (query, dep) pairs the deps scans produced: identical
+    # across routes by construction, so a device-fault run must report the
+    # SAME number as the fault-free run at the same seed — the burn-level
+    # bit-equivalence gate for the degradation ladder
+    result.stats["deps_found"] = ndeps
+    result.stats["device_fallback_queries"] = nfb
     # wall-clock timings live OUTSIDE stats: stats must stay a pure
     # function of the seed (the determinism double-run compares it)
     result.kernel_wall = {k: round(1e3 * sec, 1) for k, sec in kt.items()}
@@ -430,6 +469,12 @@ def main(argv=None):
     p.add_argument("--no-chaos", action="store_true")
     p.add_argument("--no-churn", action="store_true")
     p.add_argument("--no-restarts", action="store_true")
+    p.add_argument("--device-faults", default=None,
+                   help="inject one accelerator fault class for the whole "
+                        "run: kernel_launch | transfer | hbm_oom | "
+                        "stale_result | all")
+    p.add_argument("--device-fault-p", type=float, default=0.05,
+                   help="per-boundary-crossing fault probability")
     args = p.parse_args(argv)
 
     if args.loop_seed is not None:
@@ -437,13 +482,17 @@ def main(argv=None):
         while True:
             r = run_burn(seed, n_ops=args.ops, chaos=not args.no_chaos,
                          churn=not args.no_churn,
-                         restarts=not args.no_restarts)
+                         restarts=not args.no_restarts,
+                         device_faults=args.device_faults,
+                         device_fault_p=args.device_fault_p)
             print(f"seed {seed}: {r}")
             seed += 1
     start = args.seed if args.seed is not None else 0
     for seed in range(start, start + args.count):
         r = run_burn(seed, n_ops=args.ops, chaos=not args.no_chaos,
-                     churn=not args.no_churn, restarts=not args.no_restarts)
+                     churn=not args.no_churn, restarts=not args.no_restarts,
+                     device_faults=args.device_faults,
+                     device_fault_p=args.device_fault_p)
         print(f"seed {seed}: {r}")
 
 

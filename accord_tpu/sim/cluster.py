@@ -33,14 +33,24 @@ class PendingQueue:
     """Simulated-time priority queue (ref: impl/basic/PendingQueue.java)."""
 
     def __init__(self):
-        self._heap: List[Tuple[int, int, Callable[[], None]]] = []
+        self._heap: List[List] = []
         self._seq = itertools.count()
         self.now = 0
 
-    def add(self, at_micros: int, fn: Callable[[], None]) -> Tuple[int, int]:
-        entry = (max(at_micros, self.now), next(self._seq), fn)
+    def add(self, at_micros: int, fn: Callable[[], None]) -> List:
+        """Schedule ``fn``; the returned entry is a cancellation handle for
+        ``cancel`` (entries are [at, seq, fn] lists — seq is unique, so
+        heap ordering never compares the callables)."""
+        entry = [max(at_micros, self.now), next(self._seq), fn]
         heapq.heappush(self._heap, entry)
-        return entry[:2]
+        return entry
+
+    @staticmethod
+    def cancel(entry: List) -> None:
+        """Tombstone a pending entry in place: pop() and is_empty() skip
+        it, so a cancelled timeout costs one heap slot, not a live
+        callback held for its full horizon."""
+        entry[2] = None
 
     def pop(self) -> Optional[Callable[[], None]]:
         while self._heap:
@@ -115,6 +125,11 @@ class NodeSink(api.MessageSink):
         self.dead = False
         self._callbacks: Dict[int, api.Callback] = {}
         self._callback_seq = itertools.count(1)
+        # pending-timeout queue entries by callback id: cancelled the moment
+        # the (final) reply or failure resolves the callback — a completed
+        # request must not leave a dead lambda in the heap for the full
+        # timeout horizon (measurable heap bloat in long burns)
+        self._timeout_entries: Dict[int, List] = {}
 
     def send(self, to: int, request) -> None:
         if self.dead:
@@ -133,10 +148,15 @@ class NodeSink(api.MessageSink):
         # declaring the replica dead (ref: Maelstrom sink's per-type sweeper)
         if getattr(request, "is_slow_read", False):
             timeout *= 10
-
-        self.cluster.queue.add(self.cluster.queue.now + timeout,
-                               lambda: self._fail_pending(
-                                   cid, to, f"timeout to {to}"))
+        # small deterministic jitter: co-scheduled requests (a coordinator
+        # fanning one message to every replica in one quantum) must not
+        # time out at the same instant and fire as a synchronized retry
+        # storm.  Drawn from a dedicated stream so the protocol/chaos
+        # randomness is untouched.
+        timeout += self.cluster.timeout_jitter()
+        self._timeout_entries[cid] = self.cluster.queue.add(
+            self.cluster.queue.now + timeout,
+            lambda: self._fail_pending(cid, to, f"timeout to {to}"))
 
     def reply(self, to: int, reply_context, reply) -> None:
         if self.dead or reply_context is None:
@@ -153,6 +173,9 @@ class NodeSink(api.MessageSink):
     def _fail_pending(self, cid: int, from_id: int, msg: str) -> None:
         if self.dead:
             return
+        entry = self._timeout_entries.pop(cid, None)
+        if entry is not None:
+            PendingQueue.cancel(entry)
         cb = self._callbacks.pop(cid, None)
         if cb is not None:
             from ..coordinate.errors import Timeout as TimeoutError_
@@ -169,6 +192,9 @@ class NodeSink(api.MessageSink):
         final = reply.is_final() if hasattr(reply, "is_final") else True
         if final:
             del self._callbacks[cid]
+            entry = self._timeout_entries.pop(cid, None)
+            if entry is not None:
+                PendingQueue.cancel(entry)
         from ..messages.base import FailureReply
         if isinstance(reply, FailureReply):
             cb.on_failure(from_id, reply.failure)
@@ -246,6 +272,10 @@ class Cluster:
         self._device_mode = device_mode
         self._paged_limit = paged_limit
         self.random = RandomSource(seed)
+        # dedicated stream for request-timeout jitter: seeded from the run
+        # seed WITHOUT consuming a draw from ``self.random`` (node/restart
+        # fork seeds stay exactly what they were without jitter)
+        self._timeout_rng = RandomSource(seed ^ 0x7E9_1713)
         self.queue = PendingQueue()
         self.topologies: List[Topology] = [topology] if topology else []
         self.nodes: Dict[int, Node] = {}
@@ -323,6 +353,27 @@ class Cluster:
                                         route, nq)
 
         node.route_observer = observer
+
+        def fault_observer(store, event, detail, nid=node.node_id):
+            """Device-fault/degradation events from DeviceState: counted in
+            stats (always) and the structured trace (when attached) — the
+            sim-side leg of the degradation-ladder observability."""
+            key = "DeviceFault." + event
+            self.stats[key] = self.stats.get(key, 0) + 1
+            if self.trace is not None:
+                sid = getattr(store, "store_id", -1)
+                if event in ("quarantine", "reprobe", "restore"):
+                    self.trace.record_quarantine(self.queue.now, nid, sid,
+                                                 event, detail)
+                else:
+                    self.trace.record_fault(self.queue.now, nid, sid,
+                                            event, detail)
+
+        node.fault_observer = fault_observer
+
+    def timeout_jitter(self) -> int:
+        """Small deterministic per-request timeout jitter (micros)."""
+        return self._timeout_rng.next_int(4096)
 
     def node_now(self, nid: int) -> int:
         """The node's drifted local clock (simulated time by default)."""
@@ -510,8 +561,16 @@ class Cluster:
 
     # -- run loop -----------------------------------------------------------
     def run_until_quiescent(self, max_micros: int = 60_000_000) -> None:
+        """Run until the queue is empty or the deadline passes.  The
+        deadline is checked against the NEXT event's time (like run_for):
+        popping first would advance ``now`` past the deadline and still
+        run the event — work scheduled beyond the horizon must not
+        execute."""
         deadline = self.queue.now + max_micros
-        while self.queue.now <= deadline:
+        while True:
+            t = self._peek_time()
+            if t is None or t > deadline:
+                return
             fn = self.queue.pop()
             if fn is None:
                 return

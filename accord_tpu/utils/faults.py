@@ -1,12 +1,167 @@
-"""Static fault-injection flags.
+"""Fault injection: protocol flags + injectable accelerator faults.
 
 Rebuild of ref: accord-core/src/main/java/accord/utils/Faults.java:22-28 —
 compile-time-style switches that deliberately weaken a protocol guarantee so
-the verification harness can prove it would catch the resulting violation.
-All default off; tests flip them in a try/finally."""
+the verification harness can prove it would catch the resulting violation —
+extended with a registry of injectable DEVICE-BOUNDARY faults, the
+accelerator-side analogue of the sim's network nemesis (drops / partitions /
+crash-restarts): kernel-launch failure, transfer/upload failure, simulated
+HBM OOM on capacity grow, and stale/corrupted kernel results.
+
+Two shapes of switch:
+
+- **Boolean flags** (``TRANSACTION_INSTABILITY``, ``PARANOIA``): module
+  attributes, flipped by tests via ``with faults.enabled("NAME"):`` instead
+  of hand-rolled try/finally.
+- **Device faults**: armed per-kind with a probability and a seedable
+  ``RandomSource`` (``inject_device_fault`` / the ``device_fault`` context
+  manager).  Every device-boundary operation asks ``should_fire(kind)`` /
+  ``check(kind)``; the draw comes from the injected source only, so a
+  same-seed chaos run stays bit-reproducible and the fault stream never
+  perturbs the cluster's protocol randomness.
+
+The consumer of the fault surface is the degradation ladder in
+local/device_index.py (route quarantine -> host fallback -> compaction ->
+backpressure); all defaults are off — a production process never draws.
+"""
 
 from __future__ import annotations
+
+import contextlib
+import sys
+from typing import Dict, Iterator, Optional, Tuple
+
+from .random_source import RandomSource
 
 # Skip ensuring stability (deps durable at a quorum) before execution
 # (ref: Faults.TRANSACTION_INSTABILITY consumed at CoordinationAdapter.java:173)
 TRANSACTION_INSTABILITY = False
+
+# Paranoia mode: every device-route deps flush is shadow-verified against
+# the always-correct host route; any mismatch quarantines the device route
+# (the ONLY detector for the stale_result fault class, which corrupts
+# silently).  Costs one host scan per device flush — chaos/verification
+# runs only.
+PARANOIA = False
+
+
+class DeviceFaultError(RuntimeError):
+    """Base of every injected device-boundary failure."""
+
+
+class KernelLaunchFault(DeviceFaultError):
+    """A kernel dispatch failed to launch (injected XlaRuntimeError-alike)."""
+
+
+class TransferFault(DeviceFaultError):
+    """A host<->device transfer (upload or result download) failed."""
+
+
+class HbmOomFault(DeviceFaultError):
+    """Device memory exhausted while growing a device-resident buffer."""
+
+
+class StaleResultFault(DeviceFaultError):
+    """A kernel returned stale/corrupted bytes (detected by shadow-verify)."""
+
+
+DEVICE_FAULT_KINDS: Dict[str, type] = {
+    "kernel_launch": KernelLaunchFault,
+    "transfer": TransferFault,
+    "hbm_oom": HbmOomFault,
+    "stale_result": StaleResultFault,
+}
+
+# exception types the device layer treats as a device-boundary failure (and
+# therefore quarantines + fails over on) — injected faults plus the real
+# runtime's launch/transfer/OOM errors
+_dev_exc = [DeviceFaultError, MemoryError]
+try:  # pragma: no cover - depends on the installed jaxlib
+    from jaxlib.xla_extension import XlaRuntimeError as _XlaRuntimeError
+    _dev_exc.append(_XlaRuntimeError)
+except Exception:  # pragma: no cover
+    pass
+DEVICE_EXCEPTIONS: Tuple[type, ...] = tuple(_dev_exc)
+
+# kind -> (probability, RandomSource); empty means no draws anywhere
+_armed: Dict[str, Tuple[float, RandomSource]] = {}
+
+
+def inject_device_fault(kind: str, probability: float,
+                        random: RandomSource) -> None:
+    """Arm one fault class.  Draws come from ``random`` ONLY (pass a fork of
+    the run's seeded source so same-seed runs replay the same faults)."""
+    if kind not in DEVICE_FAULT_KINDS:
+        raise ValueError(f"unknown device fault kind {kind!r}; "
+                         f"one of {sorted(DEVICE_FAULT_KINDS)}")
+    _armed[kind] = (probability, random)
+
+
+def clear_device_faults(kind: Optional[str] = None) -> None:
+    if kind is None:
+        _armed.clear()
+    else:
+        _armed.pop(kind, None)
+
+
+def active_device_faults() -> Dict[str, float]:
+    return {k: p for k, (p, _r) in _armed.items()}
+
+
+def should_fire(kind: str) -> bool:
+    """One deterministic draw against ``kind``'s armed probability (no draw —
+    and False — when the kind is not armed)."""
+    armed = _armed.get(kind)
+    if armed is None:
+        return False
+    probability, random = armed
+    return random.decide(probability)
+
+
+def check(kind: str, detail: str = "") -> None:
+    """Raise the kind's fault exception if the armed fault fires."""
+    if should_fire(kind):
+        raise DEVICE_FAULT_KINDS[kind](f"injected {kind} fault: {detail}")
+
+
+def kind_of(exc: BaseException) -> str:
+    """Classify a device-boundary exception for counters/trace events."""
+    for kind, cls in DEVICE_FAULT_KINDS.items():
+        if isinstance(exc, cls):
+            return kind
+    return "device_error"
+
+
+@contextlib.contextmanager
+def device_fault(kind: str, probability: float,
+                 random: RandomSource) -> Iterator[None]:
+    """Arm ``kind`` for the block, restoring the prior arming on exit."""
+    prior = _armed.get(kind)
+    inject_device_fault(kind, probability, random)
+    try:
+        yield
+    finally:
+        if prior is None:
+            _armed.pop(kind, None)
+        else:
+            _armed[kind] = prior
+
+
+@contextlib.contextmanager
+def enabled(name: str) -> Iterator[None]:
+    """Flip a module-level boolean fault flag for the block::
+
+        with faults.enabled("TRANSACTION_INSTABILITY"):
+            ...
+
+    replaces the hand-rolled try/finally around flag flips; typos raise
+    (AttributeError) instead of silently testing nothing."""
+    mod = sys.modules[__name__]
+    prev = getattr(mod, name)
+    if not isinstance(prev, bool):
+        raise ValueError(f"faults.{name} is not a boolean fault flag")
+    setattr(mod, name, True)
+    try:
+        yield
+    finally:
+        setattr(mod, name, prev)

@@ -39,6 +39,23 @@ class Trace:
         self.record(sim_now, "DEPS_ROUTE", node_id, store_id,
                     f"{route} x{nq}")
 
+    def record_fault(self, sim_now: int, node_id: int, store_id: int,
+                     fault: str, detail: str) -> None:
+        """One device-boundary fault observed by a store's DeviceState
+        (injected or real: kernel launch / transfer / HBM OOM / shadow-
+        verify mismatch), plus the backpressure events (oom.compact /
+        oom.degrade) — the loud trail of the degradation ladder."""
+        self.record(sim_now, "DEVICE_FAULT", node_id, store_id,
+                    f"{fault} {detail}".rstrip())
+
+    def record_quarantine(self, sim_now: int, node_id: int, store_id: int,
+                          state: str, detail: str) -> None:
+        """A device-route health transition (quarantine / reprobe /
+        restore): the state machine that pins a faulted store to the host
+        route and re-probes it on exponential backoff."""
+        self.record(sim_now, "QUARANTINE", node_id, store_id,
+                    f"{state} {detail}".rstrip())
+
     # -- queries -------------------------------------------------------------
     def for_txn(self, needle: str) -> List[Tuple[int, int, str, int, int, str]]:
         return [e for e in self.events if needle in e[5]]

@@ -427,6 +427,11 @@ def bench_hot_keys():
                         "bucketed": dev.n_bucketed_queries,
                         "dense": dev.n_dense_queries,
                         "mesh": dev.n_mesh_queries},
+             "fault_ladder": {"device_faults": dev.n_device_faults,
+                              "quarantines": dev.n_quarantines,
+                              "fallback_queries": dev.n_fallback_queries,
+                              "compactions": dev.n_compactions,
+                              "oom_degraded": int(dev.host_pinned)},
              "note": "low-live-set regime: 90% of the 100k is below the "
                      "durable floor, so the adaptive router serves the "
                      "scan from the host tail (same floors/elision/"
@@ -687,7 +692,13 @@ def main(em: Emitter):
         f"mesh_bucketed_queries={dev.n_mesh_bucketed_queries} "
         f"dispatches={dev.n_dispatches} "
         f"wide_entries={len(dev.deps.wide_entries)} "
-        f"buckets={len(dev.deps.bucket_entries)}\n"
+        f"buckets={len(dev.deps.bucket_entries)} "
+        f"device_faults={dev.n_device_faults} "
+        f"quarantines={dev.n_quarantines} "
+        f"fallback_queries={dev.n_fallback_queries} "
+        f"shadow_mismatches={dev.n_shadow_mismatches} "
+        f"compactions={dev.n_compactions} "
+        f"oom_degraded={int(dev.host_pinned)}\n"
         f"# build={build_rate:.0f} reg/s live_insert+query={live_rate:.0f} op/s\n"
         f"# baseline=host indexed scan (numpy-vectorized reference "
         f"semantics) {host_rate:.1f} q/s median of 5x{len(hq)} queries, "

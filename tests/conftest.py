@@ -19,6 +19,16 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-horizon tier-2 tests (excluded from the "
+        "tier-1 gate via -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "faults: device-fault injection matrix (quarantine / "
+        "host fallback / HBM backpressure; tools/run_fault_matrix.sh "
+        "sweeps these under fixed seeds)")
+
+
 # -- shared DeviceState test fixture --------------------------------------
 # The routing/mesh/perf tiers all drive a bare DeviceState against the
 # minimal store surface its attribution touches; one definition here keeps

@@ -81,14 +81,12 @@ def test_transaction_instability_fault_is_injectable():
     coordination still completes in a healthy network (the hazard it creates
     is a RECOVERY hazard, which the burn harness exists to catch)."""
     from accord_tpu.utils import faults
-    faults.TRANSACTION_INSTABILITY = True
-    try:
+    with faults.enabled("TRANSACTION_INSTABILITY"):
         cluster = make_cluster(seed=7)
         out = submit(cluster, 1, kv_txn([10], {10: ("f",)}))
         cluster.run_until_quiescent()
         assert out[0][1] is None
-    finally:
-        faults.TRANSACTION_INSTABILITY = False
+    assert faults.TRANSACTION_INSTABILITY is False
 
 
 def test_adapter_seam_selects_by_kind():

@@ -105,6 +105,38 @@ def test_burn_boundary_churn_sweep(seed):
     assert result.ops_ok >= 2 * result.ops_failed, f"seed {seed}: {result}"
 
 
+@pytest.mark.faults
+@pytest.mark.parametrize("kind", ["transfer", "all"])
+def test_burn_device_faults_equivalent_and_deterministic(kind):
+    """Device-fault nemesis (--device-faults): with accelerator faults
+    continuously injected at 5% per boundary crossing, the burn must (a)
+    complete with zero unresolved ops and zero node-level failures, (b)
+    produce a protocol stream BYTE-IDENTICAL to the fault-free run at the
+    same seed — same client outcomes, same message counts, same total
+    deps_found (the degradation ladder is invisible), and (c) be
+    deterministic under a same-seed double run including every
+    fault/quarantine counter (the fault stream is seeded too)."""
+    base = run_burn(5, n_ops=60)
+    a = run_burn(5, n_ops=60, device_faults=kind)
+    b = run_burn(5, n_ops=60, device_faults=kind)
+    assert a.ops_unresolved == 0
+    assert a.stats == b.stats, "same-seed fault run must replay exactly"
+    assert a.stats["deps_found"] == base.stats["deps_found"]
+    assert (a.ops_ok, a.ops_failed, a.epochs, a.restarts, a.evictions) == \
+        (base.ops_ok, base.ops_failed, base.epochs, base.restarts,
+         base.evictions)
+    # the ladder's own counters (and routing) may differ; everything the
+    # protocol emitted must not
+    ladder = ("DepsRoute.", "DeviceFault.")
+    skip = {"device_fallback_queries", "device_dispatches"}
+    strip = lambda st: {k: v for k, v in st.items()          # noqa: E731
+                        if not k.startswith(ladder) and k not in skip}
+    assert strip(a.stats) == strip(base.stats)
+    # and the nemesis must have actually bitten
+    assert any(k.startswith("DeviceFault.fault.") for k in a.stats), a.stats
+    assert a.stats.get("device_fallback_queries", 0) > 0
+
+
 @pytest.mark.parametrize("seed", [21, 22])
 def test_post_chaos_quiescence_gate(seed):
     """After chaos/churn stop and the drain completes, a silent window must

@@ -1,0 +1,259 @@
+"""Device-fault tolerance matrix: the degradation ladder must be invisible
+to the protocol.
+
+Every injected fault class x every route (host / bucketed-adaptive device /
+dense; the mesh kernels ride the same dispatch under the 8-device test mesh)
+must yield BYTE-IDENTICAL attributed deps vs. a fault-free run — the
+quarantine -> host-fallback ladder in local.device_index absorbs the fault.
+Plus the state machine itself: quarantine -> exponential backoff -> probe ->
+restore transitions, shadow-verify catching silent result corruption, and
+the HBM budget path compacting below the RedundantBefore floor then
+degrading pinned-to-host instead of dying."""
+
+import numpy as np
+import pytest
+
+from accord_tpu.utils import faults
+from accord_tpu.utils.random_source import RandomSource
+
+from tests.conftest import make_device_state
+from tests.test_routing import _attributed, _build, _csr
+
+pytestmark = pytest.mark.faults
+
+ROUTES = ("host", "device", "dense")
+RAISING = ("kernel_launch", "transfer")
+
+
+def _rng():
+    return RandomSource(0xDEC0)
+
+
+def _dev_q(dev):
+    """Total queries served by ANY device route (the auto test mesh routes
+    'dense' through the sharded kernels)."""
+    return (dev.n_dense_queries + dev.n_bucketed_queries
+            + dev.n_mesh_queries)
+
+
+# ---------------------------------------------------------------------------
+# fault x route equivalence matrix
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("route", ROUTES)
+@pytest.mark.parametrize("kind", RAISING)
+def test_fault_route_matrix_raising(route, kind):
+    """Launch/transfer faults at p=1.0 on every route: the flush fails over
+    to host and the attributed result is byte-identical."""
+    store, dev, safe, entries, floor, qs = _build(seed=31)
+    dev.route_override = route
+    expect_csr = _csr(dev, qs, prune=True)
+    expect = _attributed(dev, safe, qs, prune=True)
+    with faults.device_fault(kind, 1.0, _rng()):
+        got_csr = _csr(dev, qs, prune=True)
+        got = _attributed(dev, safe, qs, prune=True)
+    for a, b in zip(expect_csr, got_csr):
+        np.testing.assert_array_equal(a, b)
+    assert got == expect
+    if route == "host":
+        # the host route never crosses the device boundary: no faults
+        assert dev.n_device_faults == 0
+    else:
+        assert dev.n_device_faults >= 1
+        assert dev.n_quarantines >= 1
+        assert dev._dev_quar_flushes > 0 or dev._dev_backoff > 0
+
+
+@pytest.mark.parametrize("route", ROUTES)
+def test_fault_route_matrix_stale_result(route):
+    """Silent result corruption at p=1.0: paranoia shadow-verify catches the
+    mismatch, quarantines the route, and serves the host answer — results
+    stay byte-identical."""
+    store, dev, safe, entries, floor, qs = _build(seed=32)
+    dev.route_override = route
+    dev.paranoia = True
+    expect = _attributed(dev, safe, qs, prune=True)
+    checks_before = dev.n_shadow_checks
+    with faults.device_fault("stale_result", 1.0, _rng()):
+        got = _attributed(dev, safe, qs, prune=True)
+    assert got == expect
+    if route == "host":
+        assert dev.n_shadow_mismatches == 0
+    else:
+        assert dev.n_shadow_checks > checks_before
+        assert dev.n_shadow_mismatches >= 1
+        assert dev.n_quarantines >= 1
+
+
+def test_paranoia_clean_run_restores_nothing():
+    """Shadow-verify on a healthy device: every check passes, no
+    quarantine, and the device routes keep serving."""
+    store, dev, safe, entries, floor, qs = _build(seed=33)
+    dev.route_override = "dense"
+    dev.paranoia = True
+    _attributed(dev, safe, qs, prune=True)
+    assert dev.n_shadow_checks >= 1
+    assert dev.n_shadow_mismatches == 0
+    assert dev.n_quarantines == 0
+
+
+# ---------------------------------------------------------------------------
+# quarantine state machine: enter -> backoff -> probe -> restore
+# ---------------------------------------------------------------------------
+def test_quarantine_backoff_probe_restore():
+    store, dev, safe, entries, floor, qs = _build(seed=34)
+    dev.route_override = "dense"
+    expect = _attributed(dev, safe, qs, prune=True)
+    with faults.device_fault("transfer", 1.0, _rng()):
+        got = _attributed(dev, safe, qs, prune=True)   # faulted flush
+    assert got == expect
+    assert dev.n_quarantines == 1 and dev._dev_backoff == 1
+    quarantined = dev._dev_quar_flushes
+    assert quarantined > 0
+    # while quarantined every flush is pinned to host (no device queries)
+    dev_mid = _dev_q(dev)
+    fallback_before = dev.n_fallback_queries
+    for _ in range(quarantined):
+        assert _attributed(dev, safe, qs, prune=True) == expect
+    assert _dev_q(dev) == dev_mid
+    assert dev.n_fallback_queries > fallback_before
+    assert dev._dev_quar_flushes == 0
+    # quarantine expired: the next flush is the PROBE — fault gone, so it
+    # succeeds on the device route and restores health
+    assert _attributed(dev, safe, qs, prune=True) == expect
+    assert dev.n_reprobes == 1
+    assert dev.n_restores == 1
+    assert dev._dev_backoff == 0 and dev._dev_quar_flushes == 0
+    assert _dev_q(dev) > dev_mid
+    # and the restored route keeps serving device-side
+    dev_after = _dev_q(dev)
+    assert _attributed(dev, safe, qs, prune=True) == expect
+    assert _dev_q(dev) > dev_after
+
+
+def test_probe_failure_requarantines_deeper():
+    store, dev, safe, entries, floor, qs = _build(seed=35)
+    dev.route_override = "dense"
+    expect = _attributed(dev, safe, qs, prune=True)
+    with faults.device_fault("kernel_launch", 1.0, _rng()):
+        assert _attributed(dev, safe, qs, prune=True) == expect
+        first = dev._dev_quar_flushes
+        # burn down the quarantine with the fault STILL armed: the probe
+        # flush fails and re-quarantines with a deeper backoff
+        for _ in range(first + 1):
+            assert _attributed(dev, safe, qs, prune=True) == expect
+    assert dev._dev_backoff == 2
+    assert dev.n_quarantines == 2
+    assert dev._dev_quar_flushes > first  # exponential: 8+jitter > 4+jitter
+
+
+# ---------------------------------------------------------------------------
+# HBM capacity backpressure: budget -> compaction -> degrade-to-host
+# ---------------------------------------------------------------------------
+def _register_n(dev, n, hlc_base, keyspace=4096):
+    from accord_tpu.local.commands_for_key import InternalStatus
+    from accord_tpu.primitives.keys import IntKey, Keys
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    ids = []
+    for i in range(n):
+        tid = TxnId.create(1, hlc_base + i, TxnKind.Write, Domain.Key,
+                           1 + (i % 5))
+        dev.register(tid, int(InternalStatus.PREACCEPTED),
+                     Keys([IntKey((i * 37) % keyspace)]))
+        ids.append(tid)
+    return ids
+
+
+def test_oom_budget_compacts_below_floor():
+    """At the budget, _grow_capacity frees the below-floor tail instead of
+    doubling: capacity stays flat, the store keeps accepting txns."""
+    from accord_tpu.primitives.keys import Range, Ranges
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    store, dev, safe = make_device_state(mesh=None)
+    dev.device_budget_slots = 128
+    _register_n(dev, 100, hlc_base=1)
+    # everything registered so far is redundant (covered by the watermark)
+    floor = TxnId.create(1, 100_000, TxnKind.ExclusiveSyncPoint,
+                         Domain.Range, 1)
+    store.redundant_before.add_redundant(
+        Ranges.of(Range(-(1 << 60), 1 << 60)), floor)
+    assert dev.deps.capacity == 128
+    _register_n(dev, 100, hlc_base=200_000)   # forces grow past the budget
+    assert dev.n_compactions >= 1
+    assert dev.n_compacted_slots >= 100
+    assert dev.deps.capacity == 128           # compacted, not doubled
+    assert not dev.host_pinned
+
+
+def test_oom_degrades_to_host_when_compaction_cannot_help():
+    """No floor to compact under: the budget breach degrades the store to
+    pinned-host (degraded-but-live) — and results stay correct."""
+    store, dev, safe = make_device_state(mesh=None)
+    dev.route_override = "dense"
+    dev.device_budget_slots = 128
+    _register_n(dev, 200, hlc_base=1)         # no RedundantBefore floor set
+    assert dev.n_compactions >= 1
+    assert dev.host_pinned
+    assert dev.n_oom_degraded == 1
+    assert dev.deps.capacity >= 256           # host arrays still grew: live
+    # flushes now pin to host regardless of the route override, and agree
+    # with an unbudgeted reference store over the same registrations
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    bound = TxnId.create(1, 10_000_000, TxnKind.Write, Domain.Key, 1)
+    qs = [(bound, bound, bound.kind().witnesses(), [(i * 37) % 4096], [])
+          for i in range(8)]
+    got = _attributed(dev, safe, qs, prune=True)
+    store2, dev2, safe2 = make_device_state(mesh=None)
+    dev2.route_override = "dense"
+    _register_n(dev2, 200, hlc_base=1)
+    expect = _attributed(dev2, safe2, qs, prune=True)
+    assert got == expect
+    host_before = dev.n_host_queries
+    _attributed(dev, safe, qs, prune=True)
+    assert dev.n_host_queries > host_before
+
+
+def test_injected_hbm_oom_triggers_backpressure():
+    """The hbm_oom fault class forces the budget path without a budget."""
+    store, dev, safe = make_device_state(mesh=None)
+    with faults.device_fault("hbm_oom", 1.0, _rng()):
+        _register_n(dev, 200, hlc_base=1)
+    assert dev.n_compactions >= 1
+    assert dev.host_pinned and dev.n_oom_degraded == 1
+
+
+# ---------------------------------------------------------------------------
+# faults.enabled context manager (flag flips without try/finally)
+# ---------------------------------------------------------------------------
+def test_enabled_context_manager_flips_and_restores():
+    assert faults.TRANSACTION_INSTABILITY is False
+    with faults.enabled("TRANSACTION_INSTABILITY"):
+        assert faults.TRANSACTION_INSTABILITY is True
+        with faults.enabled("PARANOIA"):
+            assert faults.PARANOIA is True
+        assert faults.PARANOIA is False
+    assert faults.TRANSACTION_INSTABILITY is False
+
+
+def test_enabled_rejects_unknown_flags():
+    with pytest.raises(AttributeError):
+        with faults.enabled("NO_SUCH_FLAG"):
+            pass
+    with pytest.raises(ValueError):
+        with faults.enabled("DEVICE_FAULT_KINDS"):
+            pass
+
+
+def test_inject_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        faults.inject_device_fault("bit_flip", 0.5, _rng())
+
+
+def test_device_fault_context_restores_prior_arming():
+    faults.inject_device_fault("transfer", 0.25, _rng())
+    try:
+        with faults.device_fault("transfer", 1.0, _rng()):
+            assert faults.active_device_faults()["transfer"] == 1.0
+        assert faults.active_device_faults()["transfer"] == 0.25
+    finally:
+        faults.clear_device_faults()
+    assert faults.active_device_faults() == {}

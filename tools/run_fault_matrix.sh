@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Device-fault matrix sweep: every injectable accelerator fault class
+# (kernel_launch / transfer / hbm_oom / stale_result / all) under 3 fixed
+# seeds, each double-run.  Fails loudly on ANY nondeterminism (same-seed
+# fault runs must replay exactly) or deps_found divergence from the
+# fault-free baseline (the degradation ladder must be invisible to the
+# protocol).  Sized to stay well inside the tier-1 870s budget.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+    python - <<'PY'
+import sys
+
+from accord_tpu.sim.burn import run_burn
+from accord_tpu.utils.faults import DEVICE_FAULT_KINDS
+
+SEEDS = (0, 5, 11)
+KINDS = sorted(DEVICE_FAULT_KINDS) + ["all"]
+N_OPS = 60
+
+failures = []
+for seed in SEEDS:
+    base = run_burn(seed, n_ops=N_OPS)
+    print(f"seed {seed} baseline: {base} deps_found={base.stats['deps_found']}",
+          flush=True)
+    for kind in KINDS:
+        a = run_burn(seed, n_ops=N_OPS, device_faults=kind)
+        b = run_burn(seed, n_ops=N_OPS, device_faults=kind)
+        faults_fired = sum(v for k, v in a.stats.items()
+                           if k.startswith("DeviceFault.fault."))
+        line = (f"seed {seed} {kind:>13}: ok={a.ops_ok} "
+                f"unresolved={a.ops_unresolved} "
+                f"deps_found={a.stats['deps_found']} "
+                f"faults={faults_fired} "
+                f"fallback={a.stats['device_fallback_queries']}")
+        problems = []
+        if a.stats != b.stats:
+            diff = {k for k in set(a.stats) | set(b.stats)
+                    if a.stats.get(k) != b.stats.get(k)}
+            problems.append(f"NONDETERMINISTIC: {sorted(diff)[:6]}")
+        if a.ops_unresolved:
+            problems.append(f"{a.ops_unresolved} ops unresolved")
+        if a.stats["deps_found"] != base.stats["deps_found"]:
+            problems.append(
+                f"deps_found diverged: {a.stats['deps_found']} != "
+                f"{base.stats['deps_found']}")
+        if (a.ops_ok, a.ops_failed) != (base.ops_ok, base.ops_failed):
+            problems.append("client outcomes diverged from baseline")
+        if problems:
+            failures.append(f"seed {seed} kind {kind}: " + "; ".join(problems))
+            line += "  <-- " + "; ".join(problems)
+        print(line, flush=True)
+
+if failures:
+    print("\nFAULT MATRIX FAILED:")
+    for f in failures:
+        print("  " + f)
+    sys.exit(1)
+print("\nfault matrix clean: every class x seed deterministic and "
+      "byte-equivalent to the fault-free baseline")
+PY
