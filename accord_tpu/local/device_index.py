@@ -161,6 +161,33 @@ def _scatter_bucket_rows(dev, idx, rows):
     return tuple(a.at[idx].set(r) for a, r in zip(dev, rows))
 
 
+def _host_index_of(status, lo_a, hi_a, msb, lsb, node, fkey):
+    """Build the host-route index (see _DepsMirror.host_index) from
+    explicit arrays — shared by the live cached path and the snapshot-based
+    fused fallback/shadow path.  ``fkey`` is the normalized floor (None =
+    no floor)."""
+    live = (status >= 0) & (status != dk.SLOT_INVALIDATED)
+    if fkey is not None:
+        from ..ops.packing import to_u64
+        fm = np.uint64(to_u64(to_i64(fkey.msb)))
+        fl = np.uint64(to_u64(to_i64(fkey.lsb)))
+        fn = np.int32(fkey.node)
+        um = msb.astype(np.uint64)
+        ul = lsb.astype(np.uint64)
+        live &= ((um > fm) | ((um == fm)
+                             & ((ul > fl) | ((ul == fl) & (node >= fn)))))
+    j = np.nonzero(live)[0]
+    lo, hi = lo_a[j], hi_a[j]
+    used = lo <= hi
+    pt = used & (lo == hi)
+    rr, cc = np.nonzero(pt)
+    ptok = lo[rr, cc]
+    order = np.argsort(ptok, kind="stable")
+    rr2, cc2 = np.nonzero(used & ~pt)
+    return (ptok[order], j[rr][order], cc[order],
+            lo[rr2, cc2], hi[rr2, cc2], j[rr2], cc2)
+
+
 class _DepsMirror:
     """Host mirror of one store's DepsTable, with dirty-row tracking, plus
     the host half of the bucketed interval index (the CINTIA-analogue in
@@ -207,6 +234,16 @@ class _DepsMirror:
         self.free_slots: List[int] = list(range(capacity - 1, -1, -1))
         self._dirty: Set[int] = set()
         self._device: Optional[dk.DepsTable] = None
+        # mesh-sharded slot-table copy, cached SEPARATELY from the
+        # single-device one (r08 satellite: the router alternating
+        # single-device and mesh routes between flushes used to clobber
+        # one consumer's copy with the other's placement and re-upload —
+        # or worse, implicitly reshard — on every switch).  Keyed on the
+        # mutation version: the dep mask reads only liveness from the
+        # status column, and every mutation it can observe (alloc/free,
+        # invalidate, footprint growth) bumps ``version``
+        self._device_sh: Optional[dk.DepsTable] = None
+        self._device_sh_key = None
         # -- bucket index (host truth) --
         self.bucket_row: Dict[int, int] = {}     # bucket id -> dense row
         self.bucket_entries: List[List[Tuple[int, int, int]]] = []
@@ -216,8 +253,12 @@ class _DepsMirror:
         self._bdev = None                         # jnp 7-tuple
         self._bdev_pending: Set[int] = set()      # rows _bdev hasn't seen
         self._g_cap = 0
-        self._whost = None                        # 7 host wide arrays
-        self._whost_key = None
+        # wide/straggler host arrays cached PER PADDED WIDTH (r08): the
+        # single-device and mesh consumers may ask for different pow2
+        # floors, and alternating routes between flushes must not rebuild
+        # (and re-upload) the wide list on every switch — each width keeps
+        # its own copy keyed on the wide version counter
+        self._whost_cache: Dict[int, tuple] = {}  # w -> (wide_version, arrs)
         self._wdev = None                         # (wlo, whi, wslot...) jnp
         self._wdev_key = None
         self._bsh = None                          # mesh-sharded BucketTable
@@ -236,6 +277,12 @@ class _DepsMirror:
         self.bucket_version = 0
         self.wide_version = 0
         self.n_live = 0
+        # ``mut_version`` bumps on EVERY column write (unlike ``version``,
+        # which skips live->live status moves the kernels cannot observe):
+        # it keys the deferred-collect snapshot cache, whose columns the
+        # host attribution DOES read in full
+        self.mut_version = 0
+        self._snap = None
         self._fstats = None                       # cached floor stats
         self._hidx = None                         # cached host-route index
         self._hidx_key = None
@@ -361,10 +408,13 @@ class _DepsMirror:
     def _sync_wide_host(self, floor: int):
         """Host arrays for the wide/straggler entries, padded to a pow2 of
         at least ``floor`` (the mesh caller passes its device count so the
-        wide dimension row-shards evenly)."""
+        wide dimension row-shards evenly).  Cached per padded width and
+        keyed on the wide version counter, so single-device and mesh
+        consumers asking for different widths never invalidate each
+        other's copy."""
         w = _pow2_at_least(max(len(self.wide_entries), 1), floor)
-        key = (self.wide_version, w)
-        if self._whost is None or self._whost_key != key:
+        hit = self._whost_cache.get(w)
+        if hit is None or hit[0] != self.wide_version:
             wlo = np.full(w, dk.PAD_LO, np.int64)
             whi = np.full(w, dk.PAD_HI, np.int64)
             wslot = np.full(w, -1, np.int32)
@@ -380,9 +430,13 @@ class _DepsMirror:
                 wlsb[i] = self.lsb[s]
                 wnode[i] = self.node[s]
                 wkind[i] = self.kind[s]
-            self._whost = (wlo, whi, wslot, wmsb, wlsb, wnode, wkind)
-            self._whost_key = key
-        return self._whost
+            hit = (self.wide_version,
+                   (wlo, whi, wslot, wmsb, wlsb, wnode, wkind))
+            self._whost_cache[w] = hit
+            if len(self._whost_cache) > 4:   # widths only grow; drop stale
+                for stale_w in sorted(self._whost_cache)[:-4]:
+                    del self._whost_cache[stale_w]
+        return hit[1]
 
     def bucket_device(self) -> "dk.BucketTable":
         """Sync the bucket index to the (single) device — dirty-row scatter,
@@ -404,10 +458,11 @@ class _DepsMirror:
                 tuple(a[idx] for a in self._bhost))
             self._bdev_pending.clear()
         whost = self._sync_wide_host(16)
-        if self._wdev is None or self._wdev_key != self._whost_key:
+        wkey = (self.wide_version, whost[0].shape[0])
+        if self._wdev is None or self._wdev_key != wkey:
             faults.check("transfer", "wide upload")
             self._wdev = tuple(jnp.asarray(a) for a in whost)
-            self._wdev_key = self._whost_key
+            self._wdev_key = wkey
         return dk.BucketTable(*self._bdev, *self._wdev)
 
     def bucket_device_sharded(self, mesh) -> "dk.BucketTable":
@@ -451,6 +506,7 @@ class _DepsMirror:
         self.hi[slot] = dk.PAD_HI
         self._dirty.add(slot)
         self.version += 1
+        self.mut_version += 1
         self.n_live += 1
         return slot
 
@@ -470,6 +526,7 @@ class _DepsMirror:
         self.free_slots.append(slot)
         self._dirty.add(slot)
         self.version += 1
+        self.mut_version += 1
 
     def _grow_capacity(self) -> None:
         if self.owner is not None and not self.owner._approve_grow(self):
@@ -493,7 +550,10 @@ class _DepsMirror:
         self.eknown = _grow(self.eknown, new, False)
         self.free_slots.extend(range(new - 1, old - 1, -1))
         self.capacity = new
+        self.mut_version += 1
+        self._snap = None
         self._device = None  # shape changed: full re-upload
+        self._device_sh = None
 
     def _grow_intervals(self) -> None:
         new_m = self.max_intervals * 2
@@ -503,7 +563,10 @@ class _DepsMirror:
         hi[:, : self.max_intervals] = self.hi
         self.lo, self.hi = lo, hi
         self.max_intervals = new_m
+        self.mut_version += 1
+        self._snap = None
         self._device = None
+        self._device_sh = None
 
     def add_intervals(self, slot: int, tokens: Sequence[int],
                       ranges: Sequence[Range]) -> None:
@@ -531,6 +594,7 @@ class _DepsMirror:
             used += 1
             self._dirty.add(slot)
             self.version += 1
+            self.mut_version += 1
             self._bucket_add(slot, lo_v, hi_v)
 
     def set_status(self, slot: int, status: int) -> None:
@@ -547,6 +611,7 @@ class _DepsMirror:
                 self.version += 1
             self.status[slot] = status
             self._dirty.add(slot)
+            self.mut_version += 1
 
     # -- host route (the third dispatch target; see module docstring) -------
     def _above_floor_mask(self, floor_id) -> np.ndarray:
@@ -610,23 +675,13 @@ class _DepsMirror:
         key = (fkey, self.version)
         if self._hidx is not None and self._hidx_key == key:
             return self._hidx
-        live = (self.status >= 0) & (self.status != dk.SLOT_INVALIDATED)
-        if fkey is not None:
-            live &= self._above_floor_mask(fkey)
-        j = np.nonzero(live)[0]
-        lo, hi = self.lo[j], self.hi[j]
-        used = lo <= hi
-        pt = used & (lo == hi)
-        rr, cc = np.nonzero(pt)
-        ptok = lo[rr, cc]
-        order = np.argsort(ptok, kind="stable")
-        rr2, cc2 = np.nonzero(used & ~pt)
-        self._hidx = (ptok[order], j[rr][order], cc[order],
-                      lo[rr2, cc2], hi[rr2, cc2], j[rr2], cc2)
+        self._hidx = _host_index_of(self.status, self.lo, self.hi,
+                                    self.msb, self.lsb, self.node, fkey)
         self._hidx_key = key
         return self._hidx
 
-    def host_pairs(self, qnp: np.ndarray, q_m: int, floor_id):
+    def host_pairs(self, qnp: np.ndarray, q_m: int, floor_id,
+                   snapshot=None):
         """The host route's candidate generation: (b_idx, j_idx) pairs
         satisfying the EXACT kernel predicate (liveness + floor structurally
         via the index; witness / earlier / not-self as vectorized compares
@@ -634,8 +689,27 @@ class _DepsMirror:
         exact emit triples (pair row, entry interval column, query interval
         column) the probes discovered — the same set np.nonzero over the
         device routes' overlap matrix yields, so attribution sees identical
-        inputs and results are bit-identical by construction."""
-        ptok, pslot, pcol, rlo, rhi, rslot, rcol = self.host_index(floor_id)
+        inputs and results are bit-identical by construction.
+
+        ``snapshot`` = (msb, lsb, node, kind, status, lo, hi) computes the
+        scan against a begin-time copy of the mirror instead of the live
+        arrays (no caching): the fused harvest path runs a store task
+        AFTER dispatch, and its host fallback / shadow verify must answer
+        for the snapshot the device kernel scanned, not for mutations that
+        landed in between."""
+        if snapshot is not None:
+            s_msb, s_lsb, s_node, s_kind, s_status, s_lo, s_hi = snapshot
+            fkey = floor_id if floor_id is not None \
+                and floor_id > TxnId.NONE else None
+            idx = _host_index_of(s_status, s_lo, s_hi, s_msb, s_lsb,
+                                 s_node, fkey)
+            cap = len(s_msb)
+        else:
+            s_msb, s_lsb, s_node, s_kind = (self.msb, self.lsb, self.node,
+                                            self.kind)
+            idx = self.host_index(floor_id)
+            cap = self.capacity
+        ptok, pslot, pcol, rlo, rhi, rslot, rcol = idx
         lo = qnp[:, 7:7 + q_m]
         hi = qnp[:, 7 + q_m:7 + 2 * q_m]
         used = lo <= hi
@@ -676,8 +750,8 @@ class _DepsMirror:
         cj = np.concatenate(parts_j).astype(np.int64)
         cm = np.concatenate(parts_m).astype(np.int64)
         cq = np.concatenate(parts_q).astype(np.int64)
-        em, el, en = self.msb[cj], self.lsb[cj], self.node[cj]
-        keep = (qnp[cb, 3] >> self.kind[cj]) & 1 > 0
+        em, el, en = s_msb[cj], s_lsb[cj], s_node[cj]
+        keep = (qnp[cb, 3] >> s_kind[cj]) & 1 > 0
         uem, ubm = em.astype(np.uint64), qnp[cb, 0].astype(np.uint64)
         uel, ubl = el.astype(np.uint64), qnp[cb, 1].astype(np.uint64)
         bn = qnp[cb, 2]
@@ -686,30 +760,59 @@ class _DepsMirror:
         keep &= ~((em == qnp[cb, 4]) & (el == qnp[cb, 5])
                   & (en == qnp[cb, 6]))
         cb, cj, cm, cq = cb[keep], cj[keep], cm[keep], cq[keep]
-        pair, p_i = np.unique(cb * np.int64(self.capacity) + cj,
+        pair, p_i = np.unique(cb * np.int64(cap) + cj,
                               return_inverse=True)
-        return pair // self.capacity, pair % self.capacity, (p_i, cm, cq)
+        return pair // cap, pair % cap, (p_i, cm, cq)
+
+    def snapshot_cols(self):
+        """(ids 9-tuple, ivs 3-tuple, kind) copies of every column the
+        deferred collect + attribution path reads, cached on
+        ``mut_version`` — back-to-back deferred flushes (pipelined bench
+        batches, fused-harvest members) over an unmutated mirror share ONE
+        copy instead of re-copying O(capacity x intervals) bytes per
+        flush.  Consumers must treat the arrays as frozen."""
+        s = self._snap
+        if s is None or s[0] != self.mut_version:
+            ids = (self.msb.copy(), self.lsb.copy(), self.node.copy(),
+                   self.obj.copy(), self.status.copy(), self.emsb.copy(),
+                   self.elsb.copy(), self.enode.copy(),
+                   self.eknown.copy())
+            ivs = (self.lo.copy(), self.hi.copy(), self.domain.copy())
+            s = self._snap = (self.mut_version, ids, ivs,
+                              self.kind.copy())
+        return s[1], s[2], s[3]
 
     # -- device sync --------------------------------------------------------
     def device_table_sharded(self, mesh) -> dk.DepsTable:
-        """Mesh placement: the slot dimension sharded across the mesh.  Any
-        dirt triggers a full sharded re-upload (the incremental scatter path
-        is single-device; on the virtual CPU mesh correctness is the point,
-        and a real multi-chip deployment would shard the scatter too)."""
-        if self._device is None or self._dirty:
-            faults.check("transfer", "sharded slot upload")
-            from jax.sharding import NamedSharding
-            from jax.sharding import PartitionSpec as P
-            from ..parallel.sharded import STORE_AXIS
-            s1 = NamedSharding(mesh, P(STORE_AXIS))
-            s2 = NamedSharding(mesh, P(STORE_AXIS, None))
-            self._device = dk.DepsTable(
-                jax.device_put(self.msb, s1), jax.device_put(self.lsb, s1),
-                jax.device_put(self.node, s1), jax.device_put(self.kind, s1),
-                jax.device_put(self.status, s1), jax.device_put(self.lo, s2),
-                jax.device_put(self.hi, s2))
-            self._dirty.clear()
-        return self._device
+        """Mesh placement: the slot dimension sharded across the mesh,
+        cached SEPARATELY from the single-device copy and keyed on the
+        mutation version counter — the router alternating single-device
+        and mesh routes between flushes keeps BOTH copies live instead of
+        invalidating one whenever the other syncs (pre-r08 this clobbered
+        the shared cache and paid an implicit reshard per alternation).
+        Any version drift triggers a full sharded re-upload (the
+        incremental scatter path is single-device; on the virtual CPU mesh
+        correctness is the point, and a real multi-chip deployment would
+        shard the scatter too).  Live->live status moves don't bump the
+        version: the dep mask reads only liveness from the status column,
+        so a stale live status byte cannot change any answer."""
+        key = (self.version, self.capacity, self.max_intervals,
+               tuple(dev.id for dev in mesh.devices.flat))
+        if self._device_sh is not None and self._device_sh_key == key:
+            return self._device_sh
+        faults.check("transfer", "sharded slot upload")
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.sharded import STORE_AXIS
+        s1 = NamedSharding(mesh, P(STORE_AXIS))
+        s2 = NamedSharding(mesh, P(STORE_AXIS, None))
+        self._device_sh = dk.DepsTable(
+            jax.device_put(self.msb, s1), jax.device_put(self.lsb, s1),
+            jax.device_put(self.node, s1), jax.device_put(self.kind, s1),
+            jax.device_put(self.status, s1), jax.device_put(self.lo, s2),
+            jax.device_put(self.hi, s2))
+        self._device_sh_key = key
+        return self._device_sh
 
     def device_table(self) -> dk.DepsTable:
         if self._device is None or self._dirty:
@@ -742,6 +845,16 @@ class _DepsMirror:
         return self._device
 
 
+@jax.jit
+def _scatter_drain_scalars(status, em, el, en, idx, s_new, em_new, el_new,
+                           en_new):
+    """One fused dirty-row update for the drain state's scalar columns —
+    the delta-upload path that replaced the r07 whole-graph upload per
+    tick (the adjacency re-uploads only when edges or membership change)."""
+    return (status.at[idx].set(s_new), em.at[idx].set(em_new),
+            el.at[idx].set(el_new), en.at[idx].set(en_new))
+
+
 class _DrainMirror:
     """Host mirror of the execution drain graph: SPARSE adjacency over the
     store's in-flight (stable-but-unapplied) txns and their direct
@@ -749,7 +862,16 @@ class _DrainMirror:
     reference's WaitingOn bitset-over-txnIds (ref: local/Command.java:
     1295-1332).  The r04 dense bool[capacity, capacity] matrix needed
     O(N^2) host memory (10^10 entries at the 100k-in-flight spec); edge
-    count here is bounded by the live waiting sets."""
+    count here is bounded by the live waiting sets.
+
+    r08 delta uploads: the compacted device state is CACHED between ticks.
+    ``version`` bumps on any device-visible mutation, ``membership_version``
+    on alloc/free (the live set — and therefore the compaction mapping —
+    changed), ``edge_version`` on adjacency changes; status/executeAt moves
+    land in ``_dirty_scalars``.  A tick whose membership and edges are
+    unchanged scatter-updates only the dirty scalar rows of the cached
+    device state instead of rebuilding and re-uploading the whole graph —
+    exactly the dirty-row policy the deps table already uses."""
 
     def __init__(self, capacity: int = _MIN_CAPACITY):
         self.capacity = capacity
@@ -764,19 +886,32 @@ class _DrainMirror:
         self.slot_of: Dict[TxnId, int] = {}
         self.id_of: Dict[int, TxnId] = {}
         self.free_slots: List[int] = list(range(capacity - 1, -1, -1))
+        self.version = 0
+        self.membership_version = 0
+        self.edge_version = 0
+        self._dirty_scalars: Set[int] = set()
+        self._state_cache: Optional[Dict[str, object]] = None
 
     # -- edge maintenance ---------------------------------------------------
     def add_edge(self, waiter: int, dep: int) -> None:
         self.deps_of[waiter].add(dep)
         self.waiters_of[dep].add(waiter)
+        self.edge_version += 1
+        self.version += 1
 
     def clear_deps(self, slot: int) -> None:
+        if self.deps_of[slot]:
+            self.edge_version += 1
+            self.version += 1
         for dep in self.deps_of[slot]:
             self.waiters_of[dep].discard(slot)
         self.deps_of[slot].clear()
 
     def _clear_edges(self, slot: int) -> None:
         self.clear_deps(slot)
+        if self.waiters_of[slot]:
+            self.edge_version += 1
+            self.version += 1
         for w in self.waiters_of[slot]:
             self.deps_of[w].discard(slot)
         self.waiters_of[slot].clear()
@@ -797,6 +932,8 @@ class _DrainMirror:
         self.awaits_all[slot] = txn_id.kind().awaits_only_deps()
         self._clear_edges(slot)
         self.active[slot] = False
+        self.membership_version += 1
+        self.version += 1
         return slot
 
     def free(self, slot: int) -> None:
@@ -807,6 +944,8 @@ class _DrainMirror:
         self._clear_edges(slot)
         self.active[slot] = False
         self.free_slots.append(slot)
+        self.membership_version += 1
+        self.version += 1
 
     def _grow_capacity(self) -> None:
         old = self.capacity
@@ -824,11 +963,20 @@ class _DrainMirror:
 
     def set_status(self, slot: int, status: int,
                    execute_at: Optional[Timestamp]) -> None:
+        changed = int(self.status[slot]) != status
         self.status[slot] = status
         if execute_at is not None:
-            self.exec_msb[slot] = to_i64(execute_at.msb)
-            self.exec_lsb[slot] = to_i64(execute_at.lsb)
-            self.exec_node[slot] = execute_at.node
+            em, el = to_i64(execute_at.msb), to_i64(execute_at.lsb)
+            en = execute_at.node
+            changed |= (int(self.exec_msb[slot]) != em
+                        or int(self.exec_lsb[slot]) != el
+                        or int(self.exec_node[slot]) != en)
+            self.exec_msb[slot] = em
+            self.exec_lsb[slot] = el
+            self.exec_node[slot] = en
+        if changed:
+            self.version += 1
+            self._dirty_scalars.add(slot)
 
     # above this live count the drain ships the ELL (padded row-index)
     # adjacency instead of the dense matrix: dense [n, n] at 100k in-flight
@@ -841,7 +989,42 @@ class _DrainMirror:
         in-flight set, not the high-water capacity.  Returns (state,
         live_slot_index); ``state`` is a dense DrainState below DENSE_MAX
         live slots (MXU matvec fixpoint) and an EllDrainState above it
-        (gather fixpoint — no O(N^2) anywhere)."""
+        (gather fixpoint — no O(N^2) anywhere).
+
+        The device state is cached between ticks (r08): an unchanged
+        mirror re-ticks with ZERO upload; membership- and edge-stable
+        mutations (status / executeAt moves — the common tick-to-tick
+        churn) scatter only the dirty rows of the scalar columns into the
+        cached state; only a changed live set or adjacency rebuilds."""
+        c = self._state_cache
+        if c is not None and c["version"] == self.version:
+            return c["state"], c["live"]
+        if (c is not None and c["membership"] == self.membership_version
+                and c["edges"] == self.edge_version):
+            # scalar delta: the live set and adjacency are exactly the
+            # cached upload's — scatter the dirty status/executeAt rows
+            rows = np.array(sorted(self._dirty_scalars), np.int64)
+            li = c["local"][rows]
+            ok = li >= 0
+            rows, li = rows[ok], li[ok].astype(np.int32)
+            st = c["state"]
+            if len(li):
+                padded = _pow2_at_least(len(li), 8)
+                idx = np.concatenate(
+                    [li, np.full(padded - len(li), li[-1], np.int32)])
+                rws = np.concatenate(
+                    [rows, np.full(padded - len(rows), rows[-1], np.int64)])
+                new_s, new_em, new_el, new_en = _scatter_drain_scalars(
+                    st.status, st.exec_msb, st.exec_lsb, st.exec_node,
+                    jnp.asarray(idx), self.status[rws].astype(np.int32),
+                    self.exec_msb[rws], self.exec_lsb[rws],
+                    self.exec_node[rws].astype(np.int32))
+                st = st._replace(status=new_s, exec_msb=new_em,
+                                 exec_lsb=new_el, exec_node=new_en)
+                c["state"] = st
+            c["version"] = self.version
+            self._dirty_scalars.clear()
+            return st, c["live"]
         live = np.nonzero(self.status != dk.SLOT_FREE)[0]
         n = _pow2_at_least(len(live), 16)
         local = np.full(self.capacity, -1, np.int32)
@@ -872,7 +1055,7 @@ class _DrainMirror:
             state = drk.DrainState(jnp.asarray(adj), jnp.asarray(status),
                                    jnp.asarray(em), jnp.asarray(el),
                                    jnp.asarray(en), jnp.asarray(aw))
-            return state, live
+            return self._cache_state(state, live, local)
         max_deg = max((len(self.deps_of[int(i)]) for i in live), default=0)
         d = _pow2_at_least(max(max_deg, 1), 4)
         adj_idx = np.full((n, d), -1, np.int32)
@@ -886,6 +1069,14 @@ class _DrainMirror:
         state = drk.EllDrainState(jnp.asarray(adj_idx), jnp.asarray(status),
                                   jnp.asarray(em), jnp.asarray(el),
                                   jnp.asarray(en), jnp.asarray(aw))
+        return self._cache_state(state, live, local)
+
+    def _cache_state(self, state, live, local):
+        self._state_cache = {"state": state, "live": live, "local": local,
+                             "version": self.version,
+                             "membership": self.membership_version,
+                             "edges": self.edge_version}
+        self._dirty_scalars.clear()
         return state, live
 
     def sweep_free(self) -> None:
@@ -1089,6 +1280,13 @@ class DeviceState:
         self.n_mesh_bucketed_queries = 0
         self.n_dispatches = 0       # kernel dispatches: n_queries /
         #                             n_dispatches = mean lived batch size
+        # r08 launch coalescing (local.dispatch.DeviceDispatcher): flushes
+        # and drain ticks of THIS store that rode a fused, store-tagged
+        # launch shared with sibling stores (launch counts live on the
+        # dispatcher — one fused launch serves many store flushes)
+        self.n_fused_flushes = 0
+        self.n_fused_queries = 0
+        self.n_fused_ticks = 0
         # routing controls (see module docstring): None = adaptive;
         # "host" / "dense" pin a route; "device" = adaptive kernels but
         # never the host route (the pre-routing behavior, used by kernel
@@ -1180,6 +1378,7 @@ class DeviceState:
             self.deps.elsb[slot] = to_i64(execute_at.lsb)
             self.deps.enode[slot] = execute_at.node
             self.deps.eknown[slot] = True
+            self.deps.mut_version += 1   # snapshot columns changed
         if new == dk.SLOT_INVALIDATED and cur != dk.SLOT_INVALIDATED:
             # de-index: the bucket path excludes invalidated entries
             # structurally (the dense path excludes them by status)
@@ -1242,6 +1441,22 @@ class DeviceState:
         self._dev_quar_flushes = 0
         self.n_restores += 1
         self._fault_event("restore")
+
+    def _flush_gate(self, nq: int):
+        """The degradation-ladder gate shared by the solo and fused flush
+        paths: (forced, may_probe).  ``forced`` pins this flush to the host
+        route ("host-pinned" / "host-fallback", consuming one quarantined
+        flush); ``may_probe`` marks that a device-bound flush would be the
+        quarantine probe (the caller records the probe only if it actually
+        takes a device route)."""
+        if self.host_pinned:
+            self.n_fallback_queries += nq
+            return "host-pinned", False
+        if self._dev_quar_flushes > 0:
+            self._dev_quar_flushes -= 1
+            self.n_fallback_queries += nq
+            return "host-fallback", False
+        return None, self._dev_backoff > 0
 
     def _approve_grow(self, mirror: _DepsMirror) -> bool:
         """HBM capacity backpressure: called by _DepsMirror._grow_capacity
@@ -1576,8 +1791,16 @@ class DeviceState:
         n_queries / n_dispatches)."""
         self._q_pending.append((query, builder, done))
         if len(self._q_pending) == 1:
-            from .command_store import PreLoadContext
             node = self.store.node
+            # node-level dispatch scheduler (r08): all stores of this node
+            # whose flushes become runnable in the same event-loop step
+            # register with ONE dispatcher event, which coalesces their
+            # device launches when the cost model says fusion wins
+            disp = getattr(node, "dispatcher", None)
+            if disp is not None:
+                disp.register_flush(self)
+                return
+            from .command_store import PreLoadContext
             # one scheduler hop (zero sim-time) so every same-instant
             # message's store task enqueues BEFORE the flush runs
             node.scheduler.now(lambda: self.store.execute(
@@ -1586,6 +1809,12 @@ class DeviceState:
     def _flush_queries(self, safe) -> None:
         batch = self._q_pending
         self._q_pending = []
+        self._flush_batch(safe, batch)
+
+    def _flush_batch(self, safe, batch) -> None:
+        """Serve one claimed batch of enqueued queries solo: the classic
+        atomic begin+collect+attribute within this store task (the
+        dispatcher routes a store here when fusion does not pay)."""
         if not batch:
             return
         try:
@@ -1684,7 +1913,16 @@ class DeviceState:
         for _ in range(reps):
             _ = ((a < c) | ((a == c) & (c < a))).sum()
         c_host = max((_time.perf_counter() - t0) / (reps * n), 1e-11)
-        return {"rtt": rtt, "c_dev": c_dev, "c_host": c_host}
+        # host per-element column-copy cost (the deferred-harvest mirror
+        # snapshot the fused pricing charges) — memcpy, ~20x cheaper per
+        # element than the compare chain
+        _ = a.copy()
+        t0 = _time.perf_counter()
+        for _ in range(8):
+            _ = a.copy()
+        c_copy = max((_time.perf_counter() - t0) / (8 * n), 1e-12)
+        return {"rtt": rtt, "c_dev": c_dev, "c_host": c_host,
+                "c_copy": c_copy}
 
     @staticmethod
     def _measure_mesh_rtt(mesh) -> float:
@@ -1774,6 +2012,30 @@ class DeviceState:
         dev_cost = 2.0 * rtt + calib["c_dev"] * dev_elems
         return "host" if host_cost < dev_cost else "device"
 
+    def _batch_floor(self, qnp: np.ndarray, q_m: int):
+        """(floor_id, np prune triple) for a batch: the conservative
+        batch-global RedundantBefore floor with the (rb.version, window)
+        memo — shared by the solo begin path and the fused dispatcher
+        prep.  (None, None) when no floor applies."""
+        rb = getattr(self.store, "redundant_before", None)
+        if rb is None:
+            return None, None
+        lo_cols = qnp[:, 7:7 + q_m]
+        hi_cols = qnp[:, 7 + q_m:7 + 2 * q_m]
+        used = lo_cols <= hi_cols
+        if not used.any():
+            return None, None
+        window = (rb.version, int(lo_cols[used].min()),
+                  int(hi_cols[used].max()))
+        if self._floor_memo is not None and self._floor_memo[0] == window:
+            f = self._floor_memo[1]
+        else:
+            f = rb.min_floor_over(window[1], window[2])
+            self._floor_memo = (window, f)
+        if f > TxnId.NONE:
+            return f, (to_i64(f.msb), to_i64(f.lsb), np.int32(f.node))
+        return None, None
+
     def deps_query_batch_begin(self, queries, immediate: bool = False,
                                prune_floors: bool = False):
         """Dispatch a batched deps scan WITHOUT waiting: one fused query
@@ -1805,25 +2067,11 @@ class DeviceState:
         # documents no floors and never prunes
         prune = None
         floor_id = None
-        rb = getattr(self.store, "redundant_before", None)
-        if prune_floors and rb is not None:
-            lo_cols = qnp[:, 7:7 + q_m]
-            hi_cols = qnp[:, 7 + q_m:7 + 2 * q_m]
-            used = lo_cols <= hi_cols
-            if used.any():
-                window = (rb.version, int(lo_cols[used].min()),
-                          int(hi_cols[used].max()))
-                if self._floor_memo is not None and \
-                        self._floor_memo[0] == window:
-                    f = self._floor_memo[1]
-                else:
-                    f = rb.min_floor_over(window[1], window[2])
-                    self._floor_memo = (window, f)
-                if f > TxnId.NONE:
-                    floor_id = f
-                    prune = (jnp.asarray(to_i64(f.msb)),
-                             jnp.asarray(to_i64(f.lsb)),
-                             jnp.asarray(np.int32(f.node)))
+        if prune_floors:
+            floor_id, prune_np = self._batch_floor(qnp, q_m)
+            if floor_id is not None:
+                prune = (jnp.asarray(prune_np[0]), jnp.asarray(prune_np[1]),
+                         jnp.asarray(prune_np[2]))
 
         def dispatch(kind, rows, qcols=None):
             """rows: np int64 array of query indices for this part, padded
@@ -1957,22 +2205,16 @@ class DeviceState:
         # its success restores the device routes, its failure re-
         # quarantines deeper
         probing = False
-        forced = None
-        if self.host_pinned:
-            forced = "host-pinned"
-        elif self._dev_quar_flushes > 0:
-            self._dev_quar_flushes -= 1
-            forced = "host-fallback"
+        forced, may_probe = self._flush_gate(nq)
         if forced is not None:
             route = "host"
-            self.n_fallback_queries += nq
         else:
             route = self.route_override
             if route is None:
                 route = self._choose_route(qnp, q_m,
                                            floor_id if prune_floors
                                            else None)
-            if route != "host" and self._dev_backoff > 0:
+            if route != "host" and may_probe:
                 probing = True
                 self.n_reprobes += 1
                 self._fault_event("reprobe", f"route={route}")
@@ -2045,14 +2287,9 @@ class DeviceState:
             # snapshot the mirror's id + interval columns: the mirror
             # mutates in place, and a slot freed+reallocated between begin
             # and end would otherwise resolve this batch's indices to the
-            # WRONG TxnId (or footprint)
-            ids = (self.deps.msb.copy(), self.deps.lsb.copy(),
-                   self.deps.node.copy(), self.deps.obj.copy(),
-                   self.deps.status.copy(), self.deps.emsb.copy(),
-                   self.deps.elsb.copy(), self.deps.enode.copy(),
-                   self.deps.eknown.copy())
-            ivs = (self.deps.lo.copy(), self.deps.hi.copy(),
-                   self.deps.domain.copy())
+            # WRONG TxnId (or footprint).  The copy is version-cached:
+            # pipelined batches over an unmutated mirror share one
+            ids, ivs, _kind = self.deps.snapshot_cols()
         fmeta = {"floor_id": floor_id, "probing": probing,
                  "immediate": immediate}
         return (parts, ids, ivs, qnp, q_m, list(queries), fmeta)
@@ -2258,22 +2495,8 @@ class DeviceState:
             pair = np.unique(b_idx * cap + j_idx)
             b_idx, j_idx = pair // cap, pair % cap
         # exact geometry on the sparse pair list
-        lo, hi, _dom = ivs
-        lo_p, hi_p = lo[j_idx], hi[j_idx]                       # [P, M]
-        used = lo_p <= hi_p
-        qlo_p = qnp[b_idx, 7:7 + q_m]                           # [P, Q]
-        qhi_p = qnp[b_idx, 7 + q_m:7 + 2 * q_m]
-        overlap = (used[:, :, None]
-                   & (lo_p[:, :, None] <= qhi_p[:, None, :])
-                   & (qlo_p[:, None, :] <= hi_p[:, :, None]))   # [P, M, Q]
-        p_i, m_i, q_i = np.nonzero(overlap)
-        # drop pairs with no exact overlap (bounding-box false positives)
-        present = np.zeros(len(j_idx), bool)
-        present[p_i] = True
-        if not present.all():
-            new_pos = np.cumsum(present) - 1
-            b_idx, j_idx = b_idx[present], j_idx[present]
-            p_i = new_pos[p_i]
+        b_idx, j_idx, (p_i, m_i, q_i) = self._exact_geometry(
+            b_idx, j_idx, ivs, qnp, q_m)
         if self._paranoid() and fmeta["immediate"]:
             # shadow-verify: the exact (query, slot) pair set must match
             # the host route's byte-for-byte; a mismatch means the device
@@ -2297,6 +2520,30 @@ class DeviceState:
         self.n_kernel_deps += len(j_idx)
         self._ktime("host_geometry", _tg)
         return b_idx, j_idx, (p_i, m_i, q_i), ids, ivs, qnp, queries
+
+    def _exact_geometry(self, b_idx, j_idx, ivs, qnp, q_m):
+        """The host-side EXACT geometry pass over a coarse (query, slot)
+        pair list: the kernel's bounding-box mask admits a query sitting
+        inside a slot's interval gap; the vectorized overlap here drops
+        those and yields the surviving (pair, dep-interval, query-interval)
+        emit triples — shared by the solo collect and the fused harvest."""
+        lo, hi, _dom = ivs
+        lo_p, hi_p = lo[j_idx], hi[j_idx]                       # [P, M]
+        used = lo_p <= hi_p
+        qlo_p = qnp[b_idx, 7:7 + q_m]                           # [P, Q]
+        qhi_p = qnp[b_idx, 7 + q_m:7 + 2 * q_m]
+        overlap = (used[:, :, None]
+                   & (lo_p[:, :, None] <= qhi_p[:, None, :])
+                   & (qlo_p[:, None, :] <= hi_p[:, :, None]))   # [P, M, Q]
+        p_i, m_i, q_i = np.nonzero(overlap)
+        # drop pairs with no exact overlap (bounding-box false positives)
+        present = np.zeros(len(j_idx), bool)
+        present[p_i] = True
+        if not present.all():
+            new_pos = np.cumsum(present) - 1
+            b_idx, j_idx = b_idx[present], j_idx[present]
+            p_i = new_pos[p_i]
+        return b_idx, j_idx, (p_i, m_i, q_i)
 
     def _host_fallback_collect(self, handle):
         """Serve a flush whose device parts failed mid-collect from the
@@ -2335,6 +2582,232 @@ class DeviceState:
         self._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs, qnp,
                               queries, builders)
         self._ktime("host_attribute", _ta)
+
+    # ------------------------------------------------------------------
+    # fused cross-store dispatch (r08; driven by local.dispatch's
+    # per-node DeviceDispatcher)
+    # ------------------------------------------------------------------
+    def fused_eligible(self, queries):
+        """Dispatcher phase A (PURE — mutates nothing): can this store's
+        pending flush join a fused device launch?  None when the flush
+        must (or would) run the host route — a host flush has no device
+        launch to coalesce; else a hint dict carrying the packed queries
+        and the modeled solo device element count the dispatcher's
+        fused-vs-solo pricing consumes.  A store that ends up NOT fused
+        runs the classic solo flush, which applies the gate/probe/route
+        bookkeeping itself."""
+        if self.host_pinned or self._dev_quar_flushes > 0 \
+                or self.route_override == "host":
+            return None
+        q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
+        packed = [(sb, wit, toks, rngs, tid)
+                  for (tid, sb, wit, toks, rngs) in queries]
+        qnp = dk.pack_query_matrix(packed, q_m)
+        floor_id, prune_np = self._batch_floor(qnp, q_m)
+        route = self.route_override
+        if route is None:
+            route = self._choose_route(qnp, q_m, floor_id)
+        if route == "host":
+            return None
+        nq = qnp.shape[0]
+        b_pad = _pow2_at_least(nq, 1)
+        cap = self.deps.capacity
+        d = 1 if self.mesh is None else max(len(self.mesh.devices.flat), 1)
+        solo_elems = b_pad * cap * q_m * self.deps.max_intervals // d
+        degenerate = not self.BUCKETED or \
+            len(self.deps.wide_entries) > self.deps.WIDE_MAX
+        if route != "dense" and not degenerate:
+            # the adaptive solo dispatch would probe the bucket index for
+            # narrow queries — price solo with the cheaper kernel
+            buck = b_pad * (q_m * self.deps.SPAN * self.deps.BUCKET_K
+                            + len(self.deps.wide_entries) // d)
+            solo_elems = min(solo_elems, buck)
+        # snapshot cost the fused pricing charges: zero when the cached
+        # copy is still fresh, one full-column memcpy's worth otherwise
+        dm = self.deps
+        snap_stale = dm._snap is None or dm._snap[0] != dm.mut_version
+        snap_elems = cap * (2 * dm.max_intervals + 10) if snap_stale else 0
+        return {"dev": self, "queries": list(queries), "qnp": qnp,
+                "q_m": q_m, "floor_id": floor_id, "prune": prune_np,
+                "nq": nq, "b_pad": b_pad, "cap": cap,
+                "m_iv": self.deps.max_intervals, "solo_elems": solo_elems,
+                "snap_elems": snap_elems}
+
+    def fused_table(self):
+        """The (cached, device-resident) table the fused launch consumes —
+        mesh-sharded under a mesh, single-device otherwise."""
+        if self.mesh is not None:
+            return self.deps.device_table_sharded(self.mesh)
+        return self.deps.device_table()
+
+    def fused_commit(self, hint) -> None:
+        """Dispatcher phase B for a chosen fused member: apply the
+        flush-gate bookkeeping the solo path would have applied (probe
+        accounting), snapshot the mirror columns the deferred harvest
+        needs (mutations may land between dispatch and the harvest task),
+        and surface the routing decision."""
+        probing = False
+        if self._dev_backoff > 0:
+            probing = True
+            self.n_reprobes += 1
+            self._fault_event("reprobe", "route=fused")
+        hint["ids"], hint["ivs"], hint["kind_col"] = \
+            self.deps.snapshot_cols()
+        hint["probing"] = probing
+        if self.on_route is not None:
+            self.on_route("fused", hint["nq"])
+        else:
+            obs = getattr(self.store.node, "route_observer", None)
+            if obs is not None:
+                obs(self.store, "fused", hint["nq"])
+
+    def fused_fail_to_host(self, hint, exc) -> None:
+        """A device fault inside the fused LAUNCH fails the whole batch
+        over to the host route: quarantine this member and compute its
+        host pairs right now (still inside the dispatcher event, so the
+        live mirror IS the prep-time state)."""
+        self._device_fault(exc, f"fused dispatch: {exc}")
+        self.n_fallback_queries += hint["nq"]
+        hint["probing"] = False
+        hint["host"] = self.deps.host_pairs(hint["qnp"], hint["q_m"],
+                                            hint["floor_id"])
+
+    def _fused_snapshot(self, hint):
+        return (hint["ids"][0], hint["ids"][1], hint["ids"][2],
+                hint["kind_col"], hint["ids"][4], hint["ivs"][0],
+                hint["ivs"][1])
+
+    def _fused_collect(self, hint, launch):
+        """Download + parse this store's block of the fused CSR, with the
+        solo path's full semantics: overflow re-run (solo, escalated s/k,
+        same snapshot table), stale-result injection point, exact
+        geometry, paranoia shadow-verify against the SNAPSHOT host scan,
+        probe restore, and whole-batch host failover on any
+        device-boundary failure."""
+        import time as _time
+        _t0 = _time.perf_counter()
+        nq = hint["nq"]
+        if "host" in hint:           # launch already failed over to host
+            self.n_host_queries += nq
+            self.n_dispatches += 1
+            return hint["host"]
+        qnp, q_m = hint["qnp"], hint["q_m"]
+        d, shard_n = hint["d"], hint["shard_n"]
+        b_pad = hint["b_pad_c"]
+
+        def parse(buf, s_, k_):
+            blocks = buf.reshape(d, 2 + b_pad + s_)
+            if int(blocks[:, 0].max()) > s_ or int(blocks[:, 1].max()) > k_:
+                return None
+            bs, js = [], []
+            for i in range(d):
+                total = int(blocks[i, 0])
+                row_end = blocks[i, 2:2 + b_pad].astype(np.int64)
+                counts = np.diff(row_end, prepend=0)
+                bs.append(np.repeat(np.arange(b_pad), counts))
+                js.append(blocks[i, 2 + b_pad:2 + b_pad + total]
+                          .astype(np.int64) + i * shard_n)
+            return np.concatenate(bs), np.concatenate(js)
+
+        try:
+            out = launch.materialize()
+            row = np.asarray(out[hint["row"]])
+            parsed = parse(row, launch.s, launch.k)
+            if parsed is None:
+                # overflow: escalate EXACTLY like the solo path — re-run
+                # this store alone against the same cached table with the
+                # learned flat capacity / row width
+                blocks = row.reshape(d, 2 + b_pad + launch.s)
+                total = int(blocks[:, 0].max())
+                s2 = min(-(-int(total * 1.25) // 16384) * 16384,
+                         b_pad * shard_n)
+                self._batch_flat = max(self._batch_flat, s2)
+                k2 = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
+                         shard_n)
+                self._batch_k = max(self._batch_k, k2)
+                qmat = jnp.asarray(hint["qmat_np"])
+                pnp = hint["prune"]
+                pz = _prune_zeros() if pnp is None else \
+                    (jnp.asarray(pnp[0]), jnp.asarray(pnp[1]),
+                     jnp.asarray(pnp[2]))
+                qmc = hint["q_m_c"]
+                if self.mesh is not None:
+                    from ..parallel.sharded import \
+                        sharded_calculate_deps_flat_pruned
+                    out2 = np.asarray(sharded_calculate_deps_flat_pruned(
+                        self.mesh, qmc, s2, k2)(hint["table"], qmat, *pz))
+                else:
+                    out2 = np.asarray(dk.calculate_deps_flat_pruned(
+                        hint["table"], qmat, *pz, qmc, s2, k2))
+                parsed = parse(out2, s2, k2)
+        except faults.DEVICE_EXCEPTIONS as e:
+            # whole-batch failover: quarantine every member, serve this
+            # flush from the SNAPSHOT host scan (begin-time bytes)
+            launch.poison(e)
+            self.n_fallback_queries += nq
+            self.n_host_queries += nq
+            self.n_dispatches += 1
+            return self.deps.host_pairs(qnp, q_m, hint["floor_id"],
+                                        snapshot=self._fused_snapshot(hint))
+        b_local, j_idx = parsed
+        if self._paranoid() and len(j_idx) \
+                and faults.should_fire("stale_result"):
+            j_idx = (j_idx + np.int64(1)) % np.int64(len(hint["ids"][0]))
+        gmap = hint["gmap"]
+        b_global = gmap[b_local]
+        keep = b_global >= 0
+        b_idx, j_idx, pmq = self._exact_geometry(
+            b_global[keep], j_idx[keep], hint["ivs"], qnp, q_m)
+        if self._paranoid():
+            self.n_shadow_checks += 1
+            b_h, j_h, pmq_h = self.deps.host_pairs(
+                qnp, q_m, hint["floor_id"],
+                snapshot=self._fused_snapshot(hint))
+            cap = np.int64(len(hint["ids"][0]))
+            if not np.array_equal(np.unique(b_idx * cap + j_idx),
+                                  np.unique(b_h * cap + j_h)):
+                self.n_shadow_mismatches += 1
+                self._device_fault("stale_result", "fused shadow mismatch")
+                self.n_fallback_queries += nq
+                self.n_dispatches += 1
+                return b_h, j_h, pmq_h
+        if hint.get("probing"):
+            self._restore_device()
+        self.n_dispatches += 1
+        self.n_fused_flushes += 1
+        self.n_fused_queries += nq
+        if self.mesh is not None:
+            self.n_mesh_queries += nq
+        else:
+            self.n_dense_queries += nq
+        self._ktime("wait_fused", _t0)
+        return b_idx, j_idx, pmq
+
+    def fused_harvest(self, safe, hint, launch) -> None:
+        """Store-task leg of a fused flush: parse this store's block of
+        the fused result (the shared download happens at the first
+        member's harvest — jax's async dispatch overlapped the device work
+        with whatever host processing ran since the launch), fold the
+        answer through the exact geometry + floors/elision/attribution
+        passes over the prep-time snapshot, and fire the batch's done
+        callbacks — the same bytes the solo launch would have produced,
+        harvested at the next event-loop boundary in deterministic store
+        order."""
+        batch = hint["batch"]
+        try:
+            b_idx, j_idx, pmq = self._fused_collect(hint, launch)
+            self.n_queries += hint["nq"]
+            self.n_kernel_deps += len(j_idx)
+            self._attribute_batch(safe, b_idx, j_idx, pmq, hint["ids"],
+                                  hint["ivs"], hint["qnp"],
+                                  hint["queries"],
+                                  [b for _q, b, _d in batch])
+        except BaseException as e:  # noqa: BLE001
+            for _q, _b, done in batch:
+                done(e, None)
+            return
+        for _q, _b, done in batch:
+            done(None, safe)
 
     # ------------------------------------------------------------------
     # the drain (device replacement of listener fan-out)
@@ -2407,6 +2880,13 @@ class DeviceState:
         if self._tick_scheduled:
             return
         self._tick_scheduled = True
+        disp = getattr(self.store.node, "dispatcher", None)
+        if disp is not None:
+            # node-level coalescing (r08): ticks landing in the same
+            # window share one dispatcher event — and, when the cost model
+            # says it pays, one fused frontier launch
+            disp.register_tick(self)
+            return
         from .command_store import PreLoadContext
 
         def run():
@@ -2414,7 +2894,7 @@ class DeviceState:
 
         self.store.node.scheduler.once(self.TICK_DELAY_MICROS, run)
 
-    def _tick(self, safe) -> None:
+    def _tick(self, safe, fused=None) -> None:
         from . import commands
         self._tick_scheduled = False
         self.n_ticks += 1
@@ -2427,32 +2907,45 @@ class DeviceState:
         # the frontier sweeps on host, and a device failure mid-tick
         # quarantines + falls back to the host sweep (same rule, same
         # candidates — the per-candidate WaitingOn re-validation below
-        # makes any residual divergence a no-op, never a wrong execution)
+        # makes any residual divergence a no-op, never a wrong execution).
+        # A fused sweep (dispatcher-precomputed, shared with sibling
+        # stores) serves the same candidates; a device failure harvesting
+        # it quarantines the WHOLE fused batch, and every member's sweep
+        # fails over to host.
         cand_slots = None
+        used_fused = False
         if not (self.host_pinned or self._dev_quar_flushes > 0):
-            try:
-                dk.launch_check("drain")
-                state, live = self.drain.state()
-                faults.check("transfer", "drain download")
-                if isinstance(state, drk.EllDrainState):
-                    # large in-flight set: sparse gather sweep (no [N, N])
-                    ready = np.asarray(
-                        drk.ready_frontier_ell(state))[: len(live)]
-                elif self.mesh is not None and \
-                        state.status.shape[0] % \
-                        len(self.mesh.devices.flat) == 0 \
-                        and self._mesh_tick_pays(state.status.shape[0]):
-                    # live mesh path: the frontier sweep row-shards across
-                    # devices (fixpoint analogue: parallel.sharded.
-                    # sharded_drain)
-                    from ..parallel.sharded import sharded_ready_frontier
-                    ready = np.asarray(
-                        sharded_ready_frontier(self.mesh)(state))[: len(live)]
-                else:
-                    ready = np.asarray(drk.ready_frontier(state))[: len(live)]
-                cand_slots = live[ready & self.drain.active[live]]
-            except faults.DEVICE_EXCEPTIONS as e:
-                self._device_fault(e, f"drain tick: {e}")
+            if fused is not None and fused.serves(self):
+                try:
+                    cand_slots = fused.result_for(self)
+                    self.n_fused_ticks += 1
+                    used_fused = True
+                except faults.DEVICE_EXCEPTIONS as e:
+                    fused.poison(e)
+            else:
+                try:
+                    dk.launch_check("drain")
+                    state, live = self.drain.state()
+                    faults.check("transfer", "drain download")
+                    if isinstance(state, drk.EllDrainState):
+                        # large in-flight set: sparse gather sweep (no [N, N])
+                        ready = np.asarray(
+                            drk.ready_frontier_ell(state))[: len(live)]
+                    elif self.mesh is not None and \
+                            state.status.shape[0] % \
+                            len(self.mesh.devices.flat) == 0 \
+                            and self._mesh_tick_pays(state.status.shape[0]):
+                        # live mesh path: the frontier sweep row-shards across
+                        # devices (fixpoint analogue: parallel.sharded.
+                        # sharded_drain)
+                        from ..parallel.sharded import sharded_ready_frontier
+                        ready = np.asarray(
+                            sharded_ready_frontier(self.mesh)(state))[: len(live)]
+                    else:
+                        ready = np.asarray(drk.ready_frontier(state))[: len(live)]
+                    cand_slots = live[ready & self.drain.active[live]]
+                except faults.DEVICE_EXCEPTIONS as e:
+                    self._device_fault(e, f"drain tick: {e}")
         if cand_slots is None:
             self.n_host_ticks += 1
             cand_slots = self._host_ready_slots()
@@ -2465,6 +2958,13 @@ class DeviceState:
                 commands.refresh_waiting_and_maybe_execute(safe, txn_id)
         if sweep_due:
             self.drain.sweep_free()
+        if used_fused and self.drain.version != fused.version_for(self) \
+                and self.drain.active.any():
+            # the fused sweep was computed at dispatch time; mutations that
+            # landed between dispatch and this harvest (earlier tasks in
+            # this store's queue) could otherwise be a lost wakeup —
+            # re-evaluate with a fresh tick
+            self.schedule_tick()
 
 
 def _exec_order_key(safe):

@@ -1,0 +1,403 @@
+"""Per-node device dispatch scheduler: cross-store launch coalescing (r08).
+
+r06 made every deps *scan* cheap (regime-adaptive routing); r07 made the
+accelerator a survivable failure domain.  What remained (device_index's own
+docstring flagged it) is the LAUNCH tax: every CommandStore paid its own
+device dispatch per flush and per drain tick, so on a node with many stores
+the per-launch overhead (dispatch + PCIe/ICI round trip) dominates the
+per-element work the kernels already amortize.  This module is the analogue
+of the reference's per-store task-queue amortization
+(InMemoryCommandStore's executor batching, SURVEY §7) lifted to the DEVICE
+boundary:
+
+- **Flush coalescing**: deps flushes from all CommandStores of one node
+  that become runnable in the same sim event-loop step register with ONE
+  dispatcher event.  Stores whose adaptive route is a device kernel are
+  priced fused-vs-solo (the same micro-probe calibration the r06 router
+  uses: fusing S launches saves (S-1) round trips and pays for the padding
+  waste of stacking unequal tables); when fusion wins, ONE store-tagged
+  kernel launch (ops.deps_kernel.fused_flat_csr, or
+  parallel.sharded.sharded_fused_flat under a mesh) answers every member.
+- **Async harvest**: the fused launch is enqueued WITHOUT blocking — jax's
+  async dispatch overlaps the device work with host protocol processing —
+  and each member harvests its block in its own store task, enqueued at
+  dispatch in store-id order: results land at the next event-loop boundary
+  BEFORE any dependent task of that store runs, so determinism is the
+  scheduler order, never device completion order.
+- **Tick coalescing**: drain ticks registered within one tick window share
+  one dispatcher event, and the single-device frontier sweeps of the
+  members fuse into one vmapped launch
+  (ops.drain_kernel.fused_ready_frontier[_ell]) when the same pricing says
+  it pays.
+
+Correctness contract: every fused launch is BIT-IDENTICAL to the solo
+launches it replaces (tests/test_routing.py property tests), and the r07
+fault ladder composes — a device fault inside a fused launch fails the
+WHOLE batch over to the host route deterministically, then quarantines
+per-store exactly as solo faults do (tests/test_device_faults.py).
+
+Knobs: ``ACCORD_TPU_FUSION=off`` pins solo launches (the conftest canary
+asserts tier-1 passes with it set — fusion must never become load-bearing
+for correctness); everything else is priced, not thresholded.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..ops import deps_kernel as dk
+from ..ops import drain_kernel as drk
+from ..utils import faults
+from .device_index import _pow2_at_least
+
+
+def fusion_enabled() -> bool:
+    """The ACCORD_TPU_FUSION escape hatch: default ON; "off"/"0"/"false"/
+    "no" pins every launch solo (correctness must never depend on fusion)."""
+    return os.environ.get("ACCORD_TPU_FUSION", "").lower() not in (
+        "off", "0", "false", "no")
+
+
+class FusedFlushLaunch:
+    """One in-flight fused deps launch: the shared device buffer plus the
+    member hints.  The download happens at the FIRST member's harvest
+    (faults.check rides it — one transfer crossing per fused launch);
+    any device-boundary failure poisons the whole batch: every member
+    quarantines and serves its flush from the snapshot host scan."""
+
+    def __init__(self, dev_out, hints, s: int, k: int):
+        self.dev = dev_out
+        self.hints = hints
+        self.s = s
+        self.k = k
+        self._out = None
+        self.failed: Optional[BaseException] = None
+
+    def materialize(self):
+        if self.failed is not None:
+            raise self.failed
+        if self._out is None:
+            faults.check("transfer", "fused result download")
+            self._out = np.asarray(self.dev)
+        return self._out
+
+    def poison(self, exc: BaseException) -> None:
+        if self.failed is None:
+            self.failed = exc
+            for h in self.hints:
+                h["dev"]._device_fault(exc, f"fused collect: {exc}")
+                h["probing"] = False
+
+
+class FusedTick:
+    """One in-flight fused drain-frontier launch (see FusedFlushLaunch for
+    the failure contract)."""
+
+    def __init__(self, dev_out, group):
+        self.dev = dev_out
+        self.rows = {id(dev): (i, live, dev.drain.version)
+                     for i, (dev, _st, live) in enumerate(group)}
+        self.members = [dev for dev, _st, _lv in group]
+        self._out = None
+        self.failed: Optional[BaseException] = None
+
+    def serves(self, dev) -> bool:
+        return id(dev) in self.rows
+
+    def version_for(self, dev) -> int:
+        return self.rows[id(dev)][2]
+
+    def result_for(self, dev) -> np.ndarray:
+        if self.failed is not None:
+            raise self.failed
+        if self._out is None:
+            faults.check("transfer", "fused drain download")
+            self._out = np.asarray(self.dev)
+        i, live, _v = self.rows[id(dev)]
+        ready = self._out[i][: len(live)]
+        return live[ready & dev.drain.active[live]]
+
+    def poison(self, exc: BaseException) -> None:
+        if self.failed is None:
+            self.failed = exc
+            for dev in self.members:
+                dev._device_fault(exc, f"fused drain collect: {exc}")
+
+
+class DeviceDispatcher:
+    """The per-node scheduler coalescing device launches across the node's
+    CommandStores (module docstring)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.fusion = fusion_enabled()
+        self._flush_pending: List = []
+        self._flush_scheduled = False
+        self._tick_pending: List = []
+        self._tick_scheduled = False
+        # launch accounting (the bench "# index" line and the sim stats
+        # read these): fused launches serve many member flushes/ticks each
+        self.n_fused_launches = 0
+        self.n_fused_members = 0
+        self.n_solo_flushes = 0
+        self.n_fused_tick_launches = 0
+        self.n_fused_tick_members = 0
+        self.n_solo_ticks = 0
+        # observer(kind, n_members, nq) — the sim cluster wires stats/trace
+        self.on_fused = None
+
+    def _handled(self, exc: BaseException) -> None:
+        agent = getattr(self.node, "agent", None)
+        if agent is not None and hasattr(agent, "on_handled_exception"):
+            agent.on_handled_exception(exc)
+
+    # -- flush side ---------------------------------------------------------
+    def register_flush(self, dev) -> None:
+        self._flush_pending.append(dev)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            # one scheduler hop (zero sim-time) so every same-instant
+            # message's store task enqueues its queries BEFORE dispatch
+            self.node.scheduler.now(self._run_flushes)
+
+    def _run_flushes(self) -> None:
+        from .command_store import PreLoadContext
+        self._flush_scheduled = False
+        devs = self._flush_pending
+        self._flush_pending = []
+        if not getattr(self.node, "alive", True):
+            return    # dead incarnation (restart): ghost work must not run
+        devs.sort(key=lambda d: d.store.store_id)
+        plans = []
+        for dev in devs:
+            batch = dev._q_pending
+            dev._q_pending = []
+            if batch:
+                plans.append((dev, batch))
+        hints: Dict[int, dict] = {}
+        launch = None
+        if self.fusion and len(plans) >= 2:
+            try:
+                for dev, batch in plans:
+                    h = dev.fused_eligible([q for q, _b, _d in batch])
+                    if h is not None:
+                        h["batch"] = batch
+                        hints[id(dev)] = h
+                if len(hints) >= 2 and \
+                        self._fused_flush_pays(list(hints.values())):
+                    launch = self._launch_fused_flush(list(hints.values()))
+                else:
+                    hints = {}
+            except BaseException as e:  # noqa: BLE001
+                # NOT a device fault (those are absorbed inside
+                # _launch_fused_flush as the whole-batch host failover) —
+                # an unexpected host-side error must never strand the
+                # claimed batches with their done callbacks unfired: fall
+                # back to solo flushes, which carry their own failure
+                # delivery
+                self._handled(e)
+                hints = {}
+                launch = None
+        # harvest order IS the deterministic scheduler order: one store
+        # task per member, enqueued here in ascending store id
+        for dev, batch in plans:
+            h = hints.get(id(dev))
+            if h is not None:
+                dev.store.execute(
+                    PreLoadContext.empty(),
+                    partial(dev.fused_harvest, hint=h, launch=launch))
+            else:
+                self.n_solo_flushes += 1
+                dev.store.execute(PreLoadContext.empty(),
+                                  partial(dev._flush_batch, batch=batch))
+
+    def _fused_flush_pays(self, hints) -> bool:
+        """Price ONE fused launch against the members' solo launches with
+        the r06 micro-probe calibration: fusing saves (S-1) round trips
+        and pays the padding waste of stacking unequal tables / batches."""
+        dev0 = hints[0]["dev"]
+        calib = dev0._calibration()
+        rtt, c_dev = calib["rtt"], calib["c_dev"]
+        d = 1
+        if dev0.mesh is not None:
+            d = max(len(dev0.mesh.devices.flat), 1)
+            rtt = calib.get("rtt_mesh", rtt)
+        solo = sum(2.0 * rtt + c_dev * h["solo_elems"] for h in hints)
+        b_pad = _pow2_at_least(max(h["b_pad"] for h in hints), 1)
+        q_m = max(h["q_m"] for h in hints)
+        n_max = max(h["cap"] for h in hints)
+        m_max = max(h["m_iv"] for h in hints)
+        fused_elems = len(hints) * b_pad * (n_max // d) * q_m * m_max
+        # the deferred harvest needs begin-time mirror snapshots the solo
+        # immediate path never takes — charge the stale members' copies at
+        # the measured memcpy rate (version-cached, so an unmutated mirror
+        # re-fuses for free)
+        c_copy = calib.get("c_copy", calib["c_host"] / 20.0)
+        snap_cost = c_copy * sum(h["snap_elems"] for h in hints)
+        return 2.0 * rtt + c_dev * fused_elems + snap_cost < solo
+
+    def _launch_fused_flush(self, hints) -> Optional[FusedFlushLaunch]:
+        devs = [h["dev"] for h in hints]
+        mesh = devs[0].mesh            # one node -> one mesh for all stores
+        d = 1 if mesh is None else max(len(mesh.devices.flat), 1)
+        q_m = max(h["q_m"] for h in hints)
+        b_pad = _pow2_at_least(max(h["b_pad"] for h in hints), 1)
+        s = max(min(dev._batch_flat, b_pad * (h["cap"] // d))
+                for dev, h in zip(devs, hints))
+        k = max(min(dev._batch_k, h["cap"] // d)
+                for dev, h in zip(devs, hints))
+        qmats = np.empty((len(hints), b_pad, 7 + 2 * q_m), np.int64)
+        pm = np.zeros(len(hints), np.int64)
+        pl = np.zeros(len(hints), np.int64)
+        pn = np.zeros(len(hints), np.int32)
+        for i, h in enumerate(hints):
+            qnp, qmi, nq = h["qnp"], h["q_m"], h["nq"]
+            rows_p = np.minimum(np.arange(b_pad), nq - 1)
+            qmats[i, :, :7] = qnp[rows_p, :7]
+            qmats[i, :, 7:7 + q_m] = dk.PAD_LO
+            qmats[i, :, 7 + q_m:] = dk.PAD_HI
+            qmats[i, :, 7:7 + qmi] = qnp[rows_p, 7:7 + qmi]
+            qmats[i, :, 7 + q_m:7 + q_m + qmi] = qnp[rows_p, 7 + qmi:]
+            if h["prune"] is not None:
+                pm[i], pl[i], pn[i] = h["prune"]
+            h["gmap"] = np.where(np.arange(b_pad) < nq,
+                                 np.arange(b_pad), -1)
+            h["row"] = i
+            h["d"] = d
+            h["shard_n"] = h["cap"] // d
+            h["b_pad_c"] = b_pad
+            h["q_m_c"] = q_m
+            h["qmat_np"] = qmats[i]
+        # commit first (probe bookkeeping, mirror snapshots, route
+        # observation): a launch fault below must still find the begin-time
+        # snapshot to serve the host failover from
+        for h in hints:
+            h["dev"].fused_commit(h)
+        try:
+            dk.launch_check("fused")
+            tables = [h["dev"].fused_table() for h in hints]
+            for h, t in zip(hints, tables):
+                h["table"] = t
+            import jax.numpy as jnp
+            if mesh is not None:
+                from ..parallel.sharded import sharded_fused_flat
+                out = sharded_fused_flat(mesh, len(hints), q_m, s, k)(
+                    *tables, jnp.asarray(qmats), jnp.asarray(pm),
+                    jnp.asarray(pl), jnp.asarray(pn))
+            else:
+                out = dk.fused_flat_csr(tables, qmats, (pm, pl, pn),
+                                        q_m, s, k)
+        except faults.DEVICE_EXCEPTIONS as e:
+            # a device fault inside the fused launch fails the WHOLE batch
+            # over to the host route, then quarantines per-store as solo
+            # faults do
+            for h in hints:
+                h["dev"].fused_fail_to_host(h, e)
+            return None
+        self.n_fused_launches += 1
+        self.n_fused_members += len(hints)
+        if self.on_fused is not None:
+            self.on_fused("flush", len(hints),
+                          sum(h["nq"] for h in hints))
+        return FusedFlushLaunch(out, hints, s, k)
+
+    # -- tick side ----------------------------------------------------------
+    def register_tick(self, dev) -> None:
+        self._tick_pending.append(dev)
+        if not self._tick_scheduled:
+            self._tick_scheduled = True
+            self.node.scheduler.once(dev.TICK_DELAY_MICROS, self._run_ticks)
+
+    def _run_ticks(self) -> None:
+        from .command_store import PreLoadContext
+        self._tick_scheduled = False
+        devs = self._tick_pending
+        self._tick_pending = []
+        if not getattr(self.node, "alive", True):
+            return    # dead incarnation (restart): ghost work must not run
+        devs.sort(key=lambda d: d.store.store_id)
+        fused_by: Dict[int, FusedTick] = {}
+        if self.fusion and len(devs) >= 2:
+            try:
+                fused_by = self._prepare_fused_ticks(devs)
+            except BaseException as e:  # noqa: BLE001
+                # an unexpected host-side error preparing the fused sweep
+                # must never leave the members' _tick_scheduled flags
+                # stuck True (a node-wide lost wakeup): every member still
+                # gets its solo tick task below
+                self._handled(e)
+                fused_by = {}
+        for dev in devs:
+            f = fused_by.get(id(dev))
+            if f is None:
+                self.n_solo_ticks += 1
+            dev.store.execute(PreLoadContext.empty(),
+                              partial(dev._tick, fused=f))
+
+    def _prepare_fused_ticks(self, devs) -> Dict[int, FusedTick]:
+        cands = [d for d in devs
+                 if not (d.host_pinned or d._dev_quar_flushes > 0)
+                 and d.drain.active.any()]
+        if len(cands) < 2:
+            return {}
+        try:
+            dk.launch_check("fused drain")
+            built = [(d,) + d.drain.state() for d in cands]
+        except faults.DEVICE_EXCEPTIONS as e:
+            # whole-batch failover: every candidate quarantines; their
+            # tick tasks sweep on host via the quarantine guard
+            for d in cands:
+                d._device_fault(e, f"fused drain tick: {e}")
+            return {}
+        dense, ell = [], []
+        for dev, state, live in built:
+            if isinstance(state, drk.EllDrainState):
+                ell.append((dev, state, live))
+            else:
+                n = state.status.shape[0]
+                if dev.mesh is not None \
+                        and n % len(dev.mesh.devices.flat) == 0 \
+                        and dev._mesh_tick_pays(n):
+                    continue       # the solo mesh sweep is the modeled winner
+                dense.append((dev, state, live))
+        out: Dict[int, FusedTick] = {}
+        calib = devs[0]._calibration()
+        for group, kernel, kind in (
+                (dense, drk.fused_ready_frontier, "dense"),
+                (ell, drk.fused_ready_frontier_ell, "ell")):
+            if len(group) < 2 or not self._fused_tick_pays(group, calib,
+                                                           kind):
+                continue
+            try:
+                out_dev = kernel([st for _d, st, _lv in group])
+            except faults.DEVICE_EXCEPTIONS as e:
+                for dev, _st, _lv in group:
+                    dev._device_fault(e, f"fused drain launch: {e}")
+                continue
+            ft = FusedTick(out_dev, group)
+            self.n_fused_tick_launches += 1
+            self.n_fused_tick_members += len(group)
+            if self.on_fused is not None:
+                self.on_fused("tick", len(group), 0)
+            for dev, _st, _lv in group:
+                out[id(dev)] = ft
+        return out
+
+    def _fused_tick_pays(self, group, calib, kind: str) -> bool:
+        rtt, c_dev = calib["rtt"], calib["c_dev"]
+        if kind == "dense":
+            sizes = [st.status.shape[0] for _d, st, _lv in group]
+            n_max = max(sizes)
+            waste = c_dev * (len(sizes) * n_max * n_max
+                             - sum(n * n for n in sizes))
+        else:
+            shapes = [st.adj_idx.shape for _d, st, _lv in group]
+            n_max = max(sh[0] for sh in shapes)
+            d_max = max(sh[1] for sh in shapes)
+            waste = c_dev * (len(shapes) * n_max * d_max
+                             - sum(n * dd for n, dd in shapes))
+        return 2.0 * rtt * (len(group) - 1) > waste

@@ -69,6 +69,15 @@ class Node:
             progress_log_factory = SimpleProgressLog
         self.progress_log_factory = progress_log_factory
         self.topology_manager = TopologyManager(node_id)
+        # per-node device dispatch scheduler (r08): coalesces deps flushes
+        # and drain ticks across this node's CommandStores into fused
+        # kernel launches when the cost model says fusion wins; None in
+        # pure host mode (no device launches to coalesce)
+        if self.device_mode:
+            from .dispatch import DeviceDispatcher
+            self.dispatcher = DeviceDispatcher(self)
+        else:
+            self.dispatcher = None
         self.command_stores = CommandStores(self, num_stores)
         self.journal = journal
         self.alive = True

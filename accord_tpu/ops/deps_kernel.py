@@ -443,6 +443,74 @@ def bucketed_flat_pruned(table: DepsTable, buckets: BucketTable,
                          (prune_msb, prune_lsb, prune_node))
 
 
+# -- fused (batched-over-stores) dispatch ------------------------------------
+#
+# The launch-coalescing entry point (r08): one device dispatch answers the
+# deps flushes of SEVERAL CommandStores that became runnable in the same
+# event-loop step.  Each store's table is padded (free slots / PAD intervals
+# prune themselves out of the mask, so padding never changes a store's
+# answer) to the group maximum and stacked on a leading store axis; the
+# per-store scan is the EXACT flat_csr_local trace vmapped over that axis —
+# integer compares/sorts/cumsums vmap losslessly, so every store's CSR block
+# is bit-identical to the solo launch it replaces.  The per-store prune
+# floors ride as [S] triples (zeros = prune nothing, the ts_lt convention).
+
+_FUSED_CACHE = {}
+
+
+def _pad_table_cols(cols, n, m):
+    """Pad one store's seven table columns to (n, m): appended slots are
+    FREE and appended interval columns are PAD (lo > hi) — structurally
+    excluded from the dep mask, so the padded scan answers exactly what the
+    unpadded one does."""
+    msb, lsb, node, kind, status, lo, hi = cols
+    dn = n - msb.shape[0]
+    dm = m - lo.shape[1]
+    pad1 = lambda a, fill: jnp.pad(a, (0, dn), constant_values=fill)  # noqa: E731
+    pad2 = lambda a, fill: jnp.pad(a, ((0, dn), (0, dm)),             # noqa: E731
+                                   constant_values=fill)
+    return (pad1(msb, 0), pad1(lsb, 0), pad1(node, 0), pad1(kind, 0),
+            pad1(status, SLOT_FREE), pad2(lo, PAD_LO), pad2(hi, PAD_HI))
+
+
+def fused_flat_csr(tables: Sequence[DepsTable], qmats: np.ndarray,
+                   prunes: Tuple[np.ndarray, np.ndarray, np.ndarray],
+                   m: int, s: int, k: int) -> jnp.ndarray:
+    """One fused launch for S stores' batched deps scans.
+
+    ``tables``: each store's (cached, device-resident) DepsTable — may
+    differ in capacity/max_intervals; padding + stacking happens INSIDE the
+    jitted program so the launch consumes the cached per-store buffers
+    directly (no host re-upload, no eager stack dispatches).
+    ``qmats``: int64[S, B, 7 + 2m] (per-store query matrices, row-padded to
+    a common B by the caller).  ``prunes``: per-store floor triples
+    (int64[S], int64[S], int32[S]); zeros prune nothing.
+    Returns int32[S, 2 + B + s] — row i is EXACTLY the solo
+    calculate_deps_flat[_pruned] output for store i."""
+    caps = tuple((t.capacity, t.lo.shape[1]) for t in tables)
+    b = qmats.shape[1]
+    key = (caps, b, m, s, k)
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        n_max = max(c for c, _ in caps)
+        m_max = max(mi for _, mi in caps)
+
+        def traced(flat_cols, qm, pm, pl, pn):
+            padded = [_pad_table_cols(cols, n_max, m_max)
+                      for cols in flat_cols]
+            stacked = DepsTable(*(jnp.stack(col)
+                                  for col in zip(*padded)))
+            return jax.vmap(
+                lambda t, q, a, b_, c: flat_csr_local(t, q, m, s, k,
+                                                      (a, b_, c))
+            )(stacked, qm, pm, pl, pn)
+
+        fn = _FUSED_CACHE[key] = jax.jit(traced)
+    return fn(tuple(tuple(t) for t in tables), jnp.asarray(qmats),
+              jnp.asarray(prunes[0]), jnp.asarray(prunes[1]),
+              jnp.asarray(prunes[2]))
+
+
 @partial(jax.jit, static_argnums=(5, 6, 7))
 def calculate_deps_flat_pruned(table: DepsTable, qmat: jnp.ndarray,
                                prune_msb: jnp.ndarray, prune_lsb: jnp.ndarray,

@@ -139,6 +139,78 @@ def ready_frontier_ell(state: EllDrainState) -> jnp.ndarray:
     return (state.status == SLOT_STABLE) & ~waiting
 
 
+# -- fused (batched-over-stores) frontier sweeps ------------------------------
+#
+# r08 launch coalescing: drain ticks from several CommandStores that land in
+# the same event-loop step share ONE device dispatch.  Each store's state is
+# padded to the group maximum (free rows gate nothing and are never Stable,
+# so padding never changes a store's frontier) and stacked on a leading
+# store axis; the sweep is the exact ready_frontier[_ell] trace vmapped over
+# that axis — bit-identical to the solo sweeps it replaces.
+
+_FUSED_FRONT_CACHE = {}
+
+
+def fused_ready_frontier(states):
+    """One fused launch for S stores' frontier sweeps.  ``states`` is a
+    list of dense DrainStates (possibly different n); padding + stacking
+    happens INSIDE the jitted program (a single dispatch consumes the
+    per-store buffers directly).  Returns bool[S, n_max]; row i's first n_i
+    entries are exactly ready_frontier(states[i])."""
+    shapes = tuple(st.status.shape[0] for st in states)
+    key = ("dense", shapes)
+    fn = _FUSED_FRONT_CACHE.get(key)
+    if fn is None:
+        n_max = max(shapes)
+
+        def pad(st):
+            d = n_max - st.status.shape[0]
+            return DrainState(
+                jnp.pad(st.adj, ((0, d), (0, d))),
+                jnp.pad(st.status, (0, d), constant_values=SLOT_FREE),
+                jnp.pad(st.exec_msb, (0, d)), jnp.pad(st.exec_lsb, (0, d)),
+                jnp.pad(st.exec_node, (0, d)),
+                jnp.pad(st.awaits_all, (0, d)))
+
+        def traced(sts):
+            stacked = DrainState(*(jnp.stack(col) for col in
+                                   zip(*(pad(st) for st in sts))))
+            return jax.vmap(ready_frontier)(stacked)
+
+        fn = _FUSED_FRONT_CACHE[key] = jax.jit(traced)
+    return fn(tuple(states))
+
+
+def fused_ready_frontier_ell(states):
+    """ELL analogue of fused_ready_frontier: pads rows to the group max n
+    and edge columns to the group max degree (-1 = no edge), stacks, and
+    vmaps ready_frontier_ell — bit-identical per store."""
+    shapes = tuple(st.adj_idx.shape for st in states)
+    key = ("ell", shapes)
+    fn = _FUSED_FRONT_CACHE.get(key)
+    if fn is None:
+        n_max = max(s[0] for s in shapes)
+        d_max = max(s[1] for s in shapes)
+
+        def pad(st):
+            d = n_max - st.status.shape[0]
+            dd = d_max - st.adj_idx.shape[1]
+            return EllDrainState(
+                jnp.pad(st.adj_idx, ((0, d), (0, dd)), constant_values=-1),
+                jnp.pad(st.status, (0, d), constant_values=SLOT_FREE),
+                jnp.pad(st.exec_msb, (0, d)), jnp.pad(st.exec_lsb, (0, d)),
+                jnp.pad(st.exec_node, (0, d)),
+                jnp.pad(st.awaits_all, (0, d)))
+
+        def traced(sts):
+            stacked = EllDrainState(*(jnp.stack(col) for col in
+                                      zip(*(pad(st) for st in sts))))
+            return jax.vmap(ready_frontier_ell)(stacked)
+
+        fn = _FUSED_FRONT_CACHE[key] = jax.jit(traced)
+    return fn(tuple(states))
+
+
 @jax.jit
 def drain_ell(state: EllDrainState) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fixpoint drain over the ELL adjacency: each sweep applies a whole

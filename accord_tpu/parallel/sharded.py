@@ -305,6 +305,53 @@ def sharded_bucketed_flat(mesh: Mesh, m: int, span: int, s: int, k: int):
     return fn
 
 
+_FUSEDSH_CACHE = {}
+
+
+def sharded_fused_flat(mesh: Mesh, n_stores: int, m: int, s: int, k: int):
+    """Batched-over-stores variant of sharded_calculate_deps_flat — the
+    mesh leg of r08 launch coalescing.  Each of the S stores' slot-sharded
+    DepsTables rides in as its own (cached, device-resident) sharded
+    pytree; inside the shard_map every shard pads its local slices to the
+    group maximum (free slots / PAD intervals prune themselves out of the
+    mask) and vmaps the exact flat_csr_local trace over the store axis, so
+    each store's shard blocks are bit-identical to the solo sharded launch
+    they replace.  Per-store prune floors ride as replicated [S] triples
+    (zeros prune nothing).
+
+    Returns fn(*tables, qmats, pm, pl, pn) -> int32[S, D * (2 + B + s)]:
+    store row i holds D shard blocks with SHARD-LOCAL slot indices — the
+    host parse offsets them by the store's OWN shard_n (capacity_i / d;
+    padding rows are free and never surface)."""
+    from ..ops import deps_kernel as dk
+    dev_key = tuple(d.id for d in mesh.devices.flat)
+    key = (dev_key, n_stores, m, s, k)
+    fn = _FUSEDSH_CACHE.get(key)
+    if fn is not None:
+        return fn
+    table_specs = DepsTable(P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS, None), P(STORE_AXIS, None))
+    in_specs = tuple([table_specs] * n_stores) + (P(), P(), P(), P())
+
+    def local(*args):
+        tables = args[:n_stores]
+        qmats, pm, pl, pn = args[n_stores:]
+        n_max = max(t.msb.shape[0] for t in tables)
+        m_max = max(t.lo.shape[1] for t in tables)
+        padded = [dk._pad_table_cols(tuple(t), n_max, m_max)
+                  for t in tables]
+        stacked = DepsTable(*(jnp.stack(col) for col in zip(*padded)))
+        return jax.vmap(
+            lambda t, q, a, b, c: dk.flat_csr_local(t, q, m, s, k,
+                                                    (a, b, c))
+        )(stacked, qmats, pm, pl, pn)
+
+    fn = jax.jit(_shard_map(local, mesh, in_specs, P(None, STORE_AXIS)))
+    _FUSEDSH_CACHE[key] = fn
+    return fn
+
+
 def shard_bucket_table(mesh: Mesh, buckets: BucketTable) -> BucketTable:
     """Place a BucketTable's bucket-row and wide dimensions across the mesh
     (row counts must divide the device count evenly)."""

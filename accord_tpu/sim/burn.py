@@ -434,9 +434,13 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     result.stats = dict(cluster.stats)
     # lived kernel batching: mean deps-scan batch size across all stores
     # (store-level coalescing; 1.0 would mean every query dispatched alone)
-    nq = nd = ndeps = nfb = 0
+    nq = nd = ndeps = nfb = nff = nft = 0
     kt: Dict[str, float] = {}
     for node in cluster.nodes.values():
+        disp = getattr(node, "dispatcher", None)
+        if disp is not None:
+            nff += disp.n_fused_launches
+            nft += disp.n_fused_tick_launches
         for s in node.command_stores.unsafe_all_stores():
             if s.device is not None:
                 nq += s.device.n_queries
@@ -447,6 +451,12 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
                     kt[k] = kt.get(k, 0.0) + sec
     result.stats["device_queries"] = nq
     result.stats["device_dispatches"] = nd
+    # r08 launch coalescing: fused cross-store launches (flush / tick)
+    # this run's dispatchers performed — like the routing mix, a cost-model
+    # outcome, so the fault-equivalence gate strips it (a quarantined store
+    # cannot fuse) while the determinism double-run still compares it
+    result.stats["device_fused_launches"] = nff
+    result.stats["device_fused_tick_launches"] = nft
     # total exact (query, dep) pairs the deps scans produced: identical
     # across routes by construction, so a device-fault run must report the
     # SAME number as the fault-free run at the same seed — the burn-level

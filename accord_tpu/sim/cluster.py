@@ -371,6 +371,21 @@ class Cluster:
 
         node.fault_observer = fault_observer
 
+        disp = getattr(node, "dispatcher", None)
+        if disp is not None:
+            def fused_observer(kind, members, nq, nid=node.node_id):
+                """One fused cross-store launch (flush or tick) from the
+                node's DeviceDispatcher: counted in stats (always) and the
+                structured trace (when attached) — the harvest-barrier leg
+                of the r08 launch-coalescing observability."""
+                key = "DeviceDispatch.fused_" + kind
+                self.stats[key] = self.stats.get(key, 0) + 1
+                if self.trace is not None:
+                    self.trace.record_fused(self.queue.now, nid, kind,
+                                            members, nq)
+
+            disp.on_fused = fused_observer
+
     def timeout_jitter(self) -> int:
         """Small deterministic per-request timeout jitter (micros)."""
         return self._timeout_rng.next_int(4096)

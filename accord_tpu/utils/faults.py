@@ -23,6 +23,13 @@ Two shapes of switch:
 The consumer of the fault surface is the degradation ladder in
 local/device_index.py (route quarantine -> host fallback -> compaction ->
 backpressure); all defaults are off — a production process never draws.
+
+Fused launches (r08, local/dispatch.py) are a SINGLE fault domain: one
+``kernel_launch`` draw covers the whole fused dispatch and one ``transfer``
+draw covers the shared result download, so a fault inside a fused launch
+fails EVERY member store's flush/tick over to the host route together —
+then each member quarantines and re-probes independently, exactly as solo
+faults do.
 """
 
 from __future__ import annotations

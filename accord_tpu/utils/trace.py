@@ -48,6 +48,16 @@ class Trace:
         self.record(sim_now, "DEVICE_FAULT", node_id, store_id,
                     f"{fault} {detail}".rstrip())
 
+    def record_fused(self, sim_now: int, node_id: int, kind: str,
+                     members: int, nq: int) -> None:
+        """One fused cross-store device launch (r08 launch coalescing):
+        ``kind`` is "flush" (deps scans) or "tick" (drain frontier),
+        ``members`` how many CommandStores shared the launch, ``nq`` the
+        total queries it answered (0 for ticks) — the observable trail a
+        launch-amortization regression shows up in (dst = member count)."""
+        self.record(sim_now, "FUSED_DISPATCH", node_id, members,
+                    f"{kind} stores={members} x{nq}")
+
     def record_quarantine(self, sim_now: int, node_id: int, store_id: int,
                           state: str, detail: str) -> None:
         """A device-route health transition (quarantine / reprobe /

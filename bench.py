@@ -448,6 +448,139 @@ def bench_hot_keys():
              "chain_depth": NDD}]
 
 
+def bench_launch_amortized():
+    """BASELINE config 5 (r08): the many-stores/small-flushes regime.  16
+    CommandStores' worth of DeviceStates on ONE node's DeviceDispatcher,
+    each flushing 4-query batches that become runnable in the same
+    event-loop step — the shape where per-launch overhead dominated
+    per-element work.  Measures the SAME workload with the dispatcher's
+    fusion off (solo launches, the r07 behavior) and on (fused,
+    store-tagged launches), reporting txn/s and device launches per 1k
+    txns for both."""
+    import time as _t
+    from accord_tpu.local.commands_for_key import InternalStatus
+    from accord_tpu.local.device_index import DeviceState
+    from accord_tpu.local.dispatch import DeviceDispatcher
+    from accord_tpu.primitives.deps import DepsBuilder
+    from accord_tpu.primitives.keys import IntKey, Keys
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    S, NPER, B, ROUNDS, KEYS = 16, 2048, 4, 48, 4096
+
+    class Sched:
+        def __init__(self):
+            self.q = []
+
+        def now(self, fn):
+            self.q.append(fn)
+
+        def once(self, _d, fn):
+            self.q.append(fn)
+
+        def run(self):
+            while self.q:
+                self.q.pop(0)()
+
+    class Node:
+        node_id = 1
+        alive = True
+
+        def __init__(self, fusion):
+            self.scheduler = Sched()
+            self.dispatcher = DeviceDispatcher(self)
+            self.dispatcher.fusion = fusion
+
+    class Shim:
+        def __init__(self, inner, node, sid):
+            self.node = node
+            self.store_id = sid
+            self.commands_for_key = inner.commands_for_key
+            self.redundant_before = inner.redundant_before
+
+        def execute(self, _ctx, fn):
+            shim = self
+
+            class Safe:
+                store = shim
+
+                @staticmethod
+                def redundant_before():
+                    return shim.redundant_before
+
+            self.node.scheduler.now(lambda: fn(Safe()))
+
+    def build(fusion):
+        rng = np.random.default_rng(21)
+        node = Node(fusion)
+        devs = []
+        for sid in range(S):
+            store = BenchStore()
+            dev = DeviceState(store)
+            dev.mesh = None           # single-device: the launch tax regime
+            dev.store = Shim(store, node, sid)
+            dev.route_override = "dense"
+            hlcs = rng.choice(np.arange(1, 1_000_000), size=NPER,
+                              replace=False)
+            for i in range(NPER):
+                tid = TxnId.create(1, int(hlcs[i]), TxnKind.Write,
+                                   Domain.Key, 1 + i % 5)
+                dev.register(tid, int(InternalStatus.PREACCEPTED),
+                             Keys([IntKey(int(rng.integers(0, KEYS)))]))
+            devs.append(dev)
+        return node, devs
+
+    def drive(node, devs, rounds, seed):
+        rng = np.random.default_rng(seed)
+        n_done = [0]
+
+        def done(failure, _safe):
+            if failure is not None:
+                raise failure
+            n_done[0] += 1
+
+        for _r in range(rounds):
+            for dev in devs:
+                for _ in range(B):
+                    bound = TxnId.create(
+                        1, int(rng.integers(1_000_000, 2_000_000)),
+                        TxnKind.Write, Domain.Key, 1)
+                    dev.enqueue_query(
+                        (bound, bound, bound.kind().witnesses(),
+                         [int(rng.integers(0, KEYS))], []),
+                        DepsBuilder(), done)
+            node.scheduler.run()
+        return n_done[0]
+
+    res = {}
+    for mode, fusion in (("solo", False), ("fused", True)):
+        node, devs = build(fusion)
+        drive(node, devs, 4, seed=5)        # warm: compile + learn s/k
+        disp = node.dispatcher
+        l0 = disp.n_fused_launches + disp.n_solo_flushes
+        t0 = _t.time()
+        nq = drive(node, devs, ROUNDS, seed=7)
+        dt = _t.time() - t0
+        launches = disp.n_fused_launches + disp.n_solo_flushes - l0
+        res[mode] = {"qps": nq / dt, "launches": launches, "nq": nq,
+                     "fused_members": disp.n_fused_members}
+    f, s = res["fused"], res["solo"]
+    return [{
+        "config": 5,
+        "metric": "launch_amortized_16store_4q_flush_txns_per_sec",
+        "value": round(f["qps"], 1), "unit": "txn/s",
+        "solo_qps": round(s["qps"], 1),
+        "speedup_vs_solo": round(f["qps"] / s["qps"], 2),
+        "fused_launches_per_1k_txn": round(1e3 * f["launches"] / f["nq"], 2),
+        "solo_launches_per_1k_txn": round(1e3 * s["launches"] / s["nq"], 2),
+        "launch_reduction_x": round(s["launches"] / max(f["launches"], 1), 1),
+        "stores": S, "flush_queries": B,
+        "note": "many-stores/small-flushes regime: one DeviceDispatcher "
+                "coalesces all 16 stores' same-step deps flushes into one "
+                "fused store-tagged launch (bit-identical to solo; "
+                "tests/test_routing.py) — launches per txn is the r08 "
+                "acceptance metric"}]
+
+
 def config4_child():
     """BASELINE configs[4], run in a subprocess on the virtual 8-device CPU
     mesh (multi-chip TPU hardware is not reachable from this environment):
@@ -691,6 +824,9 @@ def main(em: Emitter):
         f"mesh_queries={dev.n_mesh_queries} "
         f"mesh_bucketed_queries={dev.n_mesh_bucketed_queries} "
         f"dispatches={dev.n_dispatches} "
+        f"fused_flushes={dev.n_fused_flushes} "
+        f"fused_queries={dev.n_fused_queries} "
+        f"fused_ticks={dev.n_fused_ticks} "
         f"wide_entries={len(dev.deps.wide_entries)} "
         f"buckets={len(dev.deps.bucket_entries)} "
         f"device_faults={dev.n_device_faults} "
@@ -723,6 +859,11 @@ def main(em: Emitter):
             em.config(row)
     except Exception as e:
         em.note(f"# CONFIG 3 failed: {e!r}")
+    try:
+        for row in bench_launch_amortized():
+            em.config(row)
+    except Exception as e:
+        em.note(f"# CONFIG 5 failed: {e!r}")
     try:
         import os
         import subprocess

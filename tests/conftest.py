@@ -27,6 +27,15 @@ def pytest_configure(config):
         "markers", "faults: device-fault injection matrix (quarantine / "
         "host fallback / HBM backpressure; tools/run_fault_matrix.sh "
         "sweeps these under fixed seeds)")
+    # ACCORD_TPU_FUSION=off canary: running tier-1 with the escape hatch
+    # set must (a) actually disable fusion — assert the knob is honored
+    # here, where every test run passes through — and (b) stay green,
+    # proving launch fusion never became load-bearing for correctness.
+    if os.environ.get("ACCORD_TPU_FUSION", "").lower() in ("off", "0",
+                                                           "false", "no"):
+        from accord_tpu.local.dispatch import fusion_enabled
+        assert not fusion_enabled(), \
+            "ACCORD_TPU_FUSION=off set but dispatch.fusion_enabled() is True"
 
 
 # -- shared DeviceState test fixture --------------------------------------
@@ -63,3 +72,76 @@ def make_device_state(mesh="auto"):
     if mesh is None:
         dev.mesh = None
     return store, dev, DeviceTestSafe(store)
+
+
+# -- dispatcher (fused cross-store launch) test harness --------------------
+# A minimal deterministic node: a FIFO scheduler, a DeviceDispatcher, and
+# store shims that give each DeviceState the store surface the dispatcher
+# and its harvest tasks touch (store_id ordering, execute -> scheduler).
+
+
+class DispatchTestScheduler:
+    def __init__(self):
+        self.q = []
+
+    def now(self, fn):
+        self.q.append(fn)
+
+    def once(self, _delay_micros, fn):
+        self.q.append(fn)
+
+    def run(self):
+        while self.q:
+            self.q.pop(0)()
+
+
+class DispatchTestNode:
+    node_id = 1
+    alive = True
+
+    def __init__(self, fusion=None):
+        from accord_tpu.local.dispatch import DeviceDispatcher
+        self.scheduler = DispatchTestScheduler()
+        self.dispatcher = DeviceDispatcher(self)
+        if fusion is not None:
+            self.dispatcher.fusion = fusion
+
+
+class DispatchTestStoreShim:
+    """Presents a DeviceTestStore as the CommandStore surface the
+    dispatcher needs (store_id, node, execute-with-safe)."""
+
+    def __init__(self, inner, node, store_id):
+        self.inner = inner
+        self.node = node
+        self.store_id = store_id
+        self.commands_for_key = inner.commands_for_key
+        self.redundant_before = inner.redundant_before
+
+    def execute(self, _ctx, fn):
+        shim = self
+
+        class Safe:
+            store = shim
+
+            @staticmethod
+            def redundant_before():
+                return shim.redundant_before
+
+        self.node.scheduler.now(lambda: fn(Safe()))
+
+
+def make_dispatch_node(seeds, fusion=None, route="dense"):
+    """(node, [(dev, safe, qs), ...]) — one DeviceState per seed, built
+    with tests.test_routing._build and attached to a shared
+    DispatchTestNode so enqueue_query / schedule_tick flow through the
+    node's DeviceDispatcher."""
+    from tests.test_routing import _build
+    node = DispatchTestNode(fusion=fusion)
+    out = []
+    for i, seed in enumerate(seeds):
+        store, dev, safe, entries, floor, qs = _build(seed)
+        dev.store = DispatchTestStoreShim(store, node, i)
+        dev.route_override = route
+        out.append((dev, safe, qs))
+    return node, out

@@ -127,14 +127,23 @@ def test_burn_device_faults_equivalent_and_deterministic(kind):
          base.evictions)
     # the ladder's own counters (and routing) may differ; everything the
     # protocol emitted must not
-    ladder = ("DepsRoute.", "DeviceFault.")
-    skip = {"device_fallback_queries", "device_dispatches"}
+    ladder = ("DepsRoute.", "DeviceFault.", "DeviceDispatch.")
+    skip = {"device_fallback_queries", "device_dispatches",
+            "device_fused_launches", "device_fused_tick_launches"}
     strip = lambda st: {k: v for k, v in st.items()          # noqa: E731
                         if not k.startswith(ladder) and k not in skip}
     assert strip(a.stats) == strip(base.stats)
     # and the nemesis must have actually bitten
     assert any(k.startswith("DeviceFault.fault.") for k in a.stats), a.stats
     assert a.stats.get("device_fallback_queries", 0) > 0
+    # the fault-free run must exercise r08 launch coalescing, so the
+    # equivalence above also proves faults compose with FUSED launches
+    # (except under the ACCORD_TPU_FUSION=off canary, where solo pinning
+    # is exactly the property being checked)
+    from accord_tpu.local.dispatch import fusion_enabled
+    if fusion_enabled():
+        assert base.stats.get("device_fused_launches", 0) > 0 or \
+            base.stats.get("device_fused_tick_launches", 0) > 0, base.stats
 
 
 @pytest.mark.parametrize("seed", [21, 22])

@@ -16,8 +16,9 @@ import pytest
 from accord_tpu.utils import faults
 from accord_tpu.utils.random_source import RandomSource
 
-from tests.conftest import make_device_state
-from tests.test_routing import _attributed, _build, _csr
+from tests.conftest import make_device_state, make_dispatch_node
+from tests.test_routing import (_attributed, _build, _csr, _enqueue_flush,
+                                _unpack_builders)
 
 pytestmark = pytest.mark.faults
 
@@ -94,6 +95,112 @@ def test_paranoia_clean_run_restores_nothing():
     assert dev.n_shadow_checks >= 1
     assert dev.n_shadow_mismatches == 0
     assert dev.n_quarantines == 0
+
+
+# ---------------------------------------------------------------------------
+# fused launches (r08) x the fault ladder: a device fault inside a fused
+# launch fails the WHOLE batch over to the host route deterministically,
+# then quarantines per-store exactly as solo faults do
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kind", RAISING)
+def test_fused_launch_fault_fails_whole_batch_to_host(kind):
+    """Launch/upload faults at p=1.0 fire INSIDE the fused dispatch: every
+    member store's flush fails over to host with byte-identical results,
+    and every member quarantines."""
+    node, stores = make_dispatch_node((31, 47), fusion=True)
+    expected = [_attributed(dev, safe, qs, prune=True)
+                for dev, safe, qs in stores]
+    results = []
+    with faults.device_fault(kind, 1.0, _rng()):
+        for dev, _safe, qs in stores:
+            results.append(_enqueue_flush(dev, qs))
+        node.scheduler.run()
+    if kind == "kernel_launch":
+        assert node.dispatcher.n_fused_launches == 0  # never left the host
+    # (a transfer fault fires at the upload when the table is cold, or at
+    # the shared download when it is cached — either way the whole batch
+    # fails over below)
+    for i, (dev, _safe, _qs) in enumerate(stores):
+        builders, failures = results[i]
+        assert not failures
+        assert _unpack_builders(builders) == expected[i], f"store {i}"
+        assert dev.n_device_faults >= 1
+        assert dev.n_quarantines >= 1
+        assert dev.n_fallback_queries > 0
+
+
+def test_fused_download_fault_fails_whole_batch_to_host():
+    """The fused launch succeeds but the shared result download faults at
+    harvest: the first member poisons the batch, EVERY member quarantines
+    and serves its flush from the begin-time snapshot host scan — same
+    bytes."""
+    node, stores = make_dispatch_node((31, 47), fusion=True)
+    expected = [_attributed(dev, safe, qs, prune=True)
+                for dev, safe, qs in stores]
+    results = [_enqueue_flush(dev, qs) for dev, _safe, qs in stores]
+    # step ONE scheduler event: the dispatcher — the fused launch is
+    # enqueued healthy; then arm the fault so it fires at download
+    node.scheduler.q.pop(0)()
+    assert node.dispatcher.n_fused_launches == 1
+    with faults.device_fault("transfer", 1.0, _rng()):
+        node.scheduler.run()
+    for i, (dev, _safe, _qs) in enumerate(stores):
+        builders, failures = results[i]
+        assert not failures
+        assert _unpack_builders(builders) == expected[i], f"store {i}"
+        assert dev.n_quarantines >= 1
+        assert dev.n_fallback_queries > 0
+
+
+def test_fused_stale_result_detected_by_shadow():
+    """Silent corruption mid-fused batch: paranoia shadow-verify (against
+    the begin-time SNAPSHOT host scan) catches every member's mismatch,
+    quarantines, and serves the host answer — results stay
+    byte-identical."""
+    node, stores = make_dispatch_node((31, 47), fusion=True)
+    for dev, _safe, _qs in stores:
+        dev.paranoia = True
+    expected = [_attributed(dev, safe, qs, prune=True)
+                for dev, safe, qs in stores]
+    results = [_enqueue_flush(dev, qs) for dev, _safe, qs in stores]
+    with faults.device_fault("stale_result", 1.0, _rng()):
+        node.scheduler.run()
+    assert node.dispatcher.n_fused_launches == 1
+    for i, (dev, _safe, _qs) in enumerate(stores):
+        builders, failures = results[i]
+        assert not failures
+        assert _unpack_builders(builders) == expected[i], f"store {i}"
+        assert dev.n_shadow_mismatches >= 1
+        assert dev.n_quarantines >= 1
+
+
+def test_fused_quarantine_recovers_to_fused():
+    """After a fused-batch fault, the members re-probe independently and —
+    once healthy — fuse again: the ladder composes with coalescing."""
+    node, stores = make_dispatch_node((31, 47), fusion=True)
+    expected = [_attributed(dev, safe, qs, prune=True)
+                for dev, safe, qs in stores]
+
+    def round_trip():
+        results = [_enqueue_flush(dev, qs) for dev, _safe, qs in stores]
+        node.scheduler.run()
+        for i in range(len(stores)):
+            builders, failures = results[i]
+            assert not failures
+            assert _unpack_builders(builders) == expected[i]
+
+    with faults.device_fault("kernel_launch", 1.0, _rng()):
+        round_trip()                       # faulted fused dispatch
+    quarantined = max(dev._dev_quar_flushes for dev, _s, _q in stores)
+    assert quarantined > 0
+    for _ in range(quarantined):           # burn down the quarantine
+        round_trip()
+    launches_before = node.dispatcher.n_fused_launches
+    round_trip()                           # probe flushes: healthy again
+    round_trip()                           # ...and fusing again
+    assert node.dispatcher.n_fused_launches > launches_before
+    for dev, _s, _q in stores:
+        assert dev._dev_quar_flushes == 0 and dev._dev_backoff == 0
 
 
 # ---------------------------------------------------------------------------

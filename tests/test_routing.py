@@ -105,10 +105,7 @@ def _csr(dev, qs, prune):
     return dev.deps_query_batch_end(h)
 
 
-def _attributed(dev, safe, qs, prune):
-    builders = [DepsBuilder() for _ in qs]
-    h = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=prune)
-    dev.deps_query_batch_end_attributed(safe, h, builders)
+def _unpack_builders(builders):
     out = []
     for b in builders:
         deps = b.build()
@@ -119,6 +116,28 @@ def _attributed(dev, safe, qs, prune):
                      for r, row in zip(deps.range_deps.ranges,
                                        deps.range_deps._per_range)]))
     return out
+
+
+def _attributed(dev, safe, qs, prune):
+    builders = [DepsBuilder() for _ in qs]
+    h = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=prune)
+    dev.deps_query_batch_end_attributed(safe, h, builders)
+    return _unpack_builders(builders)
+
+
+def _enqueue_flush(dev, qs):
+    """Enqueue one store's queries through the coalescing path (the node
+    dispatcher decides fused vs solo); returns (builders, failures)."""
+    builders = [DepsBuilder() for _ in qs]
+    failures = []
+
+    def done(failure, _safe):
+        if failure is not None:
+            failures.append(failure)
+
+    for q, b in zip(qs, builders):
+        dev.enqueue_query(q, b, done)
+    return builders, failures
 
 
 @pytest.mark.parametrize("seed", [11, 23, 47])
@@ -173,6 +192,91 @@ def test_all_routes_identical_attributed(seed):
             else:
                 assert got == base, \
                     f"seed={seed} prune={prune} route={route}"
+
+
+@pytest.mark.parametrize("seed_set", [(11, 23), (31, 47, 7)])
+def test_fused_vs_solo_bit_identical(seed_set):
+    """r08 launch coalescing must be invisible: ANY interleaving of fused
+    and solo flushes — every subset of the node's stores flushing in the
+    same event-loop step, fused when >=2 are device-routed — yields the
+    byte-identical attributed output of the pinned solo launches."""
+    import itertools
+
+    from tests.conftest import make_dispatch_node
+    node, stores = make_dispatch_node(seed_set, fusion=True)
+    expected = [_attributed(dev, safe, qs, True)
+                for dev, safe, qs in stores]
+    for r in range(1, len(stores) + 1):
+        for combo in itertools.combinations(range(len(stores)), r):
+            results = {}
+            for i in combo:
+                dev, _safe, qs = stores[i]
+                results[i] = _enqueue_flush(dev, qs)
+            node.scheduler.run()
+            for i in combo:
+                builders, failures = results[i]
+                assert not failures, (seed_set, combo, failures)
+                assert _unpack_builders(builders) == expected[i], \
+                    f"seeds={seed_set} fused-combo={combo} store {i}"
+    assert node.dispatcher.n_fused_launches >= 1
+    # interleaved mutation: register fresh txns into one store between
+    # rounds — the next fused launch must serve the NEW solo answer
+    from accord_tpu.local.commands_for_key import InternalStatus
+    from accord_tpu.primitives.keys import IntKey, Keys
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    dev0, safe0, qs0 = stores[0]
+    for i in range(16):
+        tid = TxnId.create(1, 500_000 + i, TxnKind.Write, Domain.Key, 1)
+        dev0.register(tid, int(InternalStatus.PREACCEPTED),
+                      Keys([IntKey((i * 131) % 6000)]))
+    expected0 = _attributed(dev0, safe0, qs0, True)
+    results = {i: _enqueue_flush(stores[i][0], stores[i][2])
+               for i in range(len(stores))}
+    node.scheduler.run()
+    assert _unpack_builders(results[0][0]) == expected0
+    for i in range(1, len(stores)):
+        assert _unpack_builders(results[i][0]) == expected[i]
+
+
+def test_fused_unequal_capacities_bit_identical():
+    """Stores of different table capacities (128 vs 512 slots) fuse by
+    padding inside the kernel — the padded free slots must never surface
+    and each store's answer must equal its solo launch."""
+    from tests.conftest import DispatchTestNode, DispatchTestStoreShim
+    node = DispatchTestNode(fusion=True)
+    stores = []
+    for i, (seed, n) in enumerate(((31, 120), (47, 500))):
+        store, dev, safe, entries, floor, qs = _build(seed, n=n)
+        dev.store = DispatchTestStoreShim(store, node, i)
+        dev.route_override = "dense"
+        stores.append((dev, safe, qs))
+    assert len({dev.deps.capacity for dev, _s, _q in stores}) == 2
+    expected = [_attributed(dev, safe, qs, True)
+                for dev, safe, qs in stores]
+    results = [_enqueue_flush(dev, qs) for dev, _s, qs in stores]
+    node.scheduler.run()
+    assert node.dispatcher.n_fused_launches == 1
+    for i in range(len(stores)):
+        builders, failures = results[i]
+        assert not failures
+        assert _unpack_builders(builders) == expected[i], f"store {i}"
+
+
+def test_fusion_off_pins_solo_launches():
+    """The ACCORD_TPU_FUSION escape hatch: with fusion disabled the
+    dispatcher still coalesces SCHEDULING (one event per step) but every
+    launch is solo — and results are unchanged."""
+    from tests.conftest import make_dispatch_node
+    node, stores = make_dispatch_node((11, 23), fusion=False)
+    expected = [_attributed(dev, safe, qs, True)
+                for dev, safe, qs in stores]
+    results = [_enqueue_flush(dev, qs) for dev, _safe, qs in stores]
+    node.scheduler.run()
+    assert node.dispatcher.n_fused_launches == 0
+    assert node.dispatcher.n_solo_flushes == len(stores)
+    for i, (builders, failures) in enumerate(results):
+        assert not failures
+        assert _unpack_builders(builders) == expected[i]
 
 
 def test_adaptive_route_is_invisible():

@@ -6,6 +6,61 @@ enable_x64()
 import jax, jax.numpy as jnp
 from functools import partial
 
+
+def launch_bench():
+    """--launches: r08 launch-count microbench — device launches per 1k
+    txns and wall clock for S small per-store deps scans dispatched solo
+    vs ONE fused store-tagged launch (ops.deps_kernel.fused_flat_csr)."""
+    from accord_tpu.ops import deps_kernel as dk
+    S, N, Mi, B, QM, REPS = 16, 2048, 2, 4, 2, 32
+    rng = np.random.default_rng(0)
+    tables = []
+    for _ in range(S):
+        lo = rng.integers(0, 1 << 20, (N, Mi))
+        tables.append(dk.DepsTable(
+            jnp.asarray(rng.integers(1, 1 << 40, N)),
+            jnp.asarray(rng.integers(0, 1 << 40, N)),
+            jnp.asarray(rng.integers(1, 5, N).astype(np.int32)),
+            jnp.asarray(rng.integers(0, 4, N).astype(np.int32)),
+            jnp.asarray(np.full(N, 1, np.int32)),
+            jnp.asarray(lo), jnp.asarray(lo + 64)))
+    qm = np.zeros((S, B, 7 + 2 * QM), np.int64)
+    qm[:, :, 0] = rng.integers(1 << 39, 1 << 41, (S, B))
+    qm[:, :, 3] = 0b1111
+    qm[:, :, 4:7] = qm[:, :, 0:3]
+    qm[:, :, 7:7 + QM] = rng.integers(0, 1 << 20, (S, B, QM))
+    qm[:, :, 7 + QM:] = qm[:, :, 7:7 + QM] + 64
+    s_cap, k_cap = 16384, 64
+    pz = (np.zeros(S, np.int64), np.zeros(S, np.int64),
+          np.zeros(S, np.int32))
+    # warm + compile both shapes
+    np.asarray(dk.fused_flat_csr(tables, qm, pz, QM, s_cap, k_cap))
+    for i in range(S):
+        np.asarray(dk.calculate_deps_flat(tables[i], jnp.asarray(qm[i]),
+                                          QM, s_cap, k_cap))
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        for i in range(S):
+            np.asarray(dk.calculate_deps_flat(
+                tables[i], jnp.asarray(qm[i]), QM, s_cap, k_cap))
+    solo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        np.asarray(dk.fused_flat_csr(tables, qm, pz, QM, s_cap, k_cap))
+    fused = time.perf_counter() - t0
+    txns = REPS * S * B
+    print(f"stores={S} flush={B}q reps={REPS} txns={txns}")
+    print(f"solo : {REPS * S:5d} launches  "
+          f"{1e3 * REPS * S / txns:7.1f}/1k txn  {solo * 1e3:8.1f} ms")
+    print(f"fused: {REPS:5d} launches  "
+          f"{1e3 * REPS / txns:7.1f}/1k txn  {fused * 1e3:8.1f} ms  "
+          f"({solo / fused:.2f}x)")
+
+
+if "--launches" in sys.argv:
+    launch_bench()
+    sys.exit(0)
+
 B, P, K, G, N, M = 2048, 32, 128, 16384, 131072, 8
 rng = np.random.default_rng(0)
 blo = jnp.asarray(rng.integers(0, 1 << 40, (G, K)))
