@@ -13,6 +13,7 @@ from ..messages.preaccept import PreAccept, PreAcceptNack, PreAcceptOk
 from ..primitives.deps import Deps
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
+from ..obs import spans_of
 from ..utils import async_chain
 from .errors import Exhausted, Preempted, Rejected, Timeout
 from .adapter import Adapters
@@ -39,8 +40,14 @@ class CoordinateTransaction(api.Callback):
         self.tracker = FastPathTracker(self.topologies)
         self.oks: Dict[int, PreAcceptOk] = {}
         self.done = False
+        self._spans = spans_of(node)
+        self._sp = None
 
     def _start(self) -> async_chain.AsyncChain:
+        if self._spans is not None:
+            self._sp = self._spans.begin(
+                str(self.txn_id), "preaccept", node=self.node.node_id,
+                contacted=len(self.tracker.nodes()))
         request = PreAccept(self.txn_id, self.txn, self.route,
                             self.topologies.current_epoch(),
                             min_epoch=self.topologies.oldest_epoch())
@@ -88,7 +95,14 @@ class CoordinateTransaction(api.Callback):
     def _on_preaccepted(self) -> None:
         self.done = True
         oks = list(self.oks.values())
-        if self.tracker.has_fast_path_accepted():
+        fast = self.tracker.has_fast_path_accepted()
+        if self._spans is not None:
+            # the span's duration IS the preaccept quorum RTT in sim time
+            self._spans.end(self._sp, oks=len(oks),
+                            path="fast" if fast else "slow")
+            self._spans.decision(str(self.txn_id),
+                                 "fast" if fast else "slow")
+        if fast:
             # fast path: executeAt == txnId, deps from fast-path voters
             deps = Deps.merge([ok.deps for ok in oks
                                if ok.witnessed_at == self.txn_id])
@@ -136,4 +150,6 @@ class CoordinateTransaction(api.Callback):
     def _fail(self, exc: BaseException) -> None:
         if not self.done:
             self.done = True
+            if self._spans is not None:
+                self._spans.end(self._sp, outcome=type(exc).__name__)
             self.result.set_failure(exc)

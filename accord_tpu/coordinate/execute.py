@@ -17,6 +17,7 @@ from ..messages.read_data import ReadNack, ReadOk, ReadTxnData
 from ..primitives.deps import Deps
 from ..primitives.keys import Route
 from ..primitives.timestamp import Timestamp, TxnId
+from ..obs import spans_of
 from ..primitives.txn import Txn
 from ..utils import async_chain
 from .errors import Exhausted, Timeout
@@ -63,6 +64,9 @@ class _ExecuteTxn(api.Callback):
         # ReadTxnData Nacks Unavailable during bootstrap, and the bootstrap
         # fence is itself a sync point — read legs there would deadlock.
         self.read_done = txn.read is None
+        self._spans = spans_of(node)
+        self._sp_stable = None
+        self._sp_read = None
 
     def _read_nodes(self) -> Set[int]:
         """One replica per execution shard, preferring ourselves then the
@@ -83,6 +87,15 @@ class _ExecuteTxn(api.Callback):
             self.stable_done = True
         if not self.read_done:
             self.read_nodes = self._read_nodes()
+        if self._spans is not None:
+            key = str(self.txn_id)
+            self._sp_stable = self._spans.begin(
+                key, "stable", node=self.node.node_id,
+                execute_at=str(self.execute_at))
+            if not self.read_done:
+                self._sp_read = self._spans.begin(
+                    key, "read", node=self.node.node_id,
+                    read_nodes=sorted(self.read_nodes))
         for n in self.read_nodes:
             self.read_tracker.record_in_flight(n)
         for to in sorted(self.stable_tracker.nodes()):
@@ -100,6 +113,8 @@ class _ExecuteTxn(api.Callback):
         if isinstance(reply, CommitOk):
             if self.stable_tracker.record_success(from_id) is RequestStatus.Success:
                 self.stable_done = True
+                if self._spans is not None:     # stable quorum RTT
+                    self._spans.end(self._sp_stable)
                 self._maybe_finish()
         elif isinstance(reply, ReadOk):
             if reply.data is not None:
@@ -107,6 +122,8 @@ class _ExecuteTxn(api.Callback):
                              else self.data.merge(reply.data))
             if self.read_tracker.record_read_success(from_id) is RequestStatus.Success:
                 self.read_done = True
+                if self._spans is not None:     # drain release + data RTT
+                    self._spans.end(self._sp_read)
                 self._maybe_finish()
         elif isinstance(reply, ReadNack):
             self._read_failed(from_id)
@@ -173,4 +190,9 @@ class _ExecuteTxn(api.Callback):
     def _fail(self, exc: BaseException) -> None:
         if not self.done:
             self.done = True
+            if self._spans is not None:
+                self._spans.end(self._sp_stable,
+                                outcome=type(exc).__name__)
+                self._spans.end(self._sp_read,
+                                outcome=type(exc).__name__)
             self.result.set_failure(exc)

@@ -13,6 +13,7 @@ from ..primitives.deps import Deps
 from ..primitives.keys import Route
 from ..primitives.timestamp import Timestamp, TxnId
 from ..primitives.txn import Txn
+from ..obs import spans_of
 from ..primitives.writes import Writes
 from .tracking import AppliedTracker, RequestStatus
 
@@ -37,8 +38,13 @@ class _Persist(api.Callback):
             route.participants, txn_id.epoch(), execute_at.epoch())
         self.tracker = AppliedTracker(self.topologies)
         self.durable_recorded = False
+        self._spans = spans_of(node)
+        self._sp = None
 
     def _start(self) -> None:
+        if self._spans is not None:
+            self._sp = self._spans.begin(
+                str(self.txn_id), "apply", node=self.node.node_id)
         request = Apply("minimal", self.txn_id, self.route, self.execute_at,
                         self.deps, self.writes, self.txn_result)
         for to in sorted(self.tracker.nodes()):
@@ -55,6 +61,8 @@ class _Persist(api.Callback):
         status = self.tracker.record_success(from_id)
         if status is RequestStatus.Success and not self.durable_recorded:
             self.durable_recorded = True
+            if self._spans is not None:    # duration = time to majority-durable
+                self._spans.end(self._sp)
             # a quorum of every shard has applied: the txn is majority-durable.
             # Tell every replica so progress logs stand down and truncation
             # watermarks can advance (ref: Persist.java InformDurable leg).

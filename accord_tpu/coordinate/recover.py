@@ -37,6 +37,7 @@ from ..primitives.timestamp import Ballot, TxnId
 from ..primitives.txn import Txn
 from ..primitives.writes import ProgressToken
 from ..local.status import Status, recovery_rank
+from ..obs import spans_of
 from ..utils import async_chain
 from .errors import Preempted, Timeout, Truncated
 from .adapter import Adapters
@@ -163,6 +164,14 @@ class Recover(api.Callback):
         self.done = False
 
     def _start(self) -> None:
+        sp = spans_of(self.node)
+        if sp is not None:
+            # one recovery HOP on the txn's span tree (recovery may run on
+            # a different node than the original coordinator — the sim
+            # shares one recorder, so the hop lands on the same tree);
+            # repeated hops record the grind a progress-log storm shows as
+            sp.event(str(self.txn_id), "recover",
+                     node=self.node.node_id, ballot=str(self.ballot))
         request = BeginRecovery(self.txn_id, self.txn, self.route, self.ballot)
         for to in sorted(self.tracker.nodes()):
             self.node.send(to, request, self)

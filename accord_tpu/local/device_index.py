@@ -100,6 +100,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import devprof
 from ..ops import deps_kernel as dk
 from ..ops import drain_kernel as drk
 from ..ops.packing import to_i64
@@ -2224,7 +2225,9 @@ class DeviceState:
         else:
             obs = getattr(self.store.node, "route_observer", None)
             if obs is not None:
-                obs(self.store, observed, nq)
+                # the query txn-ids ride along so the observer can stamp
+                # the route onto each txn's span tree (obs.spans)
+                obs(self.store, observed, nq, [q[0] for q in queries])
         degenerate = not self.BUCKETED or \
             len(self.deps.wide_entries) > self.deps.WIDE_MAX
         try:
@@ -2320,11 +2323,23 @@ class DeviceState:
 
     def _ktime(self, kind: str, t0: float) -> None:
         import time as _time
+        t1 = _time.perf_counter()
         cell = self.kernel_times.get(kind)
         if cell is None:
             cell = self.kernel_times[kind] = [0, 0.0]
         cell[0] += 1
-        cell[1] += _time.perf_counter() - t0
+        cell[1] += t1 - t0
+        prof = devprof.PROFILER
+        if prof is not None:
+            # every launch boundary already timed here (dispatch_* = host
+            # pack + upload + enqueue, wait_* = download join, host_* =
+            # host passes) becomes a Chrome-trace slice: pid = node,
+            # tid = store — the launch timeline, not just a counter
+            prof.complete(
+                kind, t0, t1,
+                pid=getattr(getattr(self.store, "node", None),
+                            "node_id", 0) or 0,
+                tid=getattr(self.store, "store_id", 0) or 0)
 
     def _collect_part(self, part):
         """Download + parse one kernel part; re-run once when the learned
@@ -2659,7 +2674,9 @@ class DeviceState:
         else:
             obs = getattr(self.store.node, "route_observer", None)
             if obs is not None:
-                obs(self.store, "fused", hint["nq"])
+                batch = hint.get("batch") or ()
+                obs(self.store, "fused", hint["nq"],
+                    [q[0] for q, _b, _d in batch])
 
     def fused_fail_to_host(self, hint, exc) -> None:
         """A device fault inside the fused LAUNCH fails the whole batch

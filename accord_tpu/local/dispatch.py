@@ -44,11 +44,13 @@ for correctness); everything else is priced, not thresholded.
 from __future__ import annotations
 
 import os
+import time
 from functools import partial
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import devprof
 from ..ops import deps_kernel as dk
 from ..ops import drain_kernel as drk
 from ..utils import faults
@@ -60,6 +62,21 @@ def fusion_enabled() -> bool:
     "no" pins every launch solo (correctness must never depend on fusion)."""
     return os.environ.get("ACCORD_TPU_FUSION", "").lower() not in (
         "off", "0", "false", "no")
+
+
+def _profiled_harvest(name, dev0, members, download):
+    """Run one fused-result ``download()`` under the device profiler (when
+    armed): the harvest-barrier slice, pid-matched to the dispatch slice's
+    node row.  Shared by the flush and tick harvest paths."""
+    prof = devprof.PROFILER
+    _t0 = time.perf_counter() if prof is not None else 0.0
+    out = download()
+    if prof is not None:
+        prof.complete(name, _t0, time.perf_counter(), cat="fused",
+                      pid=getattr(getattr(dev0.store, "node", None),
+                                  "node_id", 0) or 0,
+                      args={"members": members})
+    return out
 
 
 class FusedFlushLaunch:
@@ -82,7 +99,10 @@ class FusedFlushLaunch:
             raise self.failed
         if self._out is None:
             faults.check("transfer", "fused result download")
-            self._out = np.asarray(self.dev)
+            # ONE download serves every member (first harvester pays it)
+            self._out = _profiled_harvest(
+                "fused_flush_harvest", self.hints[0]["dev"],
+                len(self.hints), lambda: np.asarray(self.dev))
         return self._out
 
     def poison(self, exc: BaseException) -> None:
@@ -116,7 +136,9 @@ class FusedTick:
             raise self.failed
         if self._out is None:
             faults.check("transfer", "fused drain download")
-            self._out = np.asarray(self.dev)
+            self._out = _profiled_harvest(
+                "fused_tick_harvest", self.members[0],
+                len(self.members), lambda: np.asarray(self.dev))
         i, live, _v = self.rows[id(dev)]
         ready = self._out[i][: len(live)]
         return live[ready & dev.drain.active[live]]
@@ -241,6 +263,8 @@ class DeviceDispatcher:
         return 2.0 * rtt + c_dev * fused_elems + snap_cost < solo
 
     def _launch_fused_flush(self, hints) -> Optional[FusedFlushLaunch]:
+        prof = devprof.PROFILER
+        _t0 = time.perf_counter() if prof is not None else 0.0
         devs = [h["dev"] for h in hints]
         mesh = devs[0].mesh            # one node -> one mesh for all stores
         d = 1 if mesh is None else max(len(mesh.devices.flat), 1)
@@ -300,6 +324,14 @@ class DeviceDispatcher:
             return None
         self.n_fused_launches += 1
         self.n_fused_members += len(hints)
+        if prof is not None:
+            # pack + stack + async enqueue of ONE store-tagged launch in
+            # place of len(hints) solo launches — the coalescing win as a
+            # timeline slice (harvest lands in fused_flush_harvest)
+            prof.complete("fused_flush_dispatch", _t0, time.perf_counter(),
+                          cat="fused", pid=getattr(self.node, "node_id", 0),
+                          args={"members": len(hints),
+                                "nq": sum(h["nq"] for h in hints)})
         if self.on_fused is not None:
             self.on_fused("flush", len(hints),
                           sum(h["nq"] for h in hints))
@@ -372,6 +404,8 @@ class DeviceDispatcher:
             if len(group) < 2 or not self._fused_tick_pays(group, calib,
                                                            kind):
                 continue
+            prof = devprof.PROFILER
+            _t0 = time.perf_counter() if prof is not None else 0.0
             try:
                 out_dev = kernel([st for _d, st, _lv in group])
             except faults.DEVICE_EXCEPTIONS as e:
@@ -381,6 +415,11 @@ class DeviceDispatcher:
             ft = FusedTick(out_dev, group)
             self.n_fused_tick_launches += 1
             self.n_fused_tick_members += len(group)
+            if prof is not None:
+                prof.complete("fused_tick_dispatch", _t0,
+                              time.perf_counter(), cat="fused",
+                              pid=getattr(self.node, "node_id", 0),
+                              args={"members": len(group), "kind": kind})
             if self.on_fused is not None:
                 self.on_fused("tick", len(group), 0)
             for dev, _st, _lv in group:

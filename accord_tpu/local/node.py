@@ -69,6 +69,10 @@ class Node:
             progress_log_factory = SimpleProgressLog
         self.progress_log_factory = progress_log_factory
         self.topology_manager = TopologyManager(node_id)
+        # observability bundle (obs.Observability) the harness attaches —
+        # the sim cluster and maelstrom runner share one per run; None
+        # means unobserved (zero cost beyond getattr+None checks)
+        self.obs = None
         # per-node device dispatch scheduler (r08): coalesces deps flushes
         # and drain ticks across this node's CommandStores into fused
         # kernel launches when the cost model says fusion wins; None in
@@ -351,6 +355,20 @@ class Node:
         self._coordinating[txn_id] = result
         result.begin(lambda _r, _f: self._coordinating.pop(txn_id, None))
 
+        from ..obs import spans_of
+        sp = spans_of(self)
+        if sp is not None:
+            # root span of this txn's tree: the client-visible window.
+            # Phase children (preaccept/accept/stable/read/apply) attach
+            # in the coordinate FSMs; a fence-Rejected retry runs under a
+            # FRESH TxnId, so the retry's tree is its own root — the
+            # ``retries`` attr counts the hop and the old root carries
+            # the terminating ``retry`` event.
+            sp.begin_txn(str(txn_id), node=self.node_id,
+                         kind=txn.kind.name, retries=_retries)
+            result.begin(lambda _r, f: sp.end_txn(
+                str(txn_id), "ok" if f is None else type(f).__name__))
+
         superseded = {"flag": False}
 
         def settle(value, failure):
@@ -380,6 +398,12 @@ class Node:
                     self.unique_now_at_least(floor)
                     if floor.epoch() > self.epoch():
                         retry_epoch = floor.epoch()
+                if sp is not None:
+                    # the old id's tree ends here; the retry's fresh id
+                    # opens its own root (retries attr links the hop count)
+                    sp.event(str(txn_id), "retry",
+                             reason="Rejected", attempt=_retries + 1)
+                    sp.end_txn(str(txn_id), "Rejected-retried")
                 superseded["flag"] = True
                 self._coordinating.pop(txn_id, None)
                 self._invalidate_then_retry(txn, txn_id, _retries, result,
@@ -399,6 +423,8 @@ class Node:
             if result.is_done() or superseded["flag"]:
                 return
             from ..coordinate.recover import Recover
+            if sp is not None:
+                sp.event(str(txn_id), "watchdog_recover")
             route = self.compute_route(txn_id, txn.keys)
             Recover.recover(self, txn_id, route, txn).begin(on_recovered)
 

@@ -191,13 +191,17 @@ class MaelstromProcess:
                  num_stores: int = 2,
                  shards: int = 16,
                  device_mode: Optional[bool] = None,
-                 durability: bool = True):
+                 durability: bool = True,
+                 obs=None):
         self._emit_raw = emit
         self.scheduler = scheduler
         self.now_micros = now_micros
         self.num_stores = num_stores
         self.shards = shards
         self.device_mode = device_mode
+        # shared obs.Observability (the in-process runner wires one per
+        # run so bench config rows read phase latencies + fast-path rate)
+        self.obs = obs
         self.enable_durability = durability
         self.name: Optional[str] = None
         self.node: Optional[Node] = None
@@ -272,6 +276,7 @@ class MaelstromProcess:
             now_micros=self.now_micros,
             num_stores=self.num_stores,
             device_mode=self.device_mode)
+        self.node.obs = self.obs
         self.node.on_topology_update(topology)
         self._sweeper = self.scheduler.recurring(SWEEP_INTERVAL_MICROS,
                                                  self.sink.sweep)

@@ -30,12 +30,33 @@ class RunResult:
         # per-op commit latency in SIMULATED micros (client submit ->
         # txn_ok) — the configs[0]/[1] p99 metric
         self.latencies_micros: List[int] = []
+        # the run's obs.Observability (set by the runner that produced
+        # this result) — obs_row_fields reads phase latencies from it
+        self.obs = None
 
     def p99_micros(self) -> Optional[int]:
         if not self.latencies_micros:
             return None
         xs = sorted(self.latencies_micros)
         return xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+
+    def obs_row_fields(self) -> dict:
+        """Per-phase p50/p99 (sim ms) + fast-path rate from the run's
+        observability bundle — the r09 bench config-row fields.  Empty
+        under ACCORD_TPU_OBS=off (the row shape degrades, never errors)."""
+        obs = self.obs
+        if obs is None or obs.spans is None:
+            return {}
+        phases = {}
+        for phase, row in obs.metrics.phase_percentiles().items():
+            phases[phase] = {"p50_ms": round(row["p50"] / 1000, 2),
+                             "p99_ms": round(row["p99"] / 1000, 2),
+                             "n": row["n"]}
+        out = {"phases_ms": phases}
+        rate = obs.spans.fast_path_rate()
+        if rate is not None:
+            out["fast_path_rate"] = round(rate, 4)
+        return out
 
     def __repr__(self):
         return (f"RunResult(ok={self.ops_ok}, failed={self.ops_failed}, "
@@ -60,6 +81,13 @@ class MaelstromRunner:
         self.result = RunResult()
         self.mean_latency = mean_latency_micros
         scheduler = SimScheduler(self.queue)
+        # one shared observability bundle (obs.*): every process node's
+        # coordinate FSM stamps phase spans in this runner's SIM time, so
+        # the bench config rows can report per-phase p50/p99 latency and
+        # the fast-path rate (spans None under ACCORD_TPU_OBS=off)
+        from ..obs import Observability
+        self.obs = Observability(now=lambda: self.queue.now)
+        self.result.obs = self.obs
         # client replies (dest "c...") land here
         self.client_handlers: Dict[int, Callable[[dict], None]] = {}
         for name in self.names:
@@ -67,7 +95,7 @@ class MaelstromRunner:
                 emit=self._make_emit(name), scheduler=scheduler,
                 now_micros=lambda: self.queue.now,
                 shards=shards, device_mode=device_mode,
-                durability=durability)
+                durability=durability, obs=self.obs)
             self.processes[name] = proc
         # init handshake (ref: Runner sends init to every node first)
         for i, name in enumerate(self.names):

@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from ..local.command_store import PreLoadContext, SafeCommandStore
 from ..local.status import SaveStatus
+from ..obs import spans_of
 from ..primitives.keys import Ranges, Route
 from ..primitives.timestamp import Timestamp, TxnId
 from ..utils import async_chain
@@ -91,8 +92,21 @@ def read_on_store(safe: SafeCommandStore, txn_id: TxnId
     if try_read(safe, cmd, via_listener=False):
         return out
 
+    # the txn is not yet ReadyToExecute on this store: the read waits on
+    # the local drain (deps with lower executeAt applying) — the
+    # deps-wait leg of the txn's span tree, stamped on the REPLICA
+    spans = spans_of(safe.store.node)
+    sp_wait = None
+    if spans is not None:
+        sp_wait = spans.begin(
+            str(txn_id), "deps_wait",
+            node=getattr(safe.store.node, "node_id", None),
+            store=getattr(safe.store, "store_id", None))
+
     def listener(s: SafeCommandStore, updated) -> None:
         if try_read(s, updated, via_listener=True):
+            if spans is not None:    # the drain released the txn here
+                spans.end(sp_wait)
             s.remove_transient_listeners(txn_id)
 
     safe.add_transient_listener(txn_id, listener)

@@ -53,6 +53,14 @@ class BurnResult:
         self.quiet_recovery_msgs = 0
         self.drain_micros_used = 0
         self.kernel_wall: Dict[str, float] = {}   # wall timings (not seeded)
+        # unified observability exports (obs.*): the registry snapshot is a
+        # pure function of the seed (sim-time stamps only) and the span
+        # export is the canonical byte string same-seed runs must reproduce
+        # exactly; span_export is None under ACCORD_TPU_OBS=off
+        self.metrics_snapshot: Optional[Dict] = None
+        self.span_export: Optional[str] = None
+        self.fast_path_rate: Optional[float] = None
+        self.phase_latencies: Dict[str, Dict[str, int]] = {}
 
     def __repr__(self):
         return (f"BurnResult(ok={self.ops_ok}, failed={self.ops_failed}, "
@@ -466,6 +474,23 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     # wall-clock timings live OUTSIDE stats: stats must stay a pure
     # function of the seed (the determinism double-run compares it)
     result.kernel_wall = {k: round(1e3 * sec, 1) for k, sec in kt.items()}
+
+    # unified observability export (obs.*): fold every store's attribute
+    # counters into the registry as labeled gauges, then snapshot — the
+    # one deterministic record the double-run gate compares byte-for-byte
+    # — and export the span trees (sim-time stamped, canonical JSON)
+    from ..obs.metrics import collect_device_state
+    for nid in sorted(cluster.nodes):
+        for s in cluster.nodes[nid].command_stores.unsafe_all_stores():
+            if s.device is not None:
+                collect_device_state(cluster.obs.metrics, s.device,
+                                     node=nid, store=s.store_id)
+    result.metrics_snapshot = cluster.obs.metrics.snapshot()
+    spans = cluster.obs.spans
+    if spans is not None:
+        result.span_export = spans.export_json()
+        result.fast_path_rate = spans.fast_path_rate()
+        result.phase_latencies = cluster.obs.metrics.phase_percentiles()
     return result
 
 

@@ -301,7 +301,16 @@ class Cluster:
         self._link_last: Dict[tuple, int] = {}
         # test hook (ref: test NetworkFilter): return True to drop a request
         self.message_filter: Optional[Callable[[int, int, object], bool]] = None
-        self.stats: Dict[str, int] = {}
+        # unified observability (obs.Observability): the metrics registry
+        # is ALWAYS live — it is the store behind ``stats`` — while span
+        # recording obeys the ACCORD_TPU_OBS knob.  ``stats`` keeps its
+        # exact legacy keys (LegacyStats is a dict-compatible view over
+        # registry counters), so every determinism gate compares the same
+        # bytes it always did.
+        from ..obs import Observability
+        from ..obs.metrics import LegacyStats
+        self.obs = Observability(now=lambda: self.queue.now)
+        self.stats = LegacyStats(self.obs.metrics)
         # structured event trace (ref: accord.impl.basic.Trace); off unless
         # a Trace instance is attached
         self.trace = None
@@ -344,13 +353,24 @@ class Cluster:
         line provides (utils.trace.Trace.record_route).  A node-level
         observer, so stores created later (topology updates, bootstrap)
         are covered without re-wiring."""
-        def observer(store, route, nq, nid=node.node_id):
+        node.obs = self.obs    # span recorder for the coordinate FSMs
+
+        def observer(store, route, nq, tids=None, nid=node.node_id):
             key = "DepsRoute." + route
             self.stats[key] = self.stats.get(key, 0) + nq
+            self.obs.metrics.counter("deps_route_queries",
+                                     node=nid, route=route).inc(nq)
+            sid = getattr(store, "store_id", -1)
             if self.trace is not None:
-                self.trace.record_route(self.queue.now, nid,
-                                        getattr(store, "store_id", -1),
-                                        route, nq)
+                self.trace.record_route(self.queue.now, nid, sid, route, nq)
+            sp = self.obs.spans
+            if sp is not None and tids:
+                # stamp the route each txn's deps scan actually took onto
+                # its span tree (the ISSUE's "deps route taken"); unknown
+                # txn keys (non-coordinated scans) drop inside event()
+                for tid in tids:
+                    sp.event(str(tid), "deps_route", route=route,
+                             node=nid, store=sid)
 
         node.route_observer = observer
 
@@ -360,6 +380,8 @@ class Cluster:
             sim-side leg of the degradation-ladder observability."""
             key = "DeviceFault." + event
             self.stats[key] = self.stats.get(key, 0) + 1
+            self.obs.metrics.counter("device_fault_events",
+                                     node=nid, event=event).inc()
             if self.trace is not None:
                 sid = getattr(store, "store_id", -1)
                 if event in ("quarantine", "reprobe", "restore"):
@@ -380,6 +402,9 @@ class Cluster:
                 of the r08 launch-coalescing observability."""
                 key = "DeviceDispatch.fused_" + kind
                 self.stats[key] = self.stats.get(key, 0) + 1
+                m = self.obs.metrics
+                m.counter("fused_launches", node=nid, kind=kind).inc()
+                m.counter("fused_members", node=nid, kind=kind).inc(members)
                 if self.trace is not None:
                     self.trace.record_fused(self.queue.now, nid, kind,
                                             members, nq)

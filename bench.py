@@ -147,6 +147,97 @@ class BenchSafe:
         return self.store.redundant_before
 
 
+def build_headline_store(entries, keyspace=1_000_000):
+    """The live protocol store the headline bench times against (shared
+    with tools/profile.py headline/attr modes): real RedundantBefore
+    floors over a slice of the keyspace + CommandsForKey state, populated
+    from ``entries`` via the same registration path the sim's protocol
+    transitions drive.  Returns (store, dev, safe)."""
+    from accord_tpu.local.commands_for_key import (CommandsForKey,
+                                                   InternalStatus)
+    from accord_tpu.local.device_index import DeviceState
+    from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    store = BenchStore()
+    # non-trivial floors over a slice of the keyspace (shard-durable
+    # watermarks in a live deployment)
+    floor_id = TxnId.create(1, 500_000, TxnKind.ExclusiveSyncPoint,
+                            Domain.Range, 1)
+    store.redundant_before.add_redundant(
+        Ranges.of(*(Range(s, s + 50_000)
+                    for s in range(0, keyspace // 2, 100_000))), floor_id)
+    dev = DeviceState(store)
+    safe = BenchSafe(store)
+    for tid, toks, rngs in entries:
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+        for t in toks:
+            cfk = store.commands_for_key.get(t)
+            if cfk is None:
+                cfk = store.commands_for_key[t] = CommandsForKey(t)
+            cfk.update(tid, InternalStatus.PREACCEPTED)
+    return store, dev, safe
+
+
+def build_hot128_store():
+    """Config 3's hot-128 dense-graph store and its query workload, drawn
+    from ONE seeded stream so the bench and tools/profile.py's hot mode
+    see identical bytes.  Returns (store, dev, safe, entries, floor_id,
+    queries, build_rate, rng) — the rng is the stream CONTINUATION so the
+    bench's drain legs draw exactly the bytes they always did."""
+    import time as _t
+    from accord_tpu.local.device_index import DeviceState
+    from accord_tpu.local.commands_for_key import (CommandsForKey,
+                                                   InternalStatus)
+    from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    N3, B3, HOT = 100_000, 256, 128
+    rng = np.random.default_rng(9)
+    store = BenchStore()
+    dev = DeviceState(store)
+    safe = BenchSafe(store)
+    hlcs = np.sort(rng.choice(np.arange(1, 2_000_000), size=N3,
+                              replace=False))
+    floor_hlc = int(hlcs[int(N3 * 0.9)])
+    floor_id = TxnId.create(1, floor_hlc, TxnKind.ExclusiveSyncPoint,
+                            Domain.Range, 1)
+    entries = []
+    for i in range(N3):
+        hlc = int(hlcs[i])
+        if hlc < floor_hlc:
+            status = InternalStatus.APPLIED
+        else:
+            status = (InternalStatus.COMMITTED if rng.random() < 0.3
+                      else InternalStatus.PREACCEPTED)
+        kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
+        tid = TxnId.create(1, hlc, kind, Domain.Key, 1 + i % 5)
+        toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
+        entries.append((tid, status, toks))
+    t0 = _t.time()
+    for tid, status, toks in entries:
+        dev.register(tid, int(status), Keys([IntKey(t) for t in toks]))
+        if status >= InternalStatus.COMMITTED:
+            dev.update_status(tid, int(status), execute_at=tid)
+        for t in toks:
+            cfk = store.commands_for_key.get(t)
+            if cfk is None:
+                cfk = store.commands_for_key[t] = CommandsForKey(t)
+            cfk.update(tid, status,
+                       execute_at=tid if status >= InternalStatus.COMMITTED
+                       else None)
+    build_rate = N3 / (_t.time() - t0)
+    store.redundant_before.add_redundant(Ranges.of(Range(0, HOT)), floor_id)
+    queries = []
+    for b in range(B3 * 4):
+        bound = TxnId.create(1, int(rng.integers(2_000_000, 3_000_000)),
+                             TxnKind.Write, Domain.Key, 1)
+        toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
+        queries.append((bound, bound, bound.kind().witnesses(), toks, []))
+    return store, dev, safe, entries, floor_id, queries, build_rate, rng
+
+
 class HostIndexedBaseline:
     """The reference's scan shape on the host: per-key sorted TxnId lists
     (CommandsForKey) + a flat range-entry table stabbed per query (the
@@ -231,15 +322,20 @@ def bench_maelstrom_configs():
     """BASELINE configs[0]/[1]: p99 commit latency through the in-process
     Maelstrom runner (full wire serde on the hot path, 1ms mean link
     latency).  SIMULATED time: the number measures protocol round counts,
-    not host speed — host mode so kernel RTTs don't skew a latency metric."""
+    not host speed — host mode so kernel RTTs don't skew a latency metric.
+    The r09 obs subsystem rides each run: rows additionally report
+    per-protocol-phase p50/p99 (sim ms) and the fast-path rate — the
+    headline protocol KPI the reference never measured."""
     from accord_tpu.maelstrom.runner import MaelstromRunner
 
     def row(config, metric, res):
         p99 = res.p99_micros()
-        return {"config": config, "metric": metric,
-                "value": None if p99 is None else round(p99 / 1000, 2),
-                "unit": "sim_ms", "ok": res.ops_ok,
-                "failed": res.ops_failed}
+        out = {"config": config, "metric": metric,
+               "value": None if p99 is None else round(p99 / 1000, 2),
+               "unit": "sim_ms", "ok": res.ops_ok,
+               "failed": res.ops_failed}
+        out.update(res.obs_row_fields())
+        return out
 
     r0 = MaelstromRunner(3, seed=0, shards=8, device_mode=False)
     yield row(0, "maelstrom_p99_commit_latency_3n_100k_single_key",
@@ -262,58 +358,16 @@ def bench_hot_keys():
     The drain leg runs 100k stable txns through the ELL (sparse) fixpoint
     kernel — no O(N^2) anywhere — plus the r04 4096-deep dense-MXU chain."""
     import time as _t
-    from accord_tpu.local.device_index import DeviceState
-    from accord_tpu.local.commands_for_key import CommandsForKey, InternalStatus
+    from accord_tpu.local.commands_for_key import InternalStatus
     from accord_tpu.ops import drain_kernel as drk
     from accord_tpu.ops.packing import pack_timestamps
     from accord_tpu.primitives.deps import DepsBuilder
-    from accord_tpu.primitives.keys import Keys, IntKey, Range, Ranges
     from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
     import jax.numpy as jnp
 
-    N3, B3, HOT = 100_000, 256, 128
-    rng = np.random.default_rng(9)
-    store = BenchStore()
-    dev = DeviceState(store)
-    safe = BenchSafe(store)
-    hlcs = np.sort(rng.choice(np.arange(1, 2_000_000), size=N3,
-                              replace=False))
-    floor_hlc = int(hlcs[int(N3 * 0.9)])
-    floor_id = TxnId.create(1, floor_hlc, TxnKind.ExclusiveSyncPoint,
-                            Domain.Range, 1)
-    entries = []
-    for i in range(N3):
-        hlc = int(hlcs[i])
-        if hlc < floor_hlc:
-            status = InternalStatus.APPLIED
-        else:
-            status = (InternalStatus.COMMITTED if rng.random() < 0.3
-                      else InternalStatus.PREACCEPTED)
-        kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
-        tid = TxnId.create(1, hlc, kind, Domain.Key, 1 + i % 5)
-        toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
-        entries.append((tid, status, toks))
-    t0 = _t.time()
-    for tid, status, toks in entries:
-        dev.register(tid, int(status), Keys([IntKey(t) for t in toks]))
-        if status >= InternalStatus.COMMITTED:
-            dev.update_status(tid, int(status), execute_at=tid)
-        for t in toks:
-            cfk = store.commands_for_key.get(t)
-            if cfk is None:
-                cfk = store.commands_for_key[t] = CommandsForKey(t)
-            cfk.update(tid, status,
-                       execute_at=tid if status >= InternalStatus.COMMITTED
-                       else None)
-    build_rate = N3 / (_t.time() - t0)
-    store.redundant_before.add_redundant(Ranges.of(Range(0, HOT)), floor_id)
-
-    queries = []
-    for b in range(B3 * 4):
-        bound = TxnId.create(1, int(rng.integers(2_000_000, 3_000_000)),
-                             TxnKind.Write, Domain.Key, 1)
-        toks = [int(t) for t in rng.integers(0, HOT, rng.integers(1, 4))]
-        queries.append((bound, bound, bound.kind().witnesses(), toks, []))
+    B3 = 256
+    store, dev, safe, entries, floor_id, queries, build_rate, rng = \
+        build_hot128_store()
     batches = [queries[i * B3:(i + 1) * B3] for i in range(4)]
     for batch in batches:   # untimed shape/capacity learning pass
         dev.deps_query_batch_attributed(safe, batch,
@@ -448,15 +502,15 @@ def bench_hot_keys():
              "chain_depth": NDD}]
 
 
-def bench_launch_amortized():
-    """BASELINE config 5 (r08): the many-stores/small-flushes regime.  16
-    CommandStores' worth of DeviceStates on ONE node's DeviceDispatcher,
-    each flushing 4-query batches that become runnable in the same
-    event-loop step — the shape where per-launch overhead dominated
-    per-element work.  Measures the SAME workload with the dispatcher's
-    fusion off (solo launches, the r07 behavior) and on (fused,
-    store-tagged launches), reporting txn/s and device launches per 1k
-    txns for both."""
+def bench_launch_amortized_harness(stores=16, rounds=48, fusion=True,
+                                   warm_rounds=4):
+    """One measured run of the many-stores/small-flushes workload (config
+    5's harness, reusable): ``stores`` DeviceStates on ONE node's
+    DeviceDispatcher, 4-query flushes becoming runnable in the same
+    event-loop step.  Returns {qps, launches, nq, fused_members}.  Shared
+    with tools/profile.py ``launches`` mode (where obs.devprof captures
+    the fused run's launch timeline) and the obs test's Chrome-trace
+    acceptance run."""
     import time as _t
     from accord_tpu.local.commands_for_key import InternalStatus
     from accord_tpu.local.device_index import DeviceState
@@ -465,7 +519,7 @@ def bench_launch_amortized():
     from accord_tpu.primitives.keys import IntKey, Keys
     from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
 
-    S, NPER, B, ROUNDS, KEYS = 16, 2048, 4, 48, 4096
+    S, NPER, B, KEYS = stores, 2048, 4, 4096
 
     class Sched:
         def __init__(self):
@@ -551,18 +605,28 @@ def bench_launch_amortized():
             node.scheduler.run()
         return n_done[0]
 
-    res = {}
-    for mode, fusion in (("solo", False), ("fused", True)):
-        node, devs = build(fusion)
-        drive(node, devs, 4, seed=5)        # warm: compile + learn s/k
-        disp = node.dispatcher
-        l0 = disp.n_fused_launches + disp.n_solo_flushes
-        t0 = _t.time()
-        nq = drive(node, devs, ROUNDS, seed=7)
-        dt = _t.time() - t0
-        launches = disp.n_fused_launches + disp.n_solo_flushes - l0
-        res[mode] = {"qps": nq / dt, "launches": launches, "nq": nq,
-                     "fused_members": disp.n_fused_members}
+    node, devs = build(fusion)
+    drive(node, devs, warm_rounds, seed=5)  # warm: compile + learn s/k
+    disp = node.dispatcher
+    l0 = disp.n_fused_launches + disp.n_solo_flushes
+    m0 = disp.n_fused_members
+    t0 = _t.time()
+    nq = drive(node, devs, rounds, seed=7)
+    dt = _t.time() - t0
+    launches = disp.n_fused_launches + disp.n_solo_flushes - l0
+    return {"qps": nq / dt, "launches": launches, "nq": nq,
+            "fused_members": disp.n_fused_members - m0}
+
+
+def bench_launch_amortized():
+    """BASELINE config 5 (r08): the many-stores/small-flushes regime — the
+    shape where per-launch overhead dominated per-element work.  Measures
+    the SAME workload with the dispatcher's fusion off (solo launches, the
+    r07 behavior) and on (fused, store-tagged launches), reporting txn/s
+    and device launches per 1k txns for both."""
+    S, B = 16, 4
+    res = {mode: bench_launch_amortized_harness(stores=S, fusion=fusion)
+           for mode, fusion in (("solo", False), ("fused", True))}
     f, s = res["fused"], res["solo"]
     return [{
         "config": 5,
@@ -661,7 +725,6 @@ def main(em: Emitter):
     from accord_tpu.ops.packing import enable_x64
     enable_x64()
     import jax
-    from accord_tpu.local.device_index import DeviceState
     from accord_tpu.local.commands_for_key import InternalStatus
     from accord_tpu.primitives.keys import Keys, IntKey, Ranges
 
@@ -681,30 +744,10 @@ def main(em: Emitter):
     #    PreAccept/Commit transitions drive (device_index.DeviceState),
     #    with REAL RedundantBefore floors and CommandsForKey state so the
     #    timed path is the protocol-complete one (floors + elision +
-    #    attribution), not a stripped kernel ----------------------------
-    from accord_tpu.local.commands_for_key import CommandsForKey
-    from accord_tpu.primitives.keys import Range
-    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
-
-    store = BenchStore()
-    # non-trivial floors over a slice of the keyspace (shard-durable
-    # watermarks in a live deployment)
-    floor_id = TxnId.create(1, 500_000, TxnKind.ExclusiveSyncPoint,
-                            Domain.Range, 1)
-    store.redundant_before.add_redundant(
-        Ranges.of(*(Range(s, s + 50_000)
-                    for s in range(0, KEYSPACE // 2, 100_000))), floor_id)
-    dev = DeviceState(store)
-    safe = BenchSafe(store)
+    #    attribution), not a stripped kernel (build_headline_store,
+    #    shared with tools/profile.py) ----------------------------------
     t0 = time.time()
-    for tid, toks, rngs in entries:
-        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
-        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
-        for t in toks:
-            cfk = store.commands_for_key.get(t)
-            if cfk is None:
-                cfk = store.commands_for_key[t] = CommandsForKey(t)
-            cfk.update(tid, InternalStatus.PREACCEPTED)
+    store, dev, safe = build_headline_store(entries, KEYSPACE)
     build_s = time.time() - t0
     build_rate = N / build_s
 
@@ -808,6 +851,11 @@ def main(em: Emitter):
     pb = {k: 1e3 * v / n_phase_batches for k, v in phases.items()}
     kt = {k: f"{1e3 * sec / max(calls, 1):.1f}ms x{calls}"
           for k, (calls, sec) in sorted(dev.kernel_times.items())}
+    # the # index: counters render from the obs registry's ONE key list
+    # (obs.metrics.INDEX_COUNTERS) — same keys, same order as every prior
+    # BENCH artifact, now shared with the burn/sim exporters
+    from accord_tpu.obs.metrics import index_counters
+    idx = " ".join(f"{k}={v}" for k, v in index_counters(dev).items())
     em.note(
         f"# device={jax.devices()[0].platform} N={N} B={B} "
         f"queries_per_rep={B * BATCHES} reps={REPS}\n"
@@ -818,23 +866,7 @@ def main(em: Emitter):
         f"collect(download+parse+geometry+attribute)={pb['collect']:.1f} "
         f"csr_freeze={pb['build']:.1f}\n"
         f"# kernel timing (wall mean per call): {kt}\n"
-        f"# index: host_queries={dev.n_host_queries} "
-        f"bucketed_queries={dev.n_bucketed_queries} "
-        f"dense_queries={dev.n_dense_queries} "
-        f"mesh_queries={dev.n_mesh_queries} "
-        f"mesh_bucketed_queries={dev.n_mesh_bucketed_queries} "
-        f"dispatches={dev.n_dispatches} "
-        f"fused_flushes={dev.n_fused_flushes} "
-        f"fused_queries={dev.n_fused_queries} "
-        f"fused_ticks={dev.n_fused_ticks} "
-        f"wide_entries={len(dev.deps.wide_entries)} "
-        f"buckets={len(dev.deps.bucket_entries)} "
-        f"device_faults={dev.n_device_faults} "
-        f"quarantines={dev.n_quarantines} "
-        f"fallback_queries={dev.n_fallback_queries} "
-        f"shadow_mismatches={dev.n_shadow_mismatches} "
-        f"compactions={dev.n_compactions} "
-        f"oom_degraded={int(dev.host_pinned)}\n"
+        f"# index: {idx}\n"
         f"# build={build_rate:.0f} reg/s live_insert+query={live_rate:.0f} op/s\n"
         f"# baseline=host indexed scan (numpy-vectorized reference "
         f"semantics) {host_rate:.1f} q/s median of 5x{len(hq)} queries, "

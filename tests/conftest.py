@@ -36,6 +36,15 @@ def pytest_configure(config):
         from accord_tpu.local.dispatch import fusion_enabled
         assert not fusion_enabled(), \
             "ACCORD_TPU_FUSION=off set but dispatch.fusion_enabled() is True"
+    # ACCORD_TPU_OBS=off canary (r09, same contract as the fusion knob):
+    # with the escape hatch set the obs subsystem must actually stand down
+    # (no span recording, no device profiler) and tier-1 must stay green —
+    # observability is never load-bearing for correctness.
+    if os.environ.get("ACCORD_TPU_OBS", "").lower() in ("off", "0",
+                                                        "false", "no"):
+        from accord_tpu import obs
+        assert not obs.enabled(), \
+            "ACCORD_TPU_OBS=off set but obs.enabled() is True"
 
 
 # -- shared DeviceState test fixture --------------------------------------

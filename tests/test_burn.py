@@ -30,12 +30,28 @@ def test_burn_seed(seed):
 def test_burn_deterministic():
     """Same seed -> identical outcome (the race detector,
     ref: burn/ReconcilingLogger same-seed diffing) — including through
-    clock drift, crash-restarts and journal eviction/reload."""
+    clock drift, crash-restarts and journal eviction/reload.  The r09 obs
+    exports join the matrix: the metrics snapshot and the canonical span
+    export must be BYTE-IDENTICAL across the double run (sim-time
+    stamping only — a wall-clock leak into either is a determinism bug),
+    and spans must survive the run's crash-restarts (a dead coordinator's
+    open spans export as unfinished, never corrupt)."""
     a = run_burn(11, n_ops=40)
     b = run_burn(11, n_ops=40)
     assert (a.ops_ok, a.ops_failed, a.epochs, a.restarts, a.evictions) == \
         (b.ops_ok, b.ops_failed, b.epochs, b.restarts, b.evictions)
     assert a.stats == b.stats
+    assert a.metrics_snapshot == b.metrics_snapshot
+    assert a.span_export == b.span_export
+    if a.span_export is not None:       # ACCORD_TPU_OBS=off canary run
+        import json
+        doc = json.loads(a.span_export)
+        assert doc["spans"], "burn coordinated txns but exported no spans"
+        assert a.restarts >= 1          # the crash-restart leg was exercised
+        phases = {c["name"] for r in doc["spans"]
+                  for c in r.get("children", ())}
+        assert {"preaccept", "stable", "apply"} <= phases, phases
+        assert a.fast_path_rate is not None and 0 <= a.fast_path_rate <= 1
 
 
 def test_burn_seed7_30ops_epoch_turnover():
@@ -121,6 +137,26 @@ def test_burn_device_faults_equivalent_and_deterministic(kind):
     b = run_burn(5, n_ops=60, device_faults=kind)
     assert a.ops_unresolved == 0
     assert a.stats == b.stats, "same-seed fault run must replay exactly"
+    assert a.span_export == b.span_export, \
+        "same-seed fault run must export identical span trees"
+    if a.span_export is not None:
+        # the degradation ladder is protocol-invisible, so the faulted
+        # run's span trees must equal the fault-free run's EXCEPT for the
+        # deps_route events (quarantined stores legitimately fall back to
+        # the host route) — phase timings included, byte for byte
+        import json
+
+        def strip_routes(export):
+            doc = json.loads(export)
+            for root in doc["spans"]:
+                evs = [e for e in root.get("events", ())
+                       if e["name"] != "deps_route"]
+                root.pop("events", None)
+                if evs:
+                    root["events"] = evs
+            return json.dumps(doc, sort_keys=True)
+
+        assert strip_routes(a.span_export) == strip_routes(base.span_export)
     assert a.stats["deps_found"] == base.stats["deps_found"]
     assert (a.ops_ok, a.ops_failed, a.epochs, a.restarts, a.evictions) == \
         (base.ops_ok, base.ops_failed, base.epochs, base.restarts,

@@ -1,0 +1,146 @@
+"""Compare two BENCH_*.json artifacts and fail on regression.
+
+    python tools/bench_compare.py BENCH_r08.json BENCH_r09.json \
+        [--threshold 0.10] [--latency-threshold 0.25]
+
+Artifacts are the driver-captured records ({"tail": "<stdout+stderr>",
+"parsed": {headline}}) this repo has emitted since r01 — or raw bench.py
+output (headline JSON last line, ``# CONFIG {...}`` rows).  The diff
+covers:
+
+- the HEADLINE metric (higher is better; regression beyond --threshold
+  fails),
+- every config row present in BOTH artifacts, matched by metric name
+  (unit ``sim_ms`` = latency = lower is better, gated by
+  --latency-threshold; everything else = throughput = higher is better),
+- the r09 observability fields where both sides carry them: per-phase
+  p99 latencies (lower is better) and the fast-path rate (higher is
+  better) — reported, and gated at 2x the base threshold since phase
+  distributions are log-bucketed (2x-granular by construction).
+
+Exit status: 0 = no regression, 1 = usage/parse error, 2 = regression
+beyond threshold.  Every comparison prints either way — the tool is the
+artifact diff first, the CI gate second.
+"""
+
+import argparse
+import json
+import sys
+
+
+def parse_artifact(path):
+    """(headline dict, {metric_name: config_row}) from a driver artifact
+    or raw bench output."""
+    with open(path) as f:
+        text = f.read()
+    headline, configs = None, {}
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict) and "tail" in doc:
+            headline = doc.get("parsed")
+            text = doc["tail"]
+    except ValueError:
+        pass
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("# CONFIG "):
+            try:
+                row = json.loads(line[len("# CONFIG "):])
+            except ValueError:
+                continue
+            if row.get("metric"):
+                configs[row["metric"]] = row
+        elif line.startswith("{"):
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if row.get("metric") and "config" not in row:
+                headline = row
+    if headline is None or headline.get("value") is None:
+        raise SystemExit(f"error: no headline metric in {path}")
+    return headline, configs
+
+
+def check(name, old, new, threshold, lower_is_better=False):
+    """One comparison row; returns the failure message or None."""
+    if old in (None, 0) or new is None:
+        print(f"  {name:58s} {old!r:>12} -> {new!r:>12}  (skipped)")
+        return None
+    if lower_is_better:
+        # new == 0 on a latency metric is a bucket-floor improvement
+        # (sub-ms sim latencies round to 0.0), never a regression
+        ratio = float("inf") if new == 0 else old / new
+    else:
+        ratio = new / old
+    arrow = "v" if new < old else "^"
+    verdict = "OK"
+    fail = None
+    if ratio < 1.0 - threshold:
+        verdict = f"REGRESSION (-{(1 - ratio) * 100:.1f}% beyond "\
+                  f"{threshold * 100:.0f}%)"
+        fail = f"{name}: {old} -> {new} ({verdict})"
+    print(f"  {name:58s} {old:>12} -> {new:>12} {arrow} "
+          f"[{ratio:.2f}x] {verdict}")
+    return fail
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="diff two BENCH artifacts, exit 2 on regression")
+    p.add_argument("old")
+    p.add_argument("new")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="allowed throughput regression fraction (default "
+                        "0.10; this box's bench spread is ~1.15x)")
+    p.add_argument("--latency-threshold", type=float, default=0.25,
+                   help="allowed latency regression fraction (default 0.25)")
+    args = p.parse_args(argv)
+
+    old_head, old_cfg = parse_artifact(args.old)
+    new_head, new_cfg = parse_artifact(args.new)
+    failures = []
+
+    print(f"headline ({args.old} -> {args.new}):")
+    if old_head["metric"] != new_head["metric"]:
+        print(f"  metric changed: {old_head['metric']} -> "
+              f"{new_head['metric']} (compared anyway)")
+    failures.append(check(new_head["metric"], old_head["value"],
+                          new_head["value"], args.threshold))
+
+    common = [m for m in old_cfg if m in new_cfg]
+    print(f"config rows ({len(common)} common, "
+          f"{len(new_cfg) - len(common)} new-only, "
+          f"{len(old_cfg) - len(common)} old-only):")
+    for m in common:
+        o, n = old_cfg[m], new_cfg[m]
+        latency = o.get("unit") == "sim_ms"
+        failures.append(check(
+            m, o.get("value"), n.get("value"),
+            args.latency_threshold if latency else args.threshold,
+            lower_is_better=latency))
+        # r09 observability fields (phase p99s lower-better, fast-path
+        # rate higher-better), gated at 2x threshold: the histograms are
+        # log-bucketed, so single-bucket jitter is expected
+        op, np_ = o.get("phases_ms") or {}, n.get("phases_ms") or {}
+        for phase in sorted(set(op) & set(np_)):
+            failures.append(check(
+                f"{m}.phase[{phase}].p99_ms",
+                op[phase].get("p99_ms"), np_[phase].get("p99_ms"),
+                2 * args.latency_threshold, lower_is_better=True))
+        if o.get("fast_path_rate") is not None \
+                and n.get("fast_path_rate") is not None:
+            failures.append(check(f"{m}.fast_path_rate",
+                                  o["fast_path_rate"], n["fast_path_rate"],
+                                  2 * args.threshold))
+    failures = [f for f in failures if f]
+    if failures:
+        print(f"\nFAIL: {len(failures)} regression(s):", file=sys.stderr)
+        for f in failures:
+            print(f"  {f}", file=sys.stderr)
+        raise SystemExit(2)
+    print("\nok: no regression beyond threshold")
+
+
+if __name__ == "__main__":
+    main()

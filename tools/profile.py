@@ -1,0 +1,308 @@
+"""The profiler: one CLI over the r09 obs subsystem, replacing the five
+overlapping ad-hoc scripts (profile2 / profile_attr / profile_bench /
+profile_hot / profile_hot2) this repo accreted across r04-r06.
+
+    python tools/profile.py headline [--n 100000] [--trace t.json] [--cprofile]
+        Phase breakdown of the headline deps-scan path (pack / upload /
+        kernel / download / begin+collect / attribute / build) on the
+        100k-in-flight workload — the old profile_bench/profile2 view —
+        with every launch boundary also captured as a Chrome-trace slice.
+
+    python tools/profile.py attr [--cprofile]
+        Attribution hot-path focus on the same store (old profile_attr).
+
+    python tools/profile.py hot [--cprofile]
+        The hot-128 low-live-set regime: per-batch begin/collect/attr
+        timings through the adaptive router (old profile_hot/profile_hot2).
+
+    python tools/profile.py launches [--stores 16] [--trace t.json]
+        The launch-coalescing regime: N CommandStores on one
+        DeviceDispatcher, fused vs solo, exporting the launch TIMELINE as
+        Chrome-trace JSON (open in chrome://tracing or ui.perfetto.dev) —
+        the r09 acceptance artifact that makes the r08 win visible as a
+        timeline, not just a counter.
+
+``--trace PATH`` arms obs.devprof for the timed section and writes the
+Chrome trace there (any mode).  Counters print from the same
+obs.metrics.index_counters key list the bench ``# index:`` line uses.
+"""
+
+import os
+import sys
+
+# run as a script, sys.path[0] is tools/ and THIS file shadows the stdlib
+# ``profile`` module cProfile imports — drop that entry before anything else
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path[:] = [p for p in sys.path
+               if os.path.abspath(p or os.getcwd()) != _here]
+sys.path.insert(0, os.path.dirname(_here))
+
+import argparse          # noqa: E402
+import contextlib        # noqa: E402
+import cProfile          # noqa: E402
+import json              # noqa: E402,F401
+import pstats            # noqa: E402
+import time              # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from accord_tpu.ops.packing import enable_x64  # noqa: E402
+
+enable_x64()
+
+from accord_tpu.obs import devprof  # noqa: E402
+from accord_tpu.obs.metrics import index_counters  # noqa: E402
+
+
+def phase(label, fn, reps=3):
+    ts = []
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        ts.append(time.perf_counter() - t0)
+    print(f"{label:28s} {min(ts) * 1e3:9.1f} ms", file=sys.stderr)
+    return out
+
+
+@contextlib.contextmanager
+def maybe_trace(path):
+    if path is None:
+        yield None
+        return
+    with devprof.capture() as prof:
+        yield prof
+    prof.write_chrome(path)
+    tr = prof.chrome_trace()
+    print(f"# chrome trace: {path} ({len(tr['traceEvents'])} events: "
+          f"{tr['otherData']['event_counts']})", file=sys.stderr)
+
+
+def maybe_cprofile(enabled, fn, top=14, sort="tottime"):
+    if not enabled:
+        return None    # don't pay an un-timed, un-profiled extra pass
+    pr = cProfile.Profile()
+    pr.enable()
+    out = fn()
+    pr.disable()
+    st = pstats.Stats(pr)
+    st.sort_stats(sort)
+    st.print_stats(top)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# store builders (shared by the modes; same shapes as bench.py)
+# ---------------------------------------------------------------------------
+
+def build_headline(n):
+    """The headline 100k-in-flight store, built by the SAME
+    bench.build_headline_store the benchmark uses — the profiler always
+    explains exactly the store the bench times."""
+    from bench import build_headline_store, build_workload
+
+    KEYSPACE, M = 1_000_000, 8
+    rng = np.random.default_rng(42)
+    entries = build_workload(rng, n, KEYSPACE, M)
+    t0 = time.time()
+    store, dev, safe = build_headline_store(entries, KEYSPACE)
+    print(f"build {time.time() - t0:.1f}s capacity={dev.deps.capacity}",
+          file=sys.stderr)
+    return store, dev, safe, KEYSPACE, M
+
+
+def headline_queries(b, keyspace, m):
+    from bench import make_queries
+    return [(q[0], q[0], q[1], q[2], q[3])
+            for q in make_queries(1000, b, keyspace, m)]
+
+
+def build_hot():
+    """Config 3's hot-128 low-live-set store + workload, via the shared
+    bench.build_hot128_store (identical seeded bytes)."""
+    from bench import build_hot128_store
+    store, dev, safe, _entries, _floor, queries, _rate, _rng = \
+        build_hot128_store()
+    return store, dev, safe, queries
+
+
+def print_index(dev):
+    print("# index: " + " ".join(f"{k}={v}"
+                                 for k, v in index_counters(dev).items()),
+          file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# modes
+# ---------------------------------------------------------------------------
+
+def mode_headline(args):
+    from accord_tpu.local.device_index import _pow2_at_least
+    from accord_tpu.ops import deps_kernel as dk
+    from accord_tpu.primitives.deps import DepsBuilder
+    import jax
+    import jax.numpy as jnp
+
+    store, dev, safe, keyspace, m = build_headline(args.n)
+    B = args.batch
+    queries = headline_queries(B, keyspace, m)
+    # warm: compile + learn s/k
+    dev.deps_query_batch_attributed(safe, queries,
+                                    [DepsBuilder() for _ in queries])
+    dev.deps_query_batch_attributed(safe, queries,
+                                    [DepsBuilder() for _ in queries])
+    print(f"learned s={dev._batch_flat} k={dev._batch_k}", file=sys.stderr)
+
+    with maybe_trace(args.trace):
+        packed = [(sb, wit, toks, rngs, tid)
+                  for (tid, sb, wit, toks, rngs) in queries]
+        q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
+        table = dev.deps.device_table()
+        n = table.capacity
+        s, k = min(dev._batch_flat, B * n), min(dev._batch_k, n)
+        qnp = phase("pack_query_matrix",
+                    lambda: dk.pack_query_matrix(packed, q_m))
+        qmat = phase("upload(qmat)",
+                     lambda: jax.block_until_ready(jnp.asarray(qnp)))
+        out_dev = phase("kernel(dispatch+wait)", lambda: jax.block_until_ready(
+            dk.calculate_deps_flat(table, qmat, q_m, s, k)))
+        phase("download", lambda: np.asarray(out_dev))
+        res = phase("begin+collect(e2e)", lambda: dev._batch_collect(
+            dev.deps_query_batch_begin(queries)))
+        b_idx, j_idx, overlap, ids, ivs, qnp2, qs = res
+        print(f"pairs after keep: {len(j_idx)}", file=sys.stderr)
+
+        def attr():
+            builders = [DepsBuilder() for _ in queries]
+            dev._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs,
+                                 qnp2, qs, builders)
+            return builders
+
+        builders = phase("attribute", attr)
+        phase("build-all", lambda: [b.build() for b in builders])
+
+        def full():
+            dev.deps_query_batch_attributed(
+                safe, queries, [DepsBuilder() for _ in queries])
+
+        phase("FULL batch e2e", full)
+        maybe_cprofile(args.cprofile,
+                       lambda: (attr(), [b.build() for b in builders]))
+    print_index(dev)
+
+
+def mode_attr(args):
+    from accord_tpu.primitives.deps import DepsBuilder
+
+    store, dev, safe, keyspace, m = build_headline(args.n)
+    queries = headline_queries(args.batch, keyspace, m)
+    dev.deps_query_batch_attributed(safe, queries,
+                                    [DepsBuilder() for _ in queries])
+    res = dev._batch_collect(dev.deps_query_batch_begin(queries))
+    b_idx, j_idx, overlap, ids, ivs, qnp2, qs = res
+
+    def attr():
+        builders = [DepsBuilder() for _ in queries]
+        dev._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs, qnp2,
+                             qs, builders)
+
+    attr()   # warm
+    phase("attribute", attr)
+    maybe_cprofile(True, attr, top=args.top or 25, sort="cumulative")
+    print_index(dev)
+
+
+def mode_hot(args):
+    from accord_tpu.primitives.deps import DepsBuilder
+
+    store, dev, safe, queries = build_hot()
+    B3 = 256
+    batches = [queries[i * B3:(i + 1) * B3] for i in range(4)]
+    t0 = time.time()
+    dev.deps_query_batch_attributed(safe, batches[0],
+                                    [DepsBuilder() for _ in batches[0]])
+    print(f"warmup {time.time() - t0:.1f}s s={dev._batch_flat} "
+          f"k={dev._batch_k} wide={len(dev.deps.wide_entries)}",
+          file=sys.stderr)
+    with maybe_trace(args.trace):
+        for bi, batch in enumerate(batches):
+            t0 = time.time()
+            handle = dev.deps_query_batch_begin(batch, prune_floors=True)
+            t1 = time.time()
+            builders = [DepsBuilder() for _ in batch]
+            dev.deps_query_batch_end_attributed(safe, handle, builders)
+            t2 = time.time()
+            nd = sum(b.build().key_deps.relation_count() for b in builders)
+            print(f"batch {bi}: begin={1e3 * (t1 - t0):.0f}ms "
+                  f"collect+attr={1e3 * (t2 - t1):.0f}ms "
+                  f"count={1e3 * (time.time() - t2):.0f}ms deps={nd}",
+                  file=sys.stderr)
+
+        def one():
+            builders = [DepsBuilder() for _ in batches[0]]
+            h = dev.deps_query_batch_begin(batches[0], prune_floors=True)
+            dev.deps_query_batch_end_attributed(safe, h, builders)
+
+        maybe_cprofile(args.cprofile, one, top=10)
+    print_index(dev)
+
+
+def mode_launches(args):
+    """N stores x small flushes on one DeviceDispatcher: run the SAME
+    workload solo-pinned then fused, print launches/1k-txn, and export the
+    fused run's launch timeline as Chrome-trace JSON."""
+    from bench import bench_launch_amortized_harness
+
+    if args.pin_fused:
+        # the fused-vs-solo pricing is wall-clock-calibrated and may
+        # legitimately price fusion OUT on a loaded box; pin it so the
+        # captured timeline always shows the coalesced shape
+        from accord_tpu.local.dispatch import DeviceDispatcher
+        DeviceDispatcher._fused_flush_pays = lambda self, hints: True
+
+    res = {}
+    for mode_name, fusion in (("solo", False), ("fused", True)):
+        prof_ctx = maybe_trace(args.trace) if fusion else \
+            contextlib.nullcontext()
+        with prof_ctx:
+            res[mode_name] = bench_launch_amortized_harness(
+                stores=args.stores, rounds=args.rounds, fusion=fusion)
+        r = res[mode_name]
+        print(f"{mode_name:5s}: {r['qps']:.1f} txn/s "
+              f"{1e3 * r['launches'] / r['nq']:.2f} launches/1k txn "
+              f"(members/launch="
+              f"{r['fused_members'] / max(r['launches'], 1):.1f})",
+              file=sys.stderr)
+    f, s = res["fused"], res["solo"]
+    print(f"speedup_vs_solo={f['qps'] / s['qps']:.2f}x "
+          f"launch_reduction={s['launches'] / max(f['launches'], 1):.1f}x",
+          file=sys.stderr)
+    if f["fused_members"] == 0:
+        print("note: the calibrated pricing served every flush solo on "
+              "this box/load — rerun with --pin-fused to capture the "
+              "coalesced timeline regardless", file=sys.stderr)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("mode", choices=["headline", "attr", "hot", "launches"])
+    p.add_argument("--n", type=int, default=100_000,
+                   help="in-flight txns for headline/attr store")
+    p.add_argument("--batch", type=int, default=2048)
+    p.add_argument("--stores", type=int, default=16,
+                   help="launches mode: CommandStores on the dispatcher")
+    p.add_argument("--rounds", type=int, default=24)
+    p.add_argument("--top", type=int, default=None)
+    p.add_argument("--trace", default=None,
+                   help="write a Chrome trace (chrome://tracing JSON) here")
+    p.add_argument("--pin-fused", action="store_true",
+                   help="launches mode: bypass the fused-vs-solo pricing "
+                        "so the trace always shows coalesced launches")
+    p.add_argument("--cprofile", action="store_true")
+    args = p.parse_args(argv)
+    {"headline": mode_headline, "attr": mode_attr,
+     "hot": mode_hot, "launches": mode_launches}[args.mode](args)
+
+
+if __name__ == "__main__":
+    main()
