@@ -28,8 +28,12 @@ degrades to a no-op, never a wrong execution.
 
 Regime-adaptive dispatch: every batched deps scan is routed per flush to
 the cheapest of THREE routes, all of which feed the same snapshot, exact
-geometry, floors, elision and attribution code — the protocol never sees
-which route ran (results are bit-identical by construction):
+overlap triples, floors, elision and attribution code — the protocol never
+sees which route ran (results are bit-identical by construction).  Since
+r10 the device kernels answer EXACTLY (sorted composite overlap-triple
+codes; ops.deps_kernel module docstring) and the result download is
+two-stage and compacted: the scalar header first, then only the live
+entry prefix — the host-side collect is a pure vectorized decode:
 
  - **host**: a vectorized numpy interval scan over only the LIVE TAIL
    (slots above the batch-global RedundantBefore floor): token-sorted point
@@ -156,6 +160,60 @@ def _grow(arr: np.ndarray, new_len: int, fill) -> np.ndarray:
     return out
 
 
+def _prefix_len(maxtot: int, s: int) -> int:
+    """Length of the live entry prefix to transfer, rounded up to a coarse
+    granularity so the device-side slice compiles a bounded number of
+    shapes (at most ~16 per learned ``s``) instead of one per total."""
+    gran = max(128, s >> 4)
+    return min(s, -(-maxtot // gran) * gran)
+
+
+def _fetch_entry_prefix(ent_dev, d: int, s: int, maxtot: int) -> np.ndarray:
+    """Stage-2 of the compacted download: transfer ONLY the live prefix of
+    each shard's entry block (the pow2-padded tail never crosses the wire).
+    Returns host [d, L]."""
+    length = _prefix_len(maxtot, s)
+    if length == 0:
+        return np.zeros((d, 0), np.dtype(ent_dev.dtype))
+    if d == 1:
+        return np.asarray(ent_dev[:length]).reshape(1, length)
+    return np.asarray(ent_dev.reshape(d, s)[:, :length])
+
+
+def _decode_triples(hdr: np.ndarray, ent: np.ndarray, nq: int,
+                    shard_n: int, global_ids: bool, mq: int, q_m: int):
+    """Vectorized parse of a (possibly multi-shard) exact CSR download:
+    one concatenate/gather over the stacked shard headers replaces the
+    per-shard Python parse loop.  Returns per-TRIPLE arrays
+    (b, slot, dep_col, q_col); slot indices are shard-local for the
+    slot-sharded kernels (offset by the shard's slice here) and GLOBAL
+    for the bucket-indexed kernels (codes embed global slot ids)."""
+    d = hdr.shape[0]
+    counts = np.diff(hdr[:, 2:].astype(np.int64), prepend=0, axis=1)
+    totals = hdr[:, 0].astype(np.int64)
+    b = np.repeat(np.tile(np.arange(nq, dtype=np.int64), d),
+                  counts.reshape(-1))
+    live = np.arange(ent.shape[1])[None, :] < totals[:, None]
+    j, m_i, q_i = dk.decode_triples(ent[live], mq // q_m, q_m)
+    if not global_ids and d > 1:
+        j = j + np.repeat(np.arange(d, dtype=np.int64) * shard_n, totals)
+    return b, j, m_i, q_i
+
+
+def _tri_pairs(tb: np.ndarray, tj: np.ndarray):
+    """Derive the exact (query, slot) pair list from triple arrays whose
+    (b, j) runs are contiguous (true per shard block by the kernels' code
+    sort, and preserved by concatenation because shard/part pair sets are
+    disjoint).  Returns (b_idx, j_idx, p_i) with p_i mapping each triple
+    to its pair row — the shape attribution consumes."""
+    n = len(tb)
+    first = np.ones(n, bool)
+    if n:
+        first[1:] = (tb[1:] != tb[:-1]) | (tj[1:] != tj[:-1])
+    p_i = np.cumsum(first) - 1
+    return tb[first], tj[first], p_i
+
+
 @jax.jit
 def _scatter_bucket_rows(dev, idx, rows):
     """Fused dirty-bucket update for the seven bucket-entry arrays."""
@@ -245,13 +303,20 @@ class _DepsMirror:
         # invalidate, footprint growth) bumps ``version``
         self._device_sh: Optional[dk.DepsTable] = None
         self._device_sh_key = None
-        # -- bucket index (host truth) --
+        # -- bucket index (host truth); entries are (lo, hi, slot, col)
+        # where col is the interval's column in its slot row — the third
+        # leg of the exact overlap triple the kernels emit --
         self.bucket_row: Dict[int, int] = {}     # bucket id -> dense row
-        self.bucket_entries: List[List[Tuple[int, int, int]]] = []
+        self.bucket_entries: List[List[Tuple[int, int, int, int]]] = []
         self.bucket_dirty: Set[int] = set()
-        self.wide_entries: Set[Tuple[int, int, int]] = set()
-        self._bhost = None                        # 7 host row arrays
-        self._bdev = None                         # jnp 7-tuple
+        self.wide_entries: Set[Tuple[int, int, int, int]] = set()
+        # live-occupancy high-water across bucket rows (monotonic, like
+        # capacity): the kernels slice the entry axis to its pow2 — the
+        # [G, BUCKET_K] rows are ~95% padding on spread keyspaces and the
+        # candidate matrix (and kernel wall) shrinks proportionally
+        self.bucket_max_len = 0
+        self._bhost = None                        # 8 host row arrays
+        self._bdev = None                         # jnp 8-tuple
         self._bdev_pending: Set[int] = set()      # rows _bdev hasn't seen
         self._g_cap = 0
         # wide/straggler host arrays cached PER PADDED WIDTH (r08): the
@@ -289,13 +354,19 @@ class _DepsMirror:
         self._hidx_key = None
 
     # -- bucket index maintenance -------------------------------------------
-    def _bucket_add(self, slot: int, lo: int, hi: int) -> None:
+    def bucket_keff(self) -> int:
+        """Static entry-axis slice for the bucketed kernels: the pow2 of
+        the live-occupancy high-water (floor 8, cap BUCKET_K)."""
+        return min(self.BUCKET_K,
+                   _pow2_at_least(max(self.bucket_max_len, 1), 8))
+
+    def _bucket_add(self, slot: int, lo: int, hi: int, col: int) -> None:
         if self.status[slot] == dk.SLOT_INVALIDATED:
             return   # structurally excluded (de-indexed on invalidation)
         self.bucket_version += 1
         blo, bhi = lo >> self.BSHIFT, hi >> self.BSHIFT
         if bhi - blo + 1 > self.SPAN:
-            self.wide_entries.add((lo, hi, slot))
+            self.wide_entries.add((lo, hi, slot, col))
             self.wide_version += 1
             return
         for bid in range(blo, bhi + 1):
@@ -308,11 +379,13 @@ class _DepsMirror:
             ents = self.bucket_entries[row]
             if len(ents) >= self.BUCKET_K:
                 # overflow spill: the straggler list absorbs hot buckets
-                self.wide_entries.add((lo, hi, slot))
+                self.wide_entries.add((lo, hi, slot, col))
                 self.wide_version += 1
             else:
-                ents.append((lo, hi, slot))
+                ents.append((lo, hi, slot, col))
                 self.bucket_dirty.add(row)
+                if len(ents) > self.bucket_max_len:
+                    self.bucket_max_len = len(ents)
 
     def _bucket_remove(self, slot: int) -> None:
         """De-index every interval of ``slot`` (called before the row's
@@ -323,7 +396,7 @@ class _DepsMirror:
             lo, hi = int(row_lo[m]), int(row_hi[m])
             if lo > hi:
                 continue
-            ent = (lo, hi, slot)
+            ent = (lo, hi, slot, m)
             blo, bhi = lo >> self.BSHIFT, hi >> self.BSHIFT
             if bhi - blo + 1 > self.SPAN:
                 if ent in self.wide_entries:
@@ -359,17 +432,19 @@ class _DepsMirror:
         return self._sorted_bids, self._row_of_sorted
 
     def _fill_bucket_row(self, arrs, r, ents) -> None:
-        """Write one bucket's entries into the 7 host row arrays, with the
+        """Write one bucket's entries into the 8 host row arrays, with the
         immutable id/kind columns read from the mirror (entries are live,
         so the mirror columns are current for their slots)."""
-        blo, bhi, bslot, bmsb, blsb, bnode, bkind = arrs
+        blo, bhi, bslot, bcol, bmsb, blsb, bnode, bkind = arrs
         blo[r] = dk.PAD_LO
         bhi[r] = dk.PAD_HI
         bslot[r] = -1
-        for i, (lo, hi, s) in enumerate(ents):
+        bcol[r] = 0
+        for i, (lo, hi, s, col) in enumerate(ents):
             blo[r, i] = lo
             bhi[r, i] = hi
             bslot[r, i] = s
+            bcol[r, i] = col
             bmsb[r, i] = self.msb[s]
             blsb[r, i] = self.lsb[s]
             bnode[r, i] = self.node[s]
@@ -387,11 +462,12 @@ class _DepsMirror:
             blo = np.full((g_cap, k), dk.PAD_LO, np.int64)
             bhi = np.full((g_cap, k), dk.PAD_HI, np.int64)
             bslot = np.full((g_cap, k), -1, np.int32)
+            bcol = np.zeros((g_cap, k), np.int32)
             bmsb = np.zeros((g_cap, k), np.int64)
             blsb = np.zeros((g_cap, k), np.int64)
             bnode = np.zeros((g_cap, k), np.int32)
             bkind = np.zeros((g_cap, k), np.int32)
-            self._bhost = (blo, bhi, bslot, bmsb, blsb, bnode, bkind)
+            self._bhost = (blo, bhi, bslot, bcol, bmsb, blsb, bnode, bkind)
             for r, ents in enumerate(self.bucket_entries):
                 if ents:
                     self._fill_bucket_row(self._bhost, r, ents)
@@ -419,20 +495,22 @@ class _DepsMirror:
             wlo = np.full(w, dk.PAD_LO, np.int64)
             whi = np.full(w, dk.PAD_HI, np.int64)
             wslot = np.full(w, -1, np.int32)
+            wcol = np.zeros(w, np.int32)
             wmsb = np.zeros(w, np.int64)
             wlsb = np.zeros(w, np.int64)
             wnode = np.zeros(w, np.int32)
             wkind = np.zeros(w, np.int32)
-            for i, (lo, hi, s) in enumerate(self.wide_entries):
+            for i, (lo, hi, s, col) in enumerate(self.wide_entries):
                 wlo[i] = lo
                 whi[i] = hi
                 wslot[i] = s
+                wcol[i] = col
                 wmsb[i] = self.msb[s]
                 wlsb[i] = self.lsb[s]
                 wnode[i] = self.node[s]
                 wkind[i] = self.kind[s]
             hit = (self.wide_version,
-                   (wlo, whi, wslot, wmsb, wlsb, wnode, wkind))
+                   (wlo, whi, wslot, wcol, wmsb, wlsb, wnode, wkind))
             self._whost_cache[w] = hit
             if len(self._whost_cache) > 4:   # widths only grow; drop stale
                 for stale_w in sorted(self._whost_cache)[:-4]:
@@ -592,11 +670,11 @@ class _DepsMirror:
                 row_lo, row_hi = self.lo[slot], self.hi[slot]
             row_lo[used] = lo_v
             row_hi[used] = hi_v
-            used += 1
             self._dirty.add(slot)
             self.version += 1
             self.mut_version += 1
-            self._bucket_add(slot, lo_v, hi_v)
+            self._bucket_add(slot, lo_v, hi_v, used)
+            used += 1
 
     def set_status(self, slot: int, status: int) -> None:
         cur = int(self.status[slot])
@@ -1344,6 +1422,12 @@ class DeviceState:
         self.n_compacted_slots = 0
         self.n_oom_degraded = 0
         self.n_host_ticks = 0          # drain ticks swept on host fallback
+        # two-stage compacted downloads (r10): bytes actually transferred
+        # (headers + live entry prefixes) vs what the old full padded
+        # flat-buffer download would have moved — the compaction ratio on
+        # every bench ``# index:`` line
+        self.download_bytes = 0
+        self.download_bytes_padded = 0
 
     # ------------------------------------------------------------------
     # registration hooks (called from local.commands transitions)
@@ -1860,6 +1944,12 @@ class DeviceState:
     # dense scan is the better kernel anyway
     BUCKETED = True
 
+    # test knob: force the global triple-dedupe pass even for single-part
+    # exact kernels (whose CSRs are unique by construction, so the pass is
+    # skipped in production) — test_routing asserts results are
+    # byte-identical either way
+    FORCE_TRIPLE_DEDUPE = False
+
     # process-wide route calibration: {"rtt": s, "c_dev": s/elem,
     # "c_host": s/elem}, measured once by a micro-probe (or injected by
     # tests via set_route_calibration)
@@ -1868,9 +1958,11 @@ class DeviceState:
     @classmethod
     def set_route_calibration(cls, rtt: float, c_host: float,
                               c_dev: float,
-                              rtt_mesh: Optional[float] = None) -> None:
+                              rtt_mesh: Optional[float] = None,
+                              c_xfer: float = 0.0) -> None:
         cls._CALIB = {"rtt": rtt, "c_host": c_host, "c_dev": c_dev,
-                      "rtt_mesh": rtt_mesh if rtt_mesh is not None else rtt}
+                      "rtt_mesh": rtt_mesh if rtt_mesh is not None else rtt,
+                      "c_xfer": c_xfer}
 
     @staticmethod
     def _measure_route_calibration():
@@ -1896,11 +1988,13 @@ class DeviceState:
         cap, b, m = 8192, 16, 4
         table = dk.empty_table(cap, m)
         qmat = jnp.asarray(np.zeros((b, 7 + 2 * m), np.int64))
-        np.asarray(dk.calculate_deps_flat(table, qmat, m, 256, 64))
+        jax.block_until_ready(dk.calculate_deps_flat(table, qmat, m,
+                                                     256, 64))
         runs = []
         for _ in range(3):
             t0 = _time.perf_counter()
-            np.asarray(dk.calculate_deps_flat(table, qmat, m, 256, 64))
+            jax.block_until_ready(dk.calculate_deps_flat(table, qmat, m,
+                                                         256, 64))
             runs.append(_time.perf_counter() - t0)
         elems = b * cap * m * m
         c_dev = max(_st.median(runs) - rtt, 1e-9) / elems
@@ -1922,8 +2016,27 @@ class DeviceState:
         for _ in range(8):
             _ = a.copy()
         c_copy = max((_time.perf_counter() - t0) / (8 * n), 1e-12)
+        # device->host transfer cost per BYTE (the r10 prefix-fetch model:
+        # an immediate flush slices the entry buffer only when the bytes
+        # it saves cost more than the extra slice dispatch ~ one rtt; on
+        # a local CPU device bytes are ~free and the full fetch wins, on
+        # a tunneled MB/s-scale link the prefix wins from ~100KB saved)
+        # each timed conversion must see a FRESH device buffer: jax.Array
+        # caches its host copy after the first np.asarray, so re-converting
+        # one array times a cache hit (~ns) and c_xfer would collapse to
+        # the floor, pricing the prefix fetch off on exactly the tunneled
+        # link it exists for
+        mk = jax.jit(lambda i: jnp.zeros(1 << 16, jnp.int64) + i)
+        bufs = [jax.block_until_ready(mk(i)) for i in range(4)]   # 512KB ea
+        np.asarray(bufs[0])                      # warm the conversion path
+        xfers = []
+        for buf in bufs[1:]:
+            t0 = _time.perf_counter()
+            np.asarray(buf)
+            xfers.append(_time.perf_counter() - t0)
+        c_xfer = max((_st.median(xfers) - rtt) / float(8 << 16), 1e-13)
         return {"rtt": rtt, "c_dev": c_dev, "c_host": c_host,
-                "c_copy": c_copy}
+                "c_copy": c_copy, "c_xfer": c_xfer}
 
     @staticmethod
     def _measure_mesh_rtt(mesh) -> float:
@@ -2005,8 +2118,12 @@ class DeviceState:
             * self.deps.max_intervals // d
         if self.BUCKETED and \
                 len(self.deps.wide_entries) <= self.deps.WIDE_MAX:
-            buck_elems = nq * (q_m * self.deps.SPAN * self.deps.BUCKET_K
-                               + len(self.deps.wide_entries) // d)
+            # the candidate matrix is sliced to the live bucket-occupancy
+            # high-water (not BUCKET_K) and the wide list crosses every
+            # query interval (exact triples) — price what actually runs
+            buck_elems = nq * (q_m * self.deps.SPAN
+                               * self.deps.bucket_keff()
+                               + q_m * len(self.deps.wide_entries) // d)
             dev_elems = min(dense_elems, buck_elems)
         else:
             dev_elems = dense_elems
@@ -2097,36 +2214,46 @@ class DeviceState:
                 [rows, np.full(b_pad - len(rows), rows[-1], np.int64)])
             gmap = np.concatenate(
                 [rows, np.full(b_pad - len(rows), -1, np.int64)])
+            m_t = self.deps.max_intervals
             part: Dict[str, object] = {"kind": kind, "gmap": gmap,
                                        "nq": b_pad, "q_m": q_m,
+                                       "mq": m_t * q_m,
                                        "immediate": immediate}
             if kind == "sharded":
                 table = self.deps.device_table_sharded(self.mesh)
                 d = int(np.prod(list(self.mesh.shape.values())))
                 n = table.capacity
-                s = min(self._batch_flat, b_pad * (n // d))
-                k = min(self._batch_k, n // d)
+                wide = dk.wide_codes(n // d, m_t, q_m)
+                s = min(self._batch_flat, b_pad * (n // d) * m_t * q_m)
+                k = min(self._batch_k, (n // d) * m_t * q_m)
                 qmat = jnp.asarray(qnp[rows_p])
                 from ..parallel.sharded import (
                     sharded_calculate_deps_flat,
                     sharded_calculate_deps_flat_pruned)
-                if prune is not None:
-                    out_dev = sharded_calculate_deps_flat_pruned(
-                        self.mesh, q_m, s, k)(table, qmat, *prune)
-                else:
-                    out_dev = sharded_calculate_deps_flat(
-                        self.mesh, q_m, s, k)(table, qmat)
+                mesh = self.mesh
+
+                def relaunch(s2, k2, _m=mesh, _t=table, _q=qmat, _p=prune):
+                    if _p is not None:
+                        return sharded_calculate_deps_flat_pruned(
+                            _m, q_m, s2, k2, wide)(_t, _q, *_p)
+                    return sharded_calculate_deps_flat(
+                        _m, q_m, s2, k2, wide)(_t, _q)
+
                 self.n_mesh_queries += len(rows)
-                part.update(table=table, qmat=qmat, d=d, shard_n=n // d,
-                            s=s, k=k, prune=prune)
+                part.update(d=d, shard_n=n // d, s=s, k=k, wide=wide,
+                            s_cap=b_pad * (n // d) * m_t * q_m,
+                            k_cap=(n // d) * m_t * q_m)
             elif kind == "sharded_bucketed":
                 btable = self.deps.bucket_device_sharded(self.mesh)
                 d = int(np.prod(list(self.mesh.shape.values())))
                 span = self.deps.SPAN
-                # per-shard candidate ceiling: every touched bucket's K
-                # entries plus this shard's slice of the wide list
-                c = (q_m * span * self.deps.BUCKET_K
-                     + btable.wlo.shape[0] // d)
+                keff = self.deps.bucket_keff()
+                wide = dk.wide_codes(self.deps.capacity, m_t, q_m)
+                # per-shard candidate ceiling: every touched bucket's live
+                # entry slice plus this shard's slice of the wide list
+                # crossed with the query intervals (exact triples)
+                c = (q_m * span * keff
+                     + q_m * (btable.wlo.shape[0] // d))
                 s = min(self._batch_flat, b_pad * c)
                 k = min(self._batch_k, c)
                 qb = qcols[rows_p].reshape(b_pad, q_m * span)
@@ -2134,61 +2261,92 @@ class DeviceState:
                     [qnp[rows_p], qb], axis=1))
                 from ..parallel.sharded import sharded_bucketed_flat
                 pz = prune if prune is not None else _prune_zeros()
-                out_dev = sharded_bucketed_flat(
-                    self.mesh, q_m, span, s, k)(btable, qmat, *pz)
+                mesh = self.mesh
+
+                def relaunch(s2, k2, _m=mesh, _b=btable, _q=qmat, _p=pz):
+                    return sharded_bucketed_flat(
+                        _m, q_m, span, s2, k2, m_t, keff, wide)(_b, _q, *_p)
+
                 self.n_mesh_queries += len(rows)
                 self.n_mesh_bucketed_queries += len(rows)
-                part.update(btable=btable, qmat=qmat, d=d, shard_n=c,
-                            s=s, k=k, c=c, span=span, prune=prune,
-                            global_ids=True)
+                part.update(d=d, shard_n=c, s=s, k=k, c=c, wide=wide,
+                            global_ids=True, s_cap=b_pad * c, k_cap=c)
             elif kind == "dense":
                 table = self.deps.device_table()
                 n = table.capacity
-                s = min(self._batch_flat, b_pad * n)
-                k = min(self._batch_k, n)
+                wide = dk.wide_codes(n, m_t, q_m)
+                s = min(self._batch_flat, b_pad * n * m_t * q_m)
+                k = min(self._batch_k, n * m_t * q_m)
                 qmat = jnp.asarray(qnp[rows_p])
-                if prune is not None:
-                    out_dev = dk.calculate_deps_flat_pruned(
-                        table, qmat, *prune, q_m, s, k)
-                else:
-                    out_dev = dk.calculate_deps_flat(table, qmat, q_m, s, k)
+
+                def relaunch(s2, k2, _t=table, _q=qmat, _p=prune):
+                    if _p is not None:
+                        return dk.calculate_deps_flat_pruned(
+                            _t, _q, *_p, q_m, s2, k2, wide)
+                    return dk.calculate_deps_flat(_t, _q, q_m, s2, k2,
+                                                  wide)
+
                 self.n_dense_queries += len(rows)
-                part.update(table=table, qmat=qmat, d=1, shard_n=n, s=s,
-                            k=k, prune=prune)
+                part.update(d=1, shard_n=n, s=s, k=k, wide=wide,
+                            s_cap=b_pad * n * m_t * q_m,
+                            k_cap=n * m_t * q_m)
             else:   # bucketed
                 table = self.deps.device_table()
                 btable = self.deps.bucket_device()
                 span = self.deps.SPAN
-                c = (q_m * span * self.deps.BUCKET_K
-                     + btable.wlo.shape[0])
+                keff = self.deps.bucket_keff()
+                wide = dk.wide_codes(table.capacity, m_t, q_m)
+                c = (q_m * span * keff + q_m * btable.wlo.shape[0])
                 s = min(self._batch_flat, b_pad * c)
                 k = min(self._batch_k, c)
                 qb = qcols[rows_p].reshape(b_pad, q_m * span)
                 qmat = jnp.asarray(np.concatenate(
                     [qnp[rows_p], qb], axis=1))
-                if prune is not None:
-                    out_dev = dk.bucketed_flat_pruned(table, btable, qmat,
-                                                      q_m, span, s, k,
-                                                      *prune)
-                else:
-                    out_dev = dk.bucketed_flat_jit(table, btable, qmat,
-                                                   q_m, span, s, k)
+
+                def relaunch(s2, k2, _t=table, _b=btable, _q=qmat,
+                             _p=prune):
+                    if _p is not None:
+                        return dk.bucketed_flat_pruned(
+                            _t, _b, _q, q_m, span, s2, k2, *_p,
+                            keff=keff, wide=wide)
+                    return dk.bucketed_flat_jit(_t, _b, _q, q_m, span,
+                                                s2, k2, keff=keff,
+                                                wide=wide)
+
                 self.n_bucketed_queries += len(rows)
-                part.update(table=table, btable=btable, qmat=qmat, d=1,
-                            shard_n=table.capacity, s=s, k=k, c=c,
-                            span=span, prune=prune, global_ids=True)
+                part.update(d=1, shard_n=table.capacity, s=s, k=k, c=c,
+                            wide=wide, global_ids=True, s_cap=b_pad * c,
+                            k_cap=c)
+            hdr_dev, ent_dev = relaunch(s, k)
+            part["relaunch"] = relaunch
             self.n_dispatches += 1
             self._ktime("dispatch_" + kind, _t0)
-            box: Dict[str, object] = {"dev": out_dev}
+            box: Dict[str, object] = {"hdr": hdr_dev, "ent": ent_dev}
             part["box"] = box
             if not immediate:
-                # prefetch on a worker thread: np.asarray blocks on the
-                # (tunneled) transfer with the GIL released, so a pipelined
-                # caller attributes batch i while batch i+1 computes AND
-                # downloads
+                # two-stage prefetch on a worker thread: the header join
+                # blocks on the kernel (GIL released), then ONLY the live
+                # entry prefix crosses the wire — a pipelined caller
+                # attributes batch i while batch i+1 computes AND
+                # downloads.  No faults.check here: injection draws stay
+                # on the deterministic store-task thread (_collect_part
+                # re-checks before consuming each stage)
+                d_, nq_, s_, k_ = part["d"], b_pad, s, k
+
                 def _fetch():
+                    import time as _time
                     try:
-                        box["out"] = np.asarray(out_dev)
+                        t0 = _time.perf_counter()
+                        hdr = np.asarray(hdr_dev).reshape(d_, 2 + nq_)
+                        box["hdr_np"] = hdr
+                        box["t_hdr"] = (t0, _time.perf_counter())
+                        if int(hdr[:, 0].max()) > s_ \
+                                or int(hdr[:, 1].max()) > k_:
+                            return    # overflowed: collector re-runs
+                        t1 = _time.perf_counter()
+                        box["ent_np"] = _fetch_entry_prefix(
+                            ent_dev, d_, s_, int(hdr[:, 0].max()))
+                        box["t_ent"] = (t1, _time.perf_counter())
                     except BaseException as e:     # surfaced after join
                         box["err"] = e
 
@@ -2323,7 +2481,15 @@ class DeviceState:
 
     def _ktime(self, kind: str, t0: float) -> None:
         import time as _time
-        t1 = _time.perf_counter()
+        self._ktime_span(kind, t0, _time.perf_counter())
+
+    def _ktime_span(self, kind: str, t0: float, t1: float) -> None:
+        """One finished launch-boundary slice with explicit endpoints —
+        the two-stage downloads measure their header/entry fetches where
+        they actually happened (possibly on the prefetch thread) and
+        report them here (dispatch_* = host pack + upload + enqueue,
+        wait_header_* = header join, wait_entries_* = entry-prefix
+        transfer, host_* = host passes)."""
         cell = self.kernel_times.get(kind)
         if cell is None:
             cell = self.kernel_times[kind] = [0, 0.0]
@@ -2331,144 +2497,149 @@ class DeviceState:
         cell[1] += t1 - t0
         prof = devprof.PROFILER
         if prof is not None:
-            # every launch boundary already timed here (dispatch_* = host
-            # pack + upload + enqueue, wait_* = download join, host_* =
-            # host passes) becomes a Chrome-trace slice: pid = node,
-            # tid = store — the launch timeline, not just a counter
+            # every launch boundary timed here becomes a Chrome-trace
+            # slice: pid = node, tid = store — the launch timeline, not
+            # just a counter
             prof.complete(
                 kind, t0, t1,
                 pid=getattr(getattr(self.store, "node", None),
                             "node_id", 0) or 0,
                 tid=getattr(self.store, "store_id", 0) or 0)
 
+    def _overflow_resize(self, total: int, maxc: int, s: int, k: int,
+                         s_cap: int, k_cap: int, runs: int):
+        """ONE overflow re-sizing policy for the solo and fused re-run
+        loops: size the flat capacity to the exact observed total (+25%
+        headroom, 16k granularity) and the row width with 2x headroom
+        (every distinct (s, k) is a fresh jit compilation; a mid-run
+        recompile costs seconds on TPU); after the first re-run escalate
+        geometrically — a truncated-past-k dense row under-counts its
+        triples in the header (flat_csr_local docstring) — so the loop
+        terminates at the caps; sticky-learn the result so subsequent
+        batches dispatch right-sized."""
+        s2 = -(-int(total * 1.25) // 16384) * 16384
+        k2 = _pow2_at_least(2 * maxc)
+        if runs:
+            s2, k2 = max(s2, 2 * s), max(k2, 2 * k)
+        s = min(max(s2, s), s_cap)
+        k = min(max(k2, k), k_cap)
+        self._batch_flat = max(self._batch_flat, s)
+        self._batch_k = max(self._batch_k, k)
+        return s, k
+
+    def _prefix_pays(self, d: int, s: int, maxtot: int,
+                     itemsize: int) -> bool:
+        """Stage-2 transfer model for a SYNCHRONOUS fetch: slicing the
+        live prefix costs one extra device dispatch (~an rtt) and saves
+        the padded tail's bytes — a model over the calibrated per-byte
+        transfer cost, not a threshold.  On a local CPU device bytes are
+        ~free and the single full fetch wins; on a tunneled MB/s link the
+        prefix wins from ~100KB of tail."""
+        saved = d * (s - _prefix_len(maxtot, s)) * itemsize
+        if saved <= 0:
+            return False
+        calib = self._calibration()
+        return saved * calib.get("c_xfer", 0.0) > calib["rtt"]
+
     def _collect_part(self, part):
-        """Download + parse one kernel part; re-run once when the learned
-        flat capacity overflowed.  Returns (global b_idx, j_idx)."""
+        """Two-stage download + decode of one kernel part's exact CSR.
+        Stage 1 fetches the scalar header (totals / max row width /
+        row_end) — a few hundred int32s whose join also absorbs the kernel
+        wait; stage 2 transfers ONLY the live prefix of the entry buffer.
+        When the learned flat capacity or row width overflowed, the re-run
+        is sized from the exact header already downloaded and rides the
+        same compacted transfer — the full pow2-padded buffer is never
+        materialized on the host.  Returns per-triple (b, j, m, q) global
+        arrays (codes decoded, pad rows dropped)."""
         import time as _time
-        _t0 = _time.perf_counter()
         box = part["box"]
         th = part.get("th")
-        nq = part["nq"]
-        d = part["d"]
-        shard_n = part["shard_n"]
+        nq, d = part["nq"], part["d"]
         s, k = part["s"], part["k"]
-
-        def parse(out, s, k):
-            """Per-shard blocks (total, maxc, row_end[B], entries[s]); slot
-            indices are shard-local for the slot-sharded kernels (offset by
-            the shard's slice) and GLOBAL for the bucket-indexed kernels
-            (entries embed global slot ids)."""
-            blocks = out.reshape(d, 2 + nq + s)
-            if int(blocks[:, 0].max()) > s or int(blocks[:, 1].max()) > k:
-                return None
-            bs, js = [], []
-            for i in range(d):
-                total = int(blocks[i, 0])
-                row_end = blocks[i, 2:2 + nq].astype(np.int64)
-                counts = np.diff(row_end, prepend=0)
-                bs.append(np.repeat(np.arange(nq), counts))
-                js.append(blocks[i, 2 + nq:2 + nq + total].astype(np.int64)
-                          + (0 if part.get("global_ids")
-                             else i * shard_n))
-            return np.concatenate(bs), np.concatenate(js)
-
-        faults.check("transfer", "result download")
+        itemsize = 8 if part["wide"] else 4
+        faults.check("transfer", "header download")
+        _t0 = _time.perf_counter()
         if th is not None:
             th.join()
             err = box.get("err")
             if err is not None:
                 raise err           # the real device/transfer failure
-            out = box["out"]
+            hdr = box["hdr_np"]
+            t_h = box.get("t_hdr")
         else:
-            out = np.asarray(box["dev"])
-        parsed = parse(out, s, k)
-        if parsed is None:
-            # size the flat capacity to the observed total (+25% headroom,
-            # 16k granularity) — pow2 rounding doubled the download
-            blocks = out.reshape(d, 2 + nq + s)
-            total = int(blocks[:, 0].max())
-            s = min(-(-int(total * 1.25) // 16384) * 16384, nq * shard_n)
-            self._batch_flat = max(self._batch_flat, s)
-            q_m = part["q_m"]
-            # escalate k with 2x headroom: every distinct k is a fresh jit
-            # compilation, and a mid-run recompile costs seconds on TPU
-            if part["kind"] == "sharded":
-                k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
-                        shard_n)
-                self._batch_k = max(self._batch_k, k)
-                from ..parallel.sharded import (
-                    sharded_calculate_deps_flat,
-                    sharded_calculate_deps_flat_pruned)
-                pr = part["prune"]
-                if pr is not None:
-                    out = np.asarray(sharded_calculate_deps_flat_pruned(
-                        self.mesh, q_m, s, k)(part["table"], part["qmat"],
-                                              *pr))
-                else:
-                    out = np.asarray(sharded_calculate_deps_flat(
-                        self.mesh, q_m, s, k)(part["table"], part["qmat"]))
-            elif part["kind"] == "sharded_bucketed":
-                k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
-                        part["c"])
-                self._batch_k = max(self._batch_k, k)
-                from ..parallel.sharded import sharded_bucketed_flat
-                pr = part["prune"]
-                pz = pr if pr is not None else _prune_zeros()
-                out = np.asarray(sharded_bucketed_flat(
-                    self.mesh, q_m, part["span"], s, k)(part["btable"],
-                                                        part["qmat"], *pz))
-            elif part["kind"] == "dense":
-                k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
-                        shard_n)
-                self._batch_k = max(self._batch_k, k)
-                pr = part["prune"]
-                if pr is not None:
-                    out = np.asarray(dk.calculate_deps_flat_pruned(
-                        part["table"], part["qmat"], *pr, q_m, s, k))
-                else:
-                    out = np.asarray(dk.calculate_deps_flat(
-                        part["table"], part["qmat"], q_m, s, k))
+            hdr = np.asarray(box["hdr"]).reshape(d, 2 + nq)
+            t_h = None
+        self._ktime_span("wait_header_" + part["kind"],
+                         *(t_h or (_t0, _time.perf_counter())))
+        self.download_bytes += hdr.nbytes
+        self.download_bytes_padded += hdr.nbytes + d * s * itemsize
+        runs = 0
+        while int(hdr[:, 0].max()) > s or int(hdr[:, 1].max()) > k:
+            # overflow: re-size from the exact header (shared policy,
+            # _overflow_resize), then re-dispatch against the same
+            # snapshot tables via the part's relaunch closure —
+            # registrations interleaved between begin and end must not
+            # shift the queried snapshot
+            s, k = self._overflow_resize(
+                int(hdr[:, 0].max()), int(hdr[:, 1].max()), s, k,
+                part["s_cap"], part["k_cap"], runs)
+            dk.launch_check(part["kind"])
+            hdr_dev, ent_dev = part["relaunch"](s, k)
+            box = {"hdr": hdr_dev, "ent": ent_dev}
+            th = None
+            faults.check("transfer", "header download")
+            _t0 = _time.perf_counter()
+            hdr = np.asarray(hdr_dev).reshape(d, 2 + nq)
+            self._ktime("wait_header_" + part["kind"], _t0)
+            self.download_bytes += hdr.nbytes
+            self.download_bytes_padded += hdr.nbytes + d * s * itemsize
+            runs += 1
+        faults.check("transfer", "entry download")
+        _t1 = _time.perf_counter()
+        if th is not None and "ent_np" in box:
+            ent = box["ent_np"]
+            t_e = box.get("t_ent")
+        else:
+            # synchronous fetch (immediate flush or post-overflow): slice
+            # the live prefix only when the modeled byte saving beats the
+            # extra slice dispatch — on the pipelined path the prefix
+            # fetch rides the prefetch thread and overlaps compute, so it
+            # never asks
+            maxtot = int(hdr[:, 0].max())
+            if self._prefix_pays(d, s, maxtot, itemsize):
+                ent = _fetch_entry_prefix(box["ent"], d, s, maxtot)
             else:
-                k = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
-                        part["c"])
-                self._batch_k = max(self._batch_k, k)
-                pr = part["prune"]
-                if pr is not None:
-                    out = np.asarray(dk.bucketed_flat_pruned(
-                        part["table"], part["btable"], part["qmat"], q_m,
-                        part["span"], s, k, *pr))
-                else:
-                    out = np.asarray(dk.bucketed_flat_jit(
-                        part["table"], part["btable"], part["qmat"], q_m,
-                        part["span"], s, k))
-            parsed = parse(out, s, k)
-        b_local, j_idx = parsed
+                ent = np.asarray(box["ent"]).reshape(d, s)
+            t_e = None
+        self._ktime_span("wait_entries_" + part["kind"],
+                         *(t_e or (_t1, _time.perf_counter())))
+        self.download_bytes += ent.nbytes
+        tb, tj, tm, tq = _decode_triples(hdr, ent, nq, part["shard_n"],
+                                         bool(part.get("global_ids")),
+                                         part["mq"], part["q_m"])
         # stale/corrupted-result injection: perturb the slot indices the
         # kernel answered with.  Only where the detector actually runs —
         # paranoia shadow-verify on an IMMEDIATE flush (the protocol path);
         # injecting silent corruption with no detector would just be
         # breaking the program, not testing it.
-        if part.get("immediate") and self._paranoid() and len(j_idx) \
+        if part.get("immediate") and self._paranoid() and len(tj) \
                 and faults.should_fire("stale_result"):
-            j_idx = (j_idx + np.int64(1)) % np.int64(self.deps.capacity)
-        self._ktime("wait_" + part["kind"], _t0)
+            tj = (tj + np.int64(1)) % np.int64(self.deps.capacity)
         gmap = part["gmap"]
-        b_global = gmap[b_local]
+        b_global = gmap[tb]
         keep = b_global >= 0                      # drop pad rows
-        return b_global[keep], j_idx[keep]
+        return b_global[keep], tj[keep], tm[keep], tq[keep]
 
     def _batch_collect(self, handle):
-        """Collect a dispatched batch: one sparse download per part (plus a
-        re-run when the learned flat capacity overflowed), then the
-        host-side EXACT geometry pass over the coarse pairs — the kernel's
-        bounding-box mask admits a query sitting inside a slot's interval
-        gap; the vectorized overlap here drops those and hands the
-        surviving (pair, dep-interval, query-interval) emit triples to
-        attribution.  The host route skips the geometry entirely: its
-        probes are exact, so its pairs and triples arrive precomputed.
-        Re-runs use the table snapshot captured at begin — registrations
-        interleaved between begin and end must not shift the queried
-        snapshot.
+        """Collect a dispatched batch: one two-stage compacted download per
+        part (plus an exact-header-sized re-run on overflow), then a pure
+        DECODE — the kernels answer with exact overlap triples, so no
+        false-positive pair exists to re-filter and the old host geometry
+        pass (``_exact_geometry``) has nothing to do on any device route.
+        The host route's probes were always exact, so its pairs and
+        triples arrive precomputed either way.  Re-runs use the table
+        snapshot captured at begin — registrations interleaved between
+        begin and end must not shift the queried snapshot.
 
         Device-boundary failures here (transfer/download, injected or real)
         quarantine the device routes and fail the flush over to the host
@@ -2493,25 +2664,25 @@ class DeviceState:
             self._device_fault(e, f"collect: {e}")
             return self._host_fallback_collect(handle)
         _tg = _time.perf_counter()
-        b_idx = np.concatenate([o[0] for o in outs]) if outs else \
-            np.zeros(0, np.int64)
-        j_idx = np.concatenate([o[1] for o in outs]) if outs else \
-            np.zeros(0, np.int64)
-        # global (query, slot) dedupe: the in-kernel dedupe is per-part
-        # only — under the row-sharded bucket index one slot can surface
-        # from several shards.  np.unique's sorted order (b-major, slot
-        # ascending) matches the per-part CSR order, so results are
-        # byte-identical with or without this pass; it is skipped when a
-        # single already-unique part answered the batch (slot-sharded CSRs
-        # are unique by construction)
-        if len(j_idx) and (len(parts) > 1
-                           or parts[0]["kind"] == "sharded_bucketed"):
-            cap = np.int64(len(ids[0]))
-            pair = np.unique(b_idx * cap + j_idx)
-            b_idx, j_idx = pair // cap, pair % cap
-        # exact geometry on the sparse pair list
-        b_idx, j_idx, (p_i, m_i, q_i) = self._exact_geometry(
-            b_idx, j_idx, ivs, qnp, q_m)
+        if len(outs) == 1:
+            tb, tj, tm, tq = outs[0]
+        else:
+            tb = np.concatenate([o[0] for o in outs])
+            tj = np.concatenate([o[1] for o in outs])
+            tm = np.concatenate([o[2] for o in outs])
+            tq = np.concatenate([o[3] for o in outs])
+        # global triple dedupe: the in-kernel dedupe is per-part only —
+        # under the row-sharded bucket index one triple can surface from
+        # several shards.  The (b-major, code-ascending) dedupe order
+        # matches the per-part CSR order, so results are byte-identical
+        # with or without this pass; single-part exact kernels skip it
+        # (slot-sharded and single-device CSRs are unique by construction)
+        if len(tj) and (self.FORCE_TRIPLE_DEDUPE or len(parts) > 1
+                        or parts[0]["kind"] == "sharded_bucketed"):
+            order, first = _group_dedupe((tq, tm, tj, tb))
+            order = order[first]
+            tb, tj, tm, tq = tb[order], tj[order], tm[order], tq[order]
+        b_idx, j_idx, p_i = _tri_pairs(tb, tj)
         if self._paranoid() and fmeta["immediate"]:
             # shadow-verify: the exact (query, slot) pair set must match
             # the host route's byte-for-byte; a mismatch means the device
@@ -2533,15 +2704,19 @@ class DeviceState:
             self._restore_device()   # the probe flush succeeded end-to-end
         self.n_queries += nq
         self.n_kernel_deps += len(j_idx)
-        self._ktime("host_geometry", _tg)
-        return b_idx, j_idx, (p_i, m_i, q_i), ids, ivs, qnp, queries
+        self._ktime("host_decode", _tg)
+        return b_idx, j_idx, (p_i, tm, tq), ids, ivs, qnp, queries
 
     def _exact_geometry(self, b_idx, j_idx, ivs, qnp, q_m):
-        """The host-side EXACT geometry pass over a coarse (query, slot)
-        pair list: the kernel's bounding-box mask admits a query sitting
-        inside a slot's interval gap; the vectorized overlap here drops
-        those and yields the surviving (pair, dep-interval, query-interval)
-        emit triples — shared by the solo collect and the fused harvest."""
+        """REFERENCE implementation of the exact overlap geometry over a
+        (query, slot) pair list, yielding the (pair, dep-interval,
+        query-interval) emit triples.  r10 pushed this into every device
+        kernel (the CSR entries ARE the triples, as sorted composite
+        codes), so no production route calls it anymore — it remains as
+        the oracle the exact-kernel property tests compare against
+        (tests/test_exact_collect.py) and as the executable spec of the
+        emit-triple order (np.nonzero over [P, M, Q] = pair-major,
+        dep-column, query-column — exactly the kernels' code sort)."""
         lo, hi, _dom = ivs
         lo_p, hi_p = lo[j_idx], hi[j_idx]                       # [P, M]
         used = lo_p <= hi_p
@@ -2634,8 +2809,8 @@ class DeviceState:
         if route != "dense" and not degenerate:
             # the adaptive solo dispatch would probe the bucket index for
             # narrow queries — price solo with the cheaper kernel
-            buck = b_pad * (q_m * self.deps.SPAN * self.deps.BUCKET_K
-                            + len(self.deps.wide_entries) // d)
+            buck = b_pad * (q_m * self.deps.SPAN * self.deps.bucket_keff()
+                            + q_m * len(self.deps.wide_entries) // d)
             solo_elems = min(solo_elems, buck)
         # snapshot cost the fused pricing charges: zero when the cached
         # copy is still fresh, one full-column memcpy's worth otherwise
@@ -2695,12 +2870,12 @@ class DeviceState:
                 hint["ivs"][1])
 
     def _fused_collect(self, hint, launch):
-        """Download + parse this store's block of the fused CSR, with the
-        solo path's full semantics: overflow re-run (solo, escalated s/k,
-        same snapshot table), stale-result injection point, exact
-        geometry, paranoia shadow-verify against the SNAPSHOT host scan,
-        probe restore, and whole-batch host failover on any
-        device-boundary failure."""
+        """Two-stage download + decode of this store's block of the fused
+        exact CSR, with the solo path's full semantics: overflow re-run
+        (solo, escalated s/k from the exact header, same snapshot table,
+        compacted transfer), stale-result injection point, paranoia
+        shadow-verify against the SNAPSHOT host scan, probe restore, and
+        whole-batch host failover on any device-boundary failure."""
         import time as _time
         _t0 = _time.perf_counter()
         nq = hint["nq"]
@@ -2711,52 +2886,53 @@ class DeviceState:
         qnp, q_m = hint["qnp"], hint["q_m"]
         d, shard_n = hint["d"], hint["shard_n"]
         b_pad = hint["b_pad_c"]
-
-        def parse(buf, s_, k_):
-            blocks = buf.reshape(d, 2 + b_pad + s_)
-            if int(blocks[:, 0].max()) > s_ or int(blocks[:, 1].max()) > k_:
-                return None
-            bs, js = [], []
-            for i in range(d):
-                total = int(blocks[i, 0])
-                row_end = blocks[i, 2:2 + b_pad].astype(np.int64)
-                counts = np.diff(row_end, prepend=0)
-                bs.append(np.repeat(np.arange(b_pad), counts))
-                js.append(blocks[i, 2 + b_pad:2 + b_pad + total]
-                          .astype(np.int64) + i * shard_n)
-            return np.concatenate(bs), np.concatenate(js)
-
+        mq, qmc = hint["mq"], hint["q_m_c"]
         try:
-            out = launch.materialize()
-            row = np.asarray(out[hint["row"]])
-            parsed = parse(row, launch.s, launch.k)
-            if parsed is None:
+            hdr_all, ent_all = launch.materialize()
+            hdr = hdr_all[hint["row"]].reshape(d, 2 + b_pad)
+            ent = ent_all[hint["row"]]
+            s_, k_ = launch.s, launch.k
+            runs = 0
+            while int(hdr[:, 0].max()) > s_ or int(hdr[:, 1].max()) > k_:
                 # overflow: escalate EXACTLY like the solo path — re-run
-                # this store alone against the same cached table with the
-                # learned flat capacity / row width
-                blocks = row.reshape(d, 2 + b_pad + launch.s)
-                total = int(blocks[:, 0].max())
-                s2 = min(-(-int(total * 1.25) // 16384) * 16384,
-                         b_pad * shard_n)
-                self._batch_flat = max(self._batch_flat, s2)
-                k2 = min(_pow2_at_least(2 * int(blocks[:, 1].max())),
-                         shard_n)
-                self._batch_k = max(self._batch_k, k2)
+                # this store alone against the same cached table, sized
+                # from the exact header, and fetch the re-run compacted
+                cap_k = shard_n * hint["m_iv"] * qmc
+                s_, k_ = self._overflow_resize(
+                    int(hdr[:, 0].max()), int(hdr[:, 1].max()), s_, k_,
+                    b_pad * cap_k, cap_k, runs)
                 qmat = jnp.asarray(hint["qmat_np"])
                 pnp = hint["prune"]
                 pz = _prune_zeros() if pnp is None else \
                     (jnp.asarray(pnp[0]), jnp.asarray(pnp[1]),
                      jnp.asarray(pnp[2]))
-                qmc = hint["q_m_c"]
+                wide = hint["wide"]
                 if self.mesh is not None:
                     from ..parallel.sharded import \
                         sharded_calculate_deps_flat_pruned
-                    out2 = np.asarray(sharded_calculate_deps_flat_pruned(
-                        self.mesh, qmc, s2, k2)(hint["table"], qmat, *pz))
+                    hdr_dev, ent_dev = sharded_calculate_deps_flat_pruned(
+                        self.mesh, qmc, s_, k_, wide)(hint["table"], qmat,
+                                                      *pz)
                 else:
-                    out2 = np.asarray(dk.calculate_deps_flat_pruned(
-                        hint["table"], qmat, *pz, qmc, s2, k2))
-                parsed = parse(out2, s2, k2)
+                    hdr_dev, ent_dev = dk.calculate_deps_flat_pruned(
+                        hint["table"], qmat, *pz, qmc, s_, k_, wide)
+                faults.check("transfer", "header download")
+                hdr = np.asarray(hdr_dev).reshape(d, 2 + b_pad)
+                itemsize = 8 if wide else 4
+                self.download_bytes += hdr.nbytes
+                self.download_bytes_padded += hdr.nbytes + d * s_ * itemsize
+                if int(hdr[:, 0].max()) <= s_ and int(hdr[:, 1].max()) <= k_:
+                    faults.check("transfer", "entry download")
+                    ent = _fetch_entry_prefix(ent_dev, d, s_,
+                                              int(hdr[:, 0].max()))
+                    self.download_bytes += ent.nbytes
+                runs += 1
+            if runs:
+                # the re-run scanned the store's OWN table, so its codes
+                # scale on the store's interval width, not the group's
+                mq = hint["m_iv"] * qmc
+            if ent.ndim == 1:
+                ent = ent.reshape(d, -1)
         except faults.DEVICE_EXCEPTIONS as e:
             # whole-batch failover: quarantine every member, serve this
             # flush from the SNAPSHOT host scan (begin-time bytes)
@@ -2766,15 +2942,17 @@ class DeviceState:
             self.n_dispatches += 1
             return self.deps.host_pairs(qnp, q_m, hint["floor_id"],
                                         snapshot=self._fused_snapshot(hint))
-        b_local, j_idx = parsed
-        if self._paranoid() and len(j_idx) \
+        tb, tj, tm, tq = _decode_triples(hdr, ent, b_pad, shard_n,
+                                         False, mq, qmc)
+        if self._paranoid() and len(tj) \
                 and faults.should_fire("stale_result"):
-            j_idx = (j_idx + np.int64(1)) % np.int64(len(hint["ids"][0]))
+            tj = (tj + np.int64(1)) % np.int64(len(hint["ids"][0]))
         gmap = hint["gmap"]
-        b_global = gmap[b_local]
+        b_global = gmap[tb]
         keep = b_global >= 0
-        b_idx, j_idx, pmq = self._exact_geometry(
-            b_global[keep], j_idx[keep], hint["ivs"], qnp, q_m)
+        tb, tj, tm, tq = b_global[keep], tj[keep], tm[keep], tq[keep]
+        b_idx, j_idx, p_i = _tri_pairs(tb, tj)
+        pmq = (p_i, tm, tq)
         if self._paranoid():
             self.n_shadow_checks += 1
             b_h, j_h, pmq_h = self.deps.host_pairs(

@@ -80,17 +80,23 @@ def _profiled_harvest(name, dev0, members, download):
 
 
 class FusedFlushLaunch:
-    """One in-flight fused deps launch: the shared device buffer plus the
+    """One in-flight fused deps launch: the shared device buffers plus the
     member hints.  The download happens at the FIRST member's harvest
-    (faults.check rides it — one transfer crossing per fused launch);
-    any device-boundary failure poisons the whole batch: every member
-    quarantines and serves its flush from the snapshot host scan."""
+    (faults.check rides it — one transfer crossing per fused launch) and
+    is TWO-STAGE like the solo path: the stacked scalar headers first,
+    then one slice carrying only the live prefix of every member's entry
+    block; any device-boundary failure poisons the whole batch: every
+    member quarantines and serves its flush from the snapshot host scan."""
 
-    def __init__(self, dev_out, hints, s: int, k: int):
-        self.dev = dev_out
+    def __init__(self, dev_out, hints, s: int, k: int, d: int, b_pad: int,
+                 wide: bool):
+        self.hdr_dev, self.ent_dev = dev_out
         self.hints = hints
         self.s = s
         self.k = k
+        self.d = d
+        self.b_pad = b_pad
+        self.wide = wide
         self._out = None
         self.failed: Optional[BaseException] = None
 
@@ -98,11 +104,28 @@ class FusedFlushLaunch:
         if self.failed is not None:
             raise self.failed
         if self._out is None:
-            faults.check("transfer", "fused result download")
-            # ONE download serves every member (first harvester pays it)
-            self._out = _profiled_harvest(
-                "fused_flush_harvest", self.hints[0]["dev"],
-                len(self.hints), lambda: np.asarray(self.dev))
+            from .device_index import _prefix_len
+            n_s = len(self.hints)
+            itemsize = 8 if self.wide else 4
+            dev0 = self.hints[0]["dev"]
+            faults.check("transfer", "fused header download")
+            hdr = _profiled_harvest(
+                "fused_flush_harvest_header", dev0,
+                n_s, lambda: np.asarray(self.hdr_dev))
+            hdr = hdr.reshape(n_s, self.d, 2 + self.b_pad)
+            maxtot = min(int(hdr[:, :, 0].max()), self.s)
+            length = _prefix_len(maxtot, self.s)
+            faults.check("transfer", "fused entry download")
+            ent3 = self.ent_dev.reshape(n_s, self.d, self.s)[:, :, :length]
+            ent = _profiled_harvest(
+                "fused_flush_harvest_entries", dev0,
+                n_s, lambda: np.asarray(ent3))
+            # byte accounting lands on the first harvester (deterministic:
+            # harvest order is store-id order)
+            dev0.download_bytes += hdr.nbytes + ent.nbytes
+            dev0.download_bytes_padded += \
+                hdr.nbytes + n_s * self.d * self.s * itemsize
+            self._out = (hdr, ent)
         return self._out
 
     def poison(self, exc: BaseException) -> None:
@@ -270,14 +293,20 @@ class DeviceDispatcher:
         d = 1 if mesh is None else max(len(mesh.devices.flat), 1)
         q_m = max(h["q_m"] for h in hints)
         b_pad = _pow2_at_least(max(h["b_pad"] for h in hints), 1)
-        s = max(min(dev._batch_flat, b_pad * (h["cap"] // d))
+        s = max(min(dev._batch_flat, b_pad * (h["cap"] // d)
+                    * h["m_iv"] * q_m)
                 for dev, h in zip(devs, hints))
-        k = max(min(dev._batch_k, h["cap"] // d)
+        k = max(min(dev._batch_k, (h["cap"] // d) * h["m_iv"] * q_m)
                 for dev, h in zip(devs, hints))
         qmats = np.empty((len(hints), b_pad, 7 + 2 * q_m), np.int64)
         pm = np.zeros(len(hints), np.int64)
         pl = np.zeros(len(hints), np.int64)
         pn = np.zeros(len(hints), np.int32)
+        m_max = max(h["m_iv"] for h in hints)
+        # the fused trace pads every table to the group's interval width,
+        # so codes scale on m_max; the entry dtype must hold the WIDEST
+        # member's codes
+        wide = any(dk.wide_codes(h["cap"] // d, m_max, q_m) for h in hints)
         for i, h in enumerate(hints):
             qnp, qmi, nq = h["qnp"], h["q_m"], h["nq"]
             rows_p = np.minimum(np.arange(b_pad), nq - 1)
@@ -295,6 +324,9 @@ class DeviceDispatcher:
             h["shard_n"] = h["cap"] // d
             h["b_pad_c"] = b_pad
             h["q_m_c"] = q_m
+            h["m_max"] = m_max
+            h["mq"] = m_max * q_m
+            h["wide"] = wide
             h["qmat_np"] = qmats[i]
         # commit first (probe bookkeeping, mirror snapshots, route
         # observation): a launch fault below must still find the begin-time
@@ -309,12 +341,13 @@ class DeviceDispatcher:
             import jax.numpy as jnp
             if mesh is not None:
                 from ..parallel.sharded import sharded_fused_flat
-                out = sharded_fused_flat(mesh, len(hints), q_m, s, k)(
+                out = sharded_fused_flat(mesh, len(hints), q_m, s, k,
+                                         wide)(
                     *tables, jnp.asarray(qmats), jnp.asarray(pm),
                     jnp.asarray(pl), jnp.asarray(pn))
             else:
                 out = dk.fused_flat_csr(tables, qmats, (pm, pl, pn),
-                                        q_m, s, k)
+                                        q_m, s, k, wide)
         except faults.DEVICE_EXCEPTIONS as e:
             # a device fault inside the fused launch fails the WHOLE batch
             # over to the host route, then quarantines per-store as solo
@@ -335,7 +368,7 @@ class DeviceDispatcher:
         if self.on_fused is not None:
             self.on_fused("flush", len(hints),
                           sum(h["nq"] for h in hints))
-        return FusedFlushLaunch(out, hints, s, k)
+        return FusedFlushLaunch(out, hints, s, k, d, b_pad, wide)
 
     # -- tick side ----------------------------------------------------------
     def register_tick(self, dev) -> None:

@@ -245,6 +245,11 @@ INDEX_COUNTERS: List[Tuple[str, str]] = [
     ("fallback_queries", "n_fallback_queries"),
     ("shadow_mismatches", "n_shadow_mismatches"),
     ("compactions", "n_compactions"),
+    # r10 two-stage compacted downloads: bytes actually transferred
+    # (headers + live entry prefixes) vs the full pow2-padded buffers the
+    # pre-r10 collect downloaded — the compaction ratio in every artifact
+    ("download_bytes", "download_bytes"),
+    ("download_bytes_padded", "download_bytes_padded"),
 ]
 
 

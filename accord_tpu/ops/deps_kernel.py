@@ -29,6 +29,24 @@ local/MaxConflicts.java:32).  Everything is elementwise compares + reduces
 over a [B, N, M, M] broadcast — embarrassingly parallel, static shapes,
 fuses to a handful of VPU loops under jit.  B and N are padded to lane
 multiples by the host packer.
+
+Exact-geometry CSR (r10): the batched flat kernels no longer answer with
+coarse (query, slot) pairs the host re-filters — every entry that leaves
+the device is an exact overlap TRIPLE, encoded as one sorted composite
+integer key::
+
+    code = slot * (M_t * Q) + dep_interval_col * Q + query_interval_col
+
+where ``M_t`` is the table's interval width and ``Q`` the query's.  Codes
+ascend (slot-major, then dep column, then query column) within each CSR
+row, which is exactly the (pair, m, q) order the host's old
+``np.nonzero(overlap)`` geometry pass produced — so the device answer
+plugs straight into attribution and ``_exact_geometry`` has nothing left
+to do on any device route.  The result ships as TWO buffers, ``(header,
+entries)``: the header (total, max_row_count, row_end[B]) is a few hundred
+int32s the host fetches first; only the LIVE PREFIX of the entry buffer
+crosses the wire after it (int32 entries whenever
+``capacity * M_t * Q <= INT32_CODE_MAX``, int64 past that crossover).
 """
 
 from __future__ import annotations
@@ -56,6 +74,29 @@ def launch_check(what: str = "") -> None:
 
 PAD_LO = np.int64(np.iinfo(np.int64).max)   # empty interval: lo > hi
 PAD_HI = np.int64(np.iinfo(np.int64).min)
+
+# widest triple code an int32 entry buffer can carry; codes are
+# slot * M_t * Q + col * Q + q, so the crossover is capacity * M_t * Q.
+# Module attribute (not inlined) so the int64 crossover is testable on
+# tables that fit in memory — tests lower it and assert both widths agree.
+INT32_CODE_MAX = 2**31 - 1
+
+
+def wide_codes(capacity: int, m_t: int, q_m: int) -> bool:
+    """True when triple codes for this (table, query) shape need int64
+    entries.  Callers thread the result into the kernels as a STATIC
+    argument (the dtype is part of the traced program, and the jit cache
+    key must see it)."""
+    return capacity * m_t * q_m > INT32_CODE_MAX
+
+
+def _code_dtype(wide: bool):
+    return jnp.int64 if wide else jnp.int32
+
+
+def _code_sentinel(wide: bool):
+    return (np.int64(np.iinfo(np.int64).max) if wide
+            else np.int32(np.iinfo(np.int32).max))
 
 # slot liveness/status codes (device view of CommandsForKey.InternalStatus)
 SLOT_FREE = -1
@@ -227,20 +268,21 @@ def calculate_deps_indices_fused(table: DepsTable, qmat: jnp.ndarray,
     return jnp.concatenate([counts[:, None], idx], axis=1)
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4))
+@partial(jax.jit, static_argnames=("m", "s", "k", "wide"))
 def calculate_deps_flat(table: DepsTable, qmat: jnp.ndarray,
-                        m: int, s: int, k: int) -> jnp.ndarray:
-    """The tunnel-optimal batched scan: the EXACT dep mask compacted into a
-    packed CSR on device, so the download is the sparse result alone.
+                        m: int, s: int, k: int, wide: bool = False):
+    """The tunnel-optimal batched scan: the EXACT dep-triple set compacted
+    into a packed CSR on device, so the download is the sparse result alone
+    — and a two-stage one: ``(header, entries)``, where the host fetches
+    the tiny header first and then only the live entry prefix.
 
     On a tunneled accelerator the wire dominates: the dense [B, 1+k]
     compaction ships megabytes at megabytes-per-second while the true dep
-    sets are tens of entries per query.  Here the per-row top-k indices
-    (memory-safe: fuses into the mask computation) are scattered into one
-    CSR — header (total, max row count), row_end[B], entries[s] — ~100KB
-    for a 2048-query batch.
+    sets are tens of entries per query.  Entries are the sorted composite
+    overlap codes (module docstring) — no false-positive pair and no
+    host-side geometry pass remain.
     """
-    return flat_csr_local(table, qmat, m, s, k)
+    return flat_csr_local(table, qmat, m, s, k, wide=wide)
 
 
 def query_from_qmat(qmat: jnp.ndarray, m: int) -> DepsQuery:
@@ -251,30 +293,86 @@ def query_from_qmat(qmat: jnp.ndarray, m: int) -> DepsQuery:
         qmat[:, 4], qmat[:, 5], qmat[:, 6].astype(jnp.int32))
 
 
+def _compact_rows(valid: jnp.ndarray, codes: jnp.ndarray, s: int, k: int):
+    """Shared row compaction: pack each row's valid ``codes`` (already in
+    their final per-row order) into the first ``counts[b]`` cells of a flat
+    entry buffer.  Returns (counts int32[B], row_end int32[B], ent[s]).
+
+    The pack is a POSITION sort (ascending column index of valid cells,
+    invalid -> C sorts last) followed by a B*k scatter — scattering all B*C
+    candidate positions directly is pathologically slow on TPU, and on the
+    CPU backend a sort beats top_k ~10x (the r06 lesson), so both backends
+    compact through the same sort here."""
+    b, c = codes.shape
+    counts = jnp.sum(valid, axis=1, dtype=jnp.int32)
+    row_end = jnp.cumsum(counts)
+    starts = row_end - counts
+    k = min(k, c)
+    col = jnp.arange(c, dtype=jnp.int32)
+    if jax.default_backend() == "cpu":
+        cols = jnp.where(valid, col, jnp.int32(c))
+        cols = jax.lax.slice_in_dim(jnp.sort(cols, axis=1), 0, k, axis=1)
+        vals = jnp.take_along_axis(codes, jnp.minimum(cols, c - 1), axis=1)
+        ok = cols < c
+    else:
+        scores = jnp.where(valid, c - col, 0)
+        top, tidx = jax.lax.top_k(scores, k)
+        vals = jnp.take_along_axis(codes, tidx, axis=1)
+        ok = top > 0
+    pos = starts[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    pos = jnp.where(ok & (pos < s), pos, s)                    # s = dropped
+    ent = jnp.full(s + 1, -1, codes.dtype).at[pos.reshape(-1)] \
+        .set(vals.reshape(-1), mode="drop")[:s]
+    return counts, row_end, ent
+
+
 def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
-                   m: int, s: int, k: int, prune=None) -> jnp.ndarray:
+                   m: int, s: int, k: int, prune=None, wide: bool = False):
     """The traceable body of calculate_deps_flat: exact mask over THIS
     table (a full table, or one mesh shard's slice under shard_map), then
-    per-row top-k compaction (memory-safe: fuses into the mask computation,
-    no [B*N] index materialization) scattered into one CSR.  ``k`` caps the
-    widest row, ``s`` the batch total; both sticky-learned by the caller
-    from the header counts."""
+    the EXACT overlap-triple expansion compacted into a two-buffer CSR —
+    (header (total, maxc, row_end[B]) int32, entries[s] composite codes).
+
+    Two phases keep it memory-safe: (1) the per-row slot indices compact
+    through the mask exactly as before (no [B, N, M, Q] expansion of the
+    full table); (2) only the <= k selected slots' interval rows are
+    gathered (row gathers — effectively free on TPU) and expanded against
+    the query intervals into sorted codes.  ``k`` caps the widest TRIPLE
+    row, ``s`` the batch triple total; both sticky-learned by the caller
+    from the header.  Overflow stays detectable: the reported maxc is the
+    exact per-row triple count when every pair fit phase 1, and at least
+    the (truncated-past-k) pair count otherwise — either way overflow
+    reads as ``maxc > k`` and the caller re-runs escalated."""
     query = query_from_qmat(qmat, m)
     if prune is None:
         mask, _conflict = _dep_mask_and_conflict(table, query)
     else:
         mask, _conflict = _dep_mask_and_conflict(table, query, *prune)
-    k = min(k, mask.shape[1])
-    idx, counts = _compact_topk(mask, k)                       # [B,k],[B]
-    row_end = jnp.cumsum(counts)                               # [B]
-    starts = row_end - counts
-    valid = idx >= 0
-    pos = starts[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
-    pos = jnp.where(valid & (pos < s), pos, s)                 # s = dropped
-    flat = jnp.full(s + 1, -1, jnp.int32).at[pos.reshape(-1)] \
-        .set(idx.reshape(-1), mode="drop")[:s]
-    header = jnp.stack([row_end[-1], jnp.max(counts)]).astype(jnp.int32)
-    return jnp.concatenate([header, row_end.astype(jnp.int32), flat])
+    n = mask.shape[1]
+    m_t = table.lo.shape[1]
+    kp = min(k, n)
+    idx, pair_counts = _compact_topk(mask, kp)                 # [B,kp],[B]
+    sel = jnp.clip(idx, 0)
+    tlo = table.lo[sel]                                        # [B,kp,M]
+    thi = table.hi[sel]
+    qlo = query.lo[:, None, None, :]                           # [B,1,1,Q]
+    qhi = query.hi[:, None, None, :]
+    ov = (qlo <= thi[:, :, :, None]) & (tlo[:, :, :, None] <= qhi)
+    valid = ov & (idx >= 0)[:, :, None, None]                  # [B,kp,M,Q]
+    dt = _code_dtype(wide)
+    mq = m_t * m
+    codes = (sel.astype(dt)[:, :, None, None] * mq
+             + jnp.arange(m_t, dtype=dt)[None, None, :, None] * m
+             + jnp.arange(m, dtype=dt)[None, None, None, :])
+    b = mask.shape[0]
+    valid_f = valid.reshape(b, -1)
+    codes_f = codes.reshape(b, -1)   # ascending: slot-major, then col, q
+    counts, row_end, ent = _compact_rows(valid_f, codes_f, s, k)
+    maxc = jnp.maximum(jnp.max(counts), jnp.max(pair_counts))
+    header = jnp.concatenate(
+        [jnp.stack([row_end[-1], maxc]).astype(jnp.int32),
+         row_end.astype(jnp.int32)])
+    return header, ent
 
 
 # -- bucketed index kernel ----------------------------------------------------
@@ -305,11 +403,15 @@ class BucketTable(NamedTuple):
     of whole bucket lines are effectively free.  Liveness needs no status
     column: entries are de-indexed on invalidate/free, so candidates are
     live by construction (the exact status/floor semantics are re-applied
-    by the host geometry + attribution pass either way)."""
+    by the attribution pass either way).  ``bcol``/``wcol`` record each
+    entry's interval COLUMN in its owning slot row — the third leg of the
+    exact overlap triple the kernel emits (module docstring), so the host
+    never rebuilds the geometry."""
 
     blo: jnp.ndarray     # int64[G, K] entry interval starts (PAD_LO empty)
     bhi: jnp.ndarray     # int64[G, K]
     bslot: jnp.ndarray   # int32[G, K] owning slot (-1 empty)
+    bcol: jnp.ndarray    # int32[G, K] entry's interval column in its slot
     bmsb: jnp.ndarray    # int64[G, K] owning TxnId packed
     blsb: jnp.ndarray    # int64[G, K]
     bnode: jnp.ndarray   # int32[G, K]
@@ -317,6 +419,7 @@ class BucketTable(NamedTuple):
     wlo: jnp.ndarray     # int64[W] wide/straggler entries
     whi: jnp.ndarray     # int64[W]
     wslot: jnp.ndarray   # int32[W]
+    wcol: jnp.ndarray    # int32[W]
     wmsb: jnp.ndarray    # int64[W]
     wlsb: jnp.ndarray    # int64[W]
     wnode: jnp.ndarray   # int32[W]
@@ -339,23 +442,42 @@ def _entry_pred(query: DepsQuery, ov, slot, emsb, elsb, enode, ekind,
 
 def bucketed_flat(table: DepsTable, buckets: BucketTable, qmat: jnp.ndarray,
                   m: int, span: int, s: int, k: int, prune=None,
-                  row_offset=None) -> jnp.ndarray:
-    """Bucket-indexed batched deps scan -> packed CSR (header(total, maxc),
-    row_end[B], entries[s]) — same layout as flat_csr_local, d=1.
+                  row_offset=None, keff: int = None, wide: bool = False,
+                  m_t: int = None):
+    """Bucket-indexed batched deps scan -> two-buffer exact CSR
+    (header(total, maxc, row_end[B]) int32, entries[s] composite overlap
+    codes) — same layout as flat_csr_local, d=1.
 
     ``qmat`` carries the standard query columns plus m*span bucket-row
     columns (int64, -1 = no bucket) appended by the host packer.  ``table``
     is unused on the device (kept in the signature so dispatch snapshots
-    stay uniform across kernels; may be None); all predicate data rides in
-    ``buckets``.  ``row_offset`` translates GLOBAL bucket rows to this
-    shard's local rows under a row-sharded BucketTable (shard_map passes
-    ``axis_index * local_rows``): rows outside the local slice become -1
-    (no bucket here) — the union over shards covers every global row."""
+    stay uniform across kernels; may be None) except for its interval
+    width, which scales the codes; all predicate data rides in ``buckets``.
+    ``row_offset`` translates GLOBAL bucket rows to this shard's local rows
+    under a row-sharded BucketTable (shard_map passes ``axis_index *
+    local_rows``): rows outside the local slice become -1 (no bucket here)
+    — the union over shards covers every global row.  ``keff`` slices the
+    bucket entry axis to the mirror's live high-water occupancy (static, so
+    XLA slices the operand before the gather): the [G, BUCKET_K] rows are
+    mostly padding on spread keyspaces, and at the measured 18-entry
+    high-water this cuts the candidate matrix — and the kernel wall — ~4x."""
     query = query_from_qmat(qmat, m)
     b = qmat.shape[0]
+    if m_t is None:
+        m_t = table.lo.shape[1]      # mesh locals pass m_t (table is None)
+    mq = m_t * m
+    dt = _code_dtype(wide)
+    sent = _code_sentinel(wide)
+    if keff is None:
+        keff = buckets.blo.shape[1]
+    keff = min(keff, buckets.blo.shape[1])
+    blo, bhi = buckets.blo[:, :keff], buckets.bhi[:, :keff]
+    bslot, bcol = buckets.bslot[:, :keff], buckets.bcol[:, :keff]
+    bmsb, blsb = buckets.bmsb[:, :keff], buckets.blsb[:, :keff]
+    bnode, bkind = buckets.bnode[:, :keff], buckets.bkind[:, :keff]
     qbuck = qmat[:, 7 + 2 * m:].astype(jnp.int32)          # [B, m*span]
     if row_offset is not None:
-        n_local = buckets.blo.shape[0]
+        n_local = blo.shape[0]
         local = qbuck - row_offset
         qbuck = jnp.where((qbuck >= 0) & (local >= 0) & (local < n_local),
                           local, -1)
@@ -363,84 +485,86 @@ def bucketed_flat(table: DepsTable, buckets: BucketTable, qmat: jnp.ndarray,
     has = qbuck >= 0                                        # [B, m*span]
     # bucket candidates: every entry of every touched bucket, each checked
     # against the query interval that touched the bucket (row gathers only)
-    elo = buckets.blo[g]                                    # [B, m*span, K]
-    ehi = buckets.bhi[g]
+    elo = blo[g]                                            # [B, m*span, K]
+    ehi = bhi[g]
     qlo = jnp.repeat(query.lo, span, axis=1)[:, :, None]    # [B, m*span, 1]
     qhi = jnp.repeat(query.hi, span, axis=1)[:, :, None]
     ov = (elo <= qhi) & (qlo <= ehi) & has[:, :, None]      # [B, m*span, K]
-    pred_b = _entry_pred(query, ov, buckets.bslot[g], buckets.bmsb[g],
-                         buckets.blsb[g], buckets.bnode[g],
-                         buckets.bkind[g], 2)
-    cand = jnp.where(has[:, :, None], buckets.bslot[g], -1).reshape(b, -1)
+    pred_b = _entry_pred(query, ov, bslot[g], bmsb[g],
+                         blsb[g], bnode[g], bkind[g], 2)
+    # the exact overlap triple is inherent in each candidate: the entry IS
+    # one (slot, interval-column) and the probe axis IS the query interval
+    q_of = jnp.repeat(jnp.arange(m, dtype=dt), span)[None, :, None]
+    cand = (bslot[g].astype(dt) * mq + bcol[g].astype(dt) * m
+            + q_of).reshape(b, -1)
     pred_b = pred_b.reshape(b, -1)
-    # wide/straggler candidates: checked against ALL query intervals
+    # wide/straggler candidates: each entry crossed with every query
+    # interval (the old any-reduce collapsed the triple; exact emission
+    # keeps the [B, Q, W] cross — W is straggler-bounded by construction)
     w = buckets.wlo.shape[0]
-    ov_w = jnp.any((buckets.wlo[None, None, :] <= query.hi[:, :, None])
-                   & (query.lo[:, :, None] <= buckets.whi[None, None, :]),
-                   axis=1)                                  # [B, W]
-    pred_w = _entry_pred(query, ov_w, buckets.wslot[None, :],
-                         buckets.wmsb[None, :], buckets.wlsb[None, :],
-                         buckets.wnode[None, :], buckets.wkind[None, :], 1)
+    ov_w = ((buckets.wlo[None, None, :] <= query.hi[:, :, None])
+            & (query.lo[:, :, None] <= buckets.whi[None, None, :]))
+    pred_w = _entry_pred(query, ov_w, buckets.wslot[None, None, :],
+                         buckets.wmsb[None, None, :],
+                         buckets.wlsb[None, None, :],
+                         buckets.wnode[None, None, :],
+                         buckets.wkind[None, None, :], 2)   # [B, Q, W]
+    cand_w = (buckets.wslot[None, None, :].astype(dt) * mq
+              + buckets.wcol[None, None, :].astype(dt) * m
+              + jnp.arange(m, dtype=dt)[None, :, None])
     cand = jnp.concatenate(
-        [cand, jnp.broadcast_to(buckets.wslot[None, :], (b, w))], axis=1)
-    pred = jnp.concatenate([pred_b, pred_w], axis=1)        # [B, C]
+        [cand, jnp.broadcast_to(cand_w, (b, m, w)).reshape(b, -1)], axis=1)
+    pred = jnp.concatenate([pred_b, pred_w.reshape(b, -1)], axis=1)
     if prune is not None:
         pmsb, plsb, pnode = prune
-        above_b = ~ts_lt(buckets.bmsb[g], buckets.blsb[g], buckets.bnode[g],
+        above_b = ~ts_lt(bmsb[g], blsb[g], bnode[g],
                          pmsb, plsb, pnode).reshape(b, -1)
-        above_w = ~ts_lt(buckets.wmsb[None, :], buckets.wlsb[None, :],
-                         buckets.wnode[None, :], pmsb, plsb, pnode)
+        above_w = ~ts_lt(buckets.wmsb[None, None, :],
+                         buckets.wlsb[None, None, :],
+                         buckets.wnode[None, None, :], pmsb, plsb, pnode)
         pred = pred & jnp.concatenate(
-            [above_b, jnp.broadcast_to(above_w, (b, w))], axis=1)
-    # dedupe (a slot is reachable via several buckets/intervals): sort the
-    # surviving ids per row, mark adjacent repeats; -1 rejects sort first
-    hit = jnp.where(pred, cand, -1)
+            [above_b,
+             jnp.broadcast_to(above_w, (b, m, w)).reshape(b, -1)], axis=1)
+    # dedupe (a triple is reachable via several buckets): sort the
+    # surviving codes per row — which ALSO establishes the canonical
+    # (slot, dep-col, query-col) ascending emit order — then mark adjacent
+    # repeats; rejected candidates carry the sentinel and sort last
+    hit = jnp.where(pred, cand, sent)
     hit = jnp.sort(hit, axis=1)
-    uniq = jnp.concatenate(
-        [hit[:, :1] >= 0,
-         (hit[:, 1:] >= 0) & (hit[:, 1:] != hit[:, :-1])], axis=1)
-    counts = jnp.sum(uniq, axis=1, dtype=jnp.int32)         # [B]
-    row_end = jnp.cumsum(counts)
-    starts = row_end - counts
-    # compact the unique survivors to the row's first k columns via top_k
-    # (scattering all B*C candidate positions directly is pathologically
-    # slow on TPU; the top_k keeps the scatter at B*k elements) — unique
-    # survivors keep ascending slot order because scores descend with col.
-    # On the CPU backend top_k itself is the pathology (~10x a sort), so
-    # the virtual-mesh path sorts set columns ascending instead — same
-    # output, chosen at trace time
-    c = hit.shape[1]
-    k = min(k, c)
-    col = jnp.arange(c, dtype=jnp.int32)
-    if jax.default_backend() == "cpu":
-        cols = jnp.where(uniq, col, jnp.int32(c))
-        cols = jax.lax.slice_in_dim(jnp.sort(cols, axis=1), 0, k, axis=1)
-        vals = jnp.take_along_axis(hit, jnp.minimum(cols, c - 1), axis=1)
-        valid = cols < c
-    else:
-        scores = jnp.where(uniq, c - col, 0)
-        top, tidx = jax.lax.top_k(scores, k)                # [B, k]
-        vals = jnp.take_along_axis(hit, tidx, axis=1)
-        valid = top > 0
-    pos = starts[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
-    pos = jnp.where(valid & (pos < s), pos, s)
-    flat = jnp.full(s + 1, -1, jnp.int32).at[pos.reshape(-1)] \
-        .set(vals.reshape(-1), mode="drop")[:s]
-    header = jnp.stack([row_end[-1], jnp.max(counts)]).astype(jnp.int32)
-    return jnp.concatenate([header, row_end.astype(jnp.int32), flat])
+    uniq = (hit != sent) & jnp.concatenate(
+        [jnp.ones((b, 1), bool), hit[:, 1:] != hit[:, :-1]], axis=1)
+    counts, row_end, ent = _compact_rows(uniq, hit, s, k)
+    header = jnp.concatenate(
+        [jnp.stack([row_end[-1], jnp.max(counts)]).astype(jnp.int32),
+         row_end.astype(jnp.int32)])
+    return header, ent
 
 
-bucketed_flat_jit = jax.jit(bucketed_flat, static_argnums=(3, 4, 5, 6))
+bucketed_flat_jit = jax.jit(
+    bucketed_flat, static_argnames=("m", "span", "s", "k", "keff", "wide"))
 
 
-@partial(jax.jit, static_argnums=(3, 4, 5, 6))
+@partial(jax.jit, static_argnames=("m", "span", "s", "k", "keff", "wide"))
 def bucketed_flat_pruned(table: DepsTable, buckets: BucketTable,
                          qmat: jnp.ndarray, m: int, span: int, s: int,
                          k: int, prune_msb: jnp.ndarray = None,
                          prune_lsb: jnp.ndarray = None,
-                         prune_node: jnp.ndarray = None) -> jnp.ndarray:
+                         prune_node: jnp.ndarray = None,
+                         keff: int = None, wide: bool = False):
     return bucketed_flat(table, buckets, qmat, m, span, s, k,
-                         (prune_msb, prune_lsb, prune_node))
+                         (prune_msb, prune_lsb, prune_node),
+                         keff=keff, wide=wide)
+
+
+def decode_triples(codes: np.ndarray, m_t: int, q_m: int):
+    """Host decode of composite overlap codes -> (slot, dep_col, q_col)
+    int64 triples (the inverse of the kernel-side encoding)."""
+    codes = codes.astype(np.int64)
+    mq = np.int64(m_t * q_m)
+    j = codes // mq
+    rem = codes - j * mq
+    m_i = rem // q_m
+    return j, m_i, rem - m_i * q_m
 
 
 # -- fused (batched-over-stores) dispatch ------------------------------------
@@ -475,7 +599,7 @@ def _pad_table_cols(cols, n, m):
 
 def fused_flat_csr(tables: Sequence[DepsTable], qmats: np.ndarray,
                    prunes: Tuple[np.ndarray, np.ndarray, np.ndarray],
-                   m: int, s: int, k: int) -> jnp.ndarray:
+                   m: int, s: int, k: int, wide: bool = False):
     """One fused launch for S stores' batched deps scans.
 
     ``tables``: each store's (cached, device-resident) DepsTable — may
@@ -485,11 +609,12 @@ def fused_flat_csr(tables: Sequence[DepsTable], qmats: np.ndarray,
     ``qmats``: int64[S, B, 7 + 2m] (per-store query matrices, row-padded to
     a common B by the caller).  ``prunes``: per-store floor triples
     (int64[S], int64[S], int32[S]); zeros prune nothing.
-    Returns int32[S, 2 + B + s] — row i is EXACTLY the solo
-    calculate_deps_flat[_pruned] output for store i."""
+    Returns (header int32[S, 2 + B], entries [S, s]) — row i is EXACTLY
+    the solo calculate_deps_flat[_pruned] output for store i (codes scale
+    on the GROUP interval width m_max, which the harvest decodes with)."""
     caps = tuple((t.capacity, t.lo.shape[1]) for t in tables)
     b = qmats.shape[1]
-    key = (caps, b, m, s, k)
+    key = (caps, b, m, s, k, wide)
     fn = _FUSED_CACHE.get(key)
     if fn is None:
         n_max = max(c for c, _ in caps)
@@ -502,7 +627,8 @@ def fused_flat_csr(tables: Sequence[DepsTable], qmats: np.ndarray,
                                   for col in zip(*padded)))
             return jax.vmap(
                 lambda t, q, a, b_, c: flat_csr_local(t, q, m, s, k,
-                                                      (a, b_, c))
+                                                      (a, b_, c),
+                                                      wide=wide)
             )(stacked, qm, pm, pl, pn)
 
         fn = _FUSED_CACHE[key] = jax.jit(traced)
@@ -511,17 +637,17 @@ def fused_flat_csr(tables: Sequence[DepsTable], qmats: np.ndarray,
               jnp.asarray(prunes[2]))
 
 
-@partial(jax.jit, static_argnums=(5, 6, 7))
+@partial(jax.jit, static_argnames=("m", "s", "k", "wide"))
 def calculate_deps_flat_pruned(table: DepsTable, qmat: jnp.ndarray,
                                prune_msb: jnp.ndarray, prune_lsb: jnp.ndarray,
                                prune_node: jnp.ndarray,
-                               m: int, s: int, k: int) -> jnp.ndarray:
+                               m: int, s: int, k: int, wide: bool = False):
     """calculate_deps_flat with a device-side RedundantBefore floor: entries
     below the (conservative, batch-global) floor never enter the CSR, so a
     hot store whose durable prefix dominates ships only the live tail (the
     host attribution still applies the exact per-token floors on top)."""
     return flat_csr_local(table, qmat, m, s, k,
-                          (prune_msb, prune_lsb, prune_node))
+                          (prune_msb, prune_lsb, prune_node), wide=wide)
 
 
 def pack_query_matrix(queries: Sequence[tuple], max_intervals: int) -> np.ndarray:

@@ -863,7 +863,7 @@ def main(em: Emitter):
         f"spread={max(rates) / min(rates):.2f}x\n"
         f"# phase breakdown (ms/batch of {B}, wall, phases overlap via "
         f"double-buffering): begin(pack+upload+dispatch)={pb['begin']:.1f} "
-        f"collect(download+parse+geometry+attribute)={pb['collect']:.1f} "
+        f"collect(header+entry download+decode+attribute)={pb['collect']:.1f} "
         f"csr_freeze={pb['build']:.1f}\n"
         f"# kernel timing (wall mean per call): {kt}\n"
         f"# index: {idx}\n"

@@ -164,8 +164,8 @@ def test_bucketed_survives_frees_and_requery():
     # the freed slots must be fully de-indexed: no stale bucket entries
     live = set()
     for ents in dev.deps.bucket_entries:
-        live.update(s for (_l, _h, s) in ents)
-    live.update(s for (_l, _h, s) in dev.deps.wide_entries)
+        live.update(s for (_l, _h, s, _c) in ents)
+    live.update(s for (_l, _h, s, _c) in dev.deps.wide_entries)
     assert all(dev.deps.id_of.get(s) is not None for s in live)
 
 

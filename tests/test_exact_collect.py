@@ -1,0 +1,286 @@
+"""Exact-geometry kernels + two-stage compacted downloads (r10).
+
+Every device kernel now emits the exact overlap TRIPLES as sorted
+composite integer codes, and the collect fetches the scalar header first
+and only the live entry prefix after.  These tests pin the new contract:
+
+- property: every exact kernel's (pair, dep-interval, query-interval)
+  triple set equals the host ``_exact_geometry`` reference — over
+  randomized INTERVAL-GAP tables specifically (multi-interval slots whose
+  gaps a coarse bounding-box mask would falsely admit);
+- the int32/int64 entry-width crossover is byte-invisible;
+- the two-stage download composes with the r07 fault ladder: a header
+  fetched followed by a faulted prefix fetch fails the whole flush over
+  to the host route;
+- overflow -> exact-header-sized re-run -> compaction interleavings keep
+  the begin-time snapshot answer.
+"""
+
+import numpy as np
+import pytest
+
+from accord_tpu.local.commands_for_key import InternalStatus
+from accord_tpu.local.device_index import _decode_triples, _prefix_len
+from accord_tpu.ops import deps_kernel as dk
+from accord_tpu.primitives.deps import DepsBuilder
+from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges
+from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from accord_tpu.utils import faults
+from accord_tpu.utils.random_source import RandomSource
+
+from tests.conftest import make_device_state
+
+
+def _build_gap_store(seed, n=160, keyspace=4_000, mesh=None):
+    """Slots with MULTIPLE disjoint intervals (gaps between them) — the
+    shape where a bounding-box mask would admit a query probing inside a
+    slot's gap.  Queries deliberately target gap interiors, interval
+    interiors, and boundaries."""
+    rng = np.random.default_rng(seed)
+    store, dev, safe = make_device_state(mesh=mesh)
+    hlcs = rng.choice(np.arange(1, 50 * n), size=n, replace=False)
+    for i in range(n):
+        kind = TxnKind.Write if rng.random() < 0.7 else TxnKind.Read
+        # 2-4 narrow intervals separated by wide gaps
+        n_iv = int(rng.integers(2, 5))
+        base = int(rng.integers(0, keyspace // 2))
+        rngs, toks = [], []
+        for v in range(n_iv):
+            s = base + v * (keyspace // 8) + int(rng.integers(0, 40))
+            if rng.random() < 0.3:
+                toks.append(s)
+            else:
+                rngs.append(Range(s, s + int(rng.integers(1, 12))))
+        dom = Domain.Range if rngs else Domain.Key
+        tid = TxnId.create(1, int(hlcs[i]), kind, dom,
+                           1 + int(rng.integers(0, 5)))
+        keys = Ranges.of(*rngs) if rngs else Keys([IntKey(t) for t in toks])
+        dev.register(tid, int(InternalStatus.PREACCEPTED), keys)
+        if rng.random() < 0.06:
+            dev.update_status(tid, int(InternalStatus.INVALIDATED))
+    qs = []
+    for _ in range(24):
+        bound = TxnId.create(1, int(rng.integers(50 * n, 99 * n)),
+                             TxnKind.Write, Domain.Key, 1)
+        toks, rngs = [], []
+        for _ in range(int(rng.integers(1, 4))):
+            r = rng.random()
+            # probe gap interiors (base + half-gap offsets) as often as
+            # interval interiors
+            s = int(rng.integers(0, keyspace - 80))
+            if r < 0.4:
+                toks.append(s + keyspace // 16)     # likely inside a gap
+            elif r < 0.7:
+                toks.append(s)
+            else:
+                rngs.append(Range(s, s + int(rng.integers(1, 80))))
+        qs.append((bound, bound, bound.kind().witnesses(), toks, rngs))
+    return store, dev, safe, qs
+
+
+@pytest.mark.parametrize("seed", [3, 17, 59])
+@pytest.mark.parametrize("route", ["device", "dense"])
+def test_exact_kernel_triples_match_host_geometry(seed, route):
+    """Device-route triples == the host _exact_geometry reference applied
+    to the device's own pair list (exact array equality — same order), and
+    the pair list == the host route's (no false positives survive)."""
+    store, dev, safe, qs = _build_gap_store(seed)
+    for prune in (False, True):
+        dev.route_override = route
+        h = dev.deps_query_batch_begin(qs, immediate=True,
+                                       prune_floors=prune)
+        b_d, j_d, (p_i, m_i, q_i), ids, ivs, qnp, _q = \
+            dev._batch_collect(h)
+        # reference: the retired host geometry pass over the device pairs
+        q_m = (qnp.shape[1] - 7) // 2
+        b_r, j_r, (p_r, m_r, q_r) = dev._exact_geometry(
+            b_d.copy(), j_d.copy(), ivs, qnp, q_m)
+        # no pair may be dropped by the reference (exactness) and the
+        # triples must match in VALUE AND ORDER (the kernels' code sort
+        # is np.nonzero's (pair, m, q) order)
+        np.testing.assert_array_equal(b_d, b_r)
+        np.testing.assert_array_equal(j_d, j_r)
+        np.testing.assert_array_equal(p_i, p_r)
+        np.testing.assert_array_equal(m_i, m_r)
+        np.testing.assert_array_equal(q_i, q_r)
+        # pair set == host route's pair set
+        dev.route_override = "host"
+        hh = dev.deps_query_batch_begin(qs, immediate=True,
+                                        prune_floors=prune)
+        b_h, j_h, _pmq, ids_h, _ivs, _qnp, _q2 = dev._batch_collect(hh)
+        # the host route snapshots only referenced slots: compare TxnIds
+        dep_d = sorted(zip(b_d.tolist(), [ids[3][j] for j in j_d]))
+        dep_h = sorted(zip(b_h.tolist(), [ids_h[3][j] for j in j_h]))
+        assert dep_d == dep_h, f"seed={seed} route={route} prune={prune}"
+
+
+def test_mesh_routes_triples_match_reference():
+    """The mesh-sharded kernels (slot-sharded dense + row-sharded
+    bucketed) emit the same exact triple SET as the reference geometry
+    (cross-shard dedupe included)."""
+    store, dev, safe, qs = _build_gap_store(31, mesh="auto")
+    if dev.mesh is None:
+        pytest.skip("virtual mesh unavailable")
+    for route in ("device", "dense"):
+        dev.route_override = route
+        h = dev.deps_query_batch_begin(qs, immediate=True,
+                                       prune_floors=True)
+        b_d, j_d, (p_i, m_i, q_i), ids, ivs, qnp, _q = \
+            dev._batch_collect(h)
+        q_m = (qnp.shape[1] - 7) // 2
+        b_r, j_r, (p_r, m_r, q_r) = dev._exact_geometry(
+            b_d.copy(), j_d.copy(), ivs, qnp, q_m)
+        got = set(zip(b_d[p_i].tolist(), j_d[p_i].tolist(),
+                      m_i.tolist(), q_i.tolist()))
+        ref = set(zip(b_r[p_r].tolist(), j_r[p_r].tolist(),
+                      m_r.tolist(), q_r.tolist()))
+        assert got == ref, route
+
+
+def test_int32_int64_code_crossover(monkeypatch):
+    """Lowering INT32_CODE_MAX to 0 forces int64 entry buffers on every
+    kernel; results must be byte-identical to the int32 run (the width is
+    a transport detail, never a semantic)."""
+    store, dev, safe, qs = _build_gap_store(7)
+    dev.mesh = None
+    outs = {}
+    for label, cap in (("i32", dk.INT32_CODE_MAX), ("i64", 0)):
+        monkeypatch.setattr(dk, "INT32_CODE_MAX", cap)
+        assert dk.wide_codes(dev.deps.capacity, dev.deps.max_intervals,
+                             4) == (cap == 0)
+        for route in ("device", "dense"):
+            dev.route_override = route
+            h = dev.deps_query_batch_begin(qs, immediate=True,
+                                           prune_floors=True)
+            part = h[0][0]
+            assert part["wide"] == (cap == 0)
+            assert np.dtype(part["box"]["ent"].dtype) == (
+                np.int64 if cap == 0 else np.int32)
+            outs[(label, route)] = dev.deps_query_batch_end(h)
+    for route in ("device", "dense"):
+        for a, b in zip(outs[("i32", route)], outs[("i64", route)]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_header_then_faulted_prefix_fails_over_to_host():
+    """The r07 ladder composes with the two-stage download: the header
+    fetch succeeds, the entry-prefix fetch faults, and the WHOLE flush
+    fails over to the host route — same bytes, one quarantine."""
+    store, dev, safe, qs = _build_gap_store(11)
+    dev.mesh = None
+    dev.route_override = "host"
+    want = dev.deps_query_batch_end(
+        dev.deps_query_batch_begin(qs, immediate=True, prune_floors=True))
+    dev.route_override = "device"
+    h = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=True)
+    orig_check = faults.check
+
+    def entry_stage_only(kind, detail=""):
+        if kind == "transfer" and detail == "entry download":
+            raise faults.TransferFault("injected entry-stage fault")
+        return orig_check(kind, detail)
+
+    n_faults = dev.n_device_faults
+    try:
+        faults.check = entry_stage_only
+        got = dev.deps_query_batch_end(h)
+    finally:
+        faults.check = orig_check
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert dev.n_device_faults == n_faults + 1
+    assert dev.n_fallback_queries >= len(qs)
+    assert dev._dev_quar_flushes > 0          # quarantined, as a real fault
+
+
+def test_whole_transfer_fault_fails_over_to_host():
+    """Armed transfer faults at collect (header stage) also fail the
+    flush over — the pre-r10 behavior is preserved stage-wise."""
+    store, dev, safe, qs = _build_gap_store(13)
+    dev.mesh = None
+    dev.route_override = "host"
+    want = dev.deps_query_batch_end(
+        dev.deps_query_batch_begin(qs, immediate=True, prune_floors=True))
+    dev.route_override = "device"
+    h = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=True)
+    with faults.device_fault("transfer", 1.0, RandomSource(5)):
+        got = dev.deps_query_batch_end(h)
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert dev.n_fallback_queries >= len(qs)
+
+
+def test_overflow_rerun_compaction_interleaving():
+    """Overflow -> exact-header-sized re-run -> interleaved mutation +
+    floor compaction: the deferred collect must answer for the BEGIN-time
+    snapshot, sized from the header it already downloaded (never the full
+    padded buffer), regardless of what lands in between."""
+    store, dev, safe, qs = _build_gap_store(23, n=220)
+    dev.mesh = None
+    dev.route_override = "host"
+    builders_h = [DepsBuilder() for _ in qs]
+    hh = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=True)
+    dev.deps_query_batch_end_attributed(safe, hh, builders_h)
+    want = [b.build() for b in builders_h]
+    # force overflow: a learned row width far below the true max triples
+    dev.route_override = "device"
+    dev._batch_k = 4
+    dev._batch_flat = 4096
+    h = dev.deps_query_batch_begin(qs, prune_floors=True)
+    # interleave: register fresh txns (bucket index + mirror mutate) and
+    # free a live one, then squeeze the table under a budget so the next
+    # grow compacts — none of it may leak into the in-flight collect
+    for i in range(40):
+        tid = TxnId.create(1, 900_000 + i, TxnKind.Write, Domain.Key, 1)
+        dev.register(tid, int(InternalStatus.PREACCEPTED),
+                     Keys([IntKey((i * 97) % 4_000)]))
+    victim = next(iter(dev.deps.slot_of))
+    dev.free(victim)
+    dev.device_budget_slots = dev.deps.capacity
+    dev._compact_below_floor()
+    builders_d = [DepsBuilder() for _ in qs]
+    dev.deps_query_batch_end_attributed(safe, h, builders_d)
+    got = [b.build() for b in builders_d]
+    assert dev._batch_k > 4, "overflow re-run never happened"
+    for w, g in zip(want, got):
+        assert list(w.key_deps.keys.tokens()) == \
+            list(g.key_deps.keys.tokens())
+        for t in w.key_deps.keys.tokens():
+            assert list(w.key_deps.txn_ids_for(t)) == \
+                list(g.key_deps.txn_ids_for(t))
+        assert [r.start for r in w.range_deps.ranges] == \
+            [r.start for r in g.range_deps.ranges]
+
+
+def test_prefix_len_and_decode_edges():
+    """Unit edges of the download helpers: zero totals fetch nothing,
+    granularity bounds the slice-shape count, decode round-trips codes."""
+    assert _prefix_len(0, 4096) == 0
+    assert _prefix_len(1, 4096) == 256          # gran = max(128, s>>4)
+    assert _prefix_len(4096, 4096) == 4096
+    assert _prefix_len(100, 65536) == 4096      # gran = s>>4
+    # decode round-trip (2 shards, global ids off -> shard offsets)
+    m_t, q_m, shard_n = 4, 8, 100
+    mq = m_t * q_m
+    hdr = np.array([[3, 2, 1, 3, 3], [1, 1, 0, 1, 1]], np.int64)
+    ent = np.array([[5 * mq + 2 * q_m + 7, 9 * mq, 9 * mq + 3],
+                    [1 * mq + 1 * q_m + 1, -1, -1]], np.int64)
+    b, j, m_i, q_i = _decode_triples(hdr, ent, 3, shard_n, False, mq, q_m)
+    np.testing.assert_array_equal(b, [0, 1, 1, 1])
+    np.testing.assert_array_equal(j, [5, 9, 9, 101])
+    np.testing.assert_array_equal(m_i, [2, 0, 0, 1])
+    np.testing.assert_array_equal(q_i, [7, 0, 3, 1])
+
+
+def test_download_byte_counters_and_compaction_ratio():
+    """The two-stage transfer counts what it actually moved; the padded
+    counter records what the old full-buffer download would have moved.
+    On a spread keyspace the ratio must show real compaction."""
+    store, dev, safe, qs = _build_gap_store(41)
+    dev.mesh = None
+    dev.route_override = "device"
+    for _ in range(3):
+        dev.deps_query_batch_attributed(safe, qs,
+                                        [DepsBuilder() for _ in qs])
+    assert dev.download_bytes > 0
+    assert dev.download_bytes < dev.download_bytes_padded

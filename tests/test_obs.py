@@ -303,9 +303,14 @@ def test_devprof_16store_fused_run_trace(tmp_path, monkeypatch):
              if e["name"] == "fused_flush_dispatch"]
     assert fused, "16-store fused run produced no fused launch slices"
     assert all(e["args"]["members"] == 16 for e in fused)
+    # r10 two-stage downloads: the harvest is a header slice plus an
+    # entry-prefix slice (the wait split the compacted transfer exposes)
     harvests = [e for e in doc["traceEvents"]
-                if e["name"] == "fused_flush_harvest"]
+                if e["name"] == "fused_flush_harvest_header"]
     assert harvests, "fused launches were never harvested"
+    assert [e for e in doc["traceEvents"]
+            if e["name"] == "fused_flush_harvest_entries"], \
+        "two-stage harvest emitted no entry-prefix slice"
     assert r["launches"] < r["nq"] / 16, "launches were not coalesced"
     path = str(tmp_path / "fused16.json")
     prof.write_chrome(path)

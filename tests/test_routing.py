@@ -279,6 +279,59 @@ def test_fusion_off_pins_solo_launches():
         assert _unpack_builders(builders) == expected[i]
 
 
+@pytest.mark.parametrize("seed", [11, 47])
+def test_triple_dedupe_is_identity_for_exact_kernels(seed):
+    """r10 satellite: the global triple-dedupe pass is skipped for
+    single-part exact kernels (their CSRs are unique by construction) and
+    kept for multi-part / sharded_bucketed — forcing it ON for EVERY route
+    must be byte-invisible, proving the skip drops only dead work."""
+    from accord_tpu.local.device_index import DeviceState
+    store, dev, safe, entries, floor, qs = _build(seed)
+    for prune in (False, True):
+        for route in ROUTES:
+            dev.route_override = route
+            plain = _csr(dev, qs, prune)
+            attr_plain = _attributed(dev, safe, qs, prune)
+            try:
+                DeviceState.FORCE_TRIPLE_DEDUPE = True
+                forced = _csr(dev, qs, prune)
+                attr_forced = _attributed(dev, safe, qs, prune)
+            finally:
+                DeviceState.FORCE_TRIPLE_DEDUPE = False
+            for a, b in zip(plain, forced):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"seed={seed} route={route} prune={prune}")
+            assert attr_plain == attr_forced
+
+
+@pytest.mark.parametrize("seed", [13, 61])
+def test_exact_kernels_match_host_geometry_property(seed):
+    """r10 tentpole contract: every device kernel's emitted triples equal
+    the host ``_exact_geometry`` reference over its own pair list — on the
+    mixed point/range footprints of the routing property generator (the
+    reference is the executable spec of the emit order)."""
+    store, dev, safe, entries, floor, qs = _build(seed)
+    for route in ("device", "dense"):
+        for mesh in (dev.mesh, None):
+            saved = dev.mesh
+            dev.mesh = mesh
+            dev.route_override = route
+            h = dev.deps_query_batch_begin(qs, immediate=True,
+                                           prune_floors=True)
+            b_d, j_d, (p_i, m_i, q_i), _ids, ivs, qnp, _q = \
+                dev._batch_collect(h)
+            dev.mesh = saved
+            q_m = (qnp.shape[1] - 7) // 2
+            b_r, j_r, (p_r, m_r, q_r) = dev._exact_geometry(
+                b_d.copy(), j_d.copy(), ivs, qnp, q_m)
+            np.testing.assert_array_equal(b_d, b_r)
+            np.testing.assert_array_equal(j_d, j_r)
+            got = set(zip(p_i.tolist(), m_i.tolist(), q_i.tolist()))
+            ref = set(zip(p_r.tolist(), m_r.tolist(), q_r.tolist()))
+            assert got == ref, f"seed={seed} route={route} mesh={mesh}"
+
+
 def test_adaptive_route_is_invisible():
     """Whatever the adaptive chooser picks (route_override=None) must equal
     the pinned routes — the router can only change cost, never results."""

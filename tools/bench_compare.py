@@ -16,7 +16,11 @@ covers:
 - the r09 observability fields where both sides carry them: per-phase
   p99 latencies (lower is better) and the fast-path rate (higher is
   better) — reported, and gated at 2x the base threshold since phase
-  distributions are log-bucketed (2x-granular by construction).
+  distributions are log-bucketed (2x-granular by construction),
+- the r10 download-byte counters from the headline ``# index:`` line
+  (``download_bytes`` / ``download_bytes_padded``): the two-stage
+  compacted transfer's actual bytes are gated lower-is-better, and the
+  compaction ratio prints for every artifact that carries them.
 
 Exit status: 0 = no regression, 1 = usage/parse error, 2 = regression
 beyond threshold.  Every comparison prints either way — the tool is the
@@ -28,9 +32,27 @@ import json
 import sys
 
 
+def parse_index_counters(text):
+    """{counter: int} from the bench's ``# index: k=v ...`` line (empty
+    when the artifact predates a counter or the line)."""
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("# index:"):
+            out = {}
+            for tok in line[len("# index:"):].split():
+                if "=" in tok:
+                    key, _, val = tok.partition("=")
+                    try:
+                        out[key] = int(val)
+                    except ValueError:
+                        pass
+            return out
+    return {}
+
+
 def parse_artifact(path):
-    """(headline dict, {metric_name: config_row}) from a driver artifact
-    or raw bench output."""
+    """(headline dict, {metric_name: config_row}, index counters) from a
+    driver artifact or raw bench output."""
     with open(path) as f:
         text = f.read()
     headline, configs = None, {}
@@ -59,7 +81,7 @@ def parse_artifact(path):
                 headline = row
     if headline is None or headline.get("value") is None:
         raise SystemExit(f"error: no headline metric in {path}")
-    return headline, configs
+    return headline, configs, parse_index_counters(text)
 
 
 def check(name, old, new, threshold, lower_is_better=False):
@@ -97,8 +119,8 @@ def main(argv=None):
                    help="allowed latency regression fraction (default 0.25)")
     args = p.parse_args(argv)
 
-    old_head, old_cfg = parse_artifact(args.old)
-    new_head, new_cfg = parse_artifact(args.new)
+    old_head, old_cfg, old_idx = parse_artifact(args.old)
+    new_head, new_cfg, new_idx = parse_artifact(args.new)
     failures = []
 
     print(f"headline ({args.old} -> {args.new}):")
@@ -107,6 +129,22 @@ def main(argv=None):
               f"{new_head['metric']} (compared anyway)")
     failures.append(check(new_head["metric"], old_head["value"],
                           new_head["value"], args.threshold))
+    # r10 compacted downloads: actual bytes must not regress (lower is
+    # better); the compaction ratio prints wherever the counters exist
+    for tag, idx in (("old", old_idx), ("new", new_idx)):
+        db, dp = idx.get("download_bytes"), idx.get("download_bytes_padded")
+        if db is not None and dp is not None:
+            # db == 0 prints too (an all-host/quarantined run is an
+            # anomaly worth surfacing, not a pre-r10 artifact)
+            ratio = db / dp if dp else float("nan")
+            print(f"  download_bytes[{tag}]: {db} / padded {dp} "
+                  f"(compaction {ratio:.3f}x)")
+    if (old_idx.get("download_bytes") is not None
+            and new_idx.get("download_bytes") is not None):
+        failures.append(check("headline.download_bytes",
+                              old_idx["download_bytes"],
+                              new_idx["download_bytes"],
+                              args.threshold, lower_is_better=True))
 
     common = [m for m in old_cfg if m in new_cfg]
     print(f"config rows ({len(common)} common, "

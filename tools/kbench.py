@@ -33,20 +33,26 @@ def launch_bench():
     s_cap, k_cap = 16384, 64
     pz = (np.zeros(S, np.int64), np.zeros(S, np.int64),
           np.zeros(S, np.int32))
+
+    def fetch(out):
+        # the r10 two-stage shape: header join, then live entry prefix
+        hdr = np.asarray(out[0])
+        return hdr, np.asarray(out[1])
+
     # warm + compile both shapes
-    np.asarray(dk.fused_flat_csr(tables, qm, pz, QM, s_cap, k_cap))
+    fetch(dk.fused_flat_csr(tables, qm, pz, QM, s_cap, k_cap))
     for i in range(S):
-        np.asarray(dk.calculate_deps_flat(tables[i], jnp.asarray(qm[i]),
-                                          QM, s_cap, k_cap))
+        fetch(dk.calculate_deps_flat(tables[i], jnp.asarray(qm[i]),
+                                     QM, s_cap, k_cap))
     t0 = time.perf_counter()
     for _ in range(REPS):
         for i in range(S):
-            np.asarray(dk.calculate_deps_flat(
+            fetch(dk.calculate_deps_flat(
                 tables[i], jnp.asarray(qm[i]), QM, s_cap, k_cap))
     solo = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(REPS):
-        np.asarray(dk.fused_flat_csr(tables, qm, pz, QM, s_cap, k_cap))
+        fetch(dk.fused_flat_csr(tables, qm, pz, QM, s_cap, k_cap))
     fused = time.perf_counter() - t0
     txns = REPS * S * B
     print(f"stores={S} flush={B}q reps={REPS} txns={txns}")

@@ -159,14 +159,22 @@ def mode_headline(args):
         q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
         table = dev.deps.device_table()
         n = table.capacity
-        s, k = min(dev._batch_flat, B * n), min(dev._batch_k, n)
+        m_t = dev.deps.max_intervals
+        wide = dk.wide_codes(n, m_t, q_m)
+        s, k = (min(dev._batch_flat, B * n * m_t * q_m),
+                min(dev._batch_k, n * m_t * q_m))
         qnp = phase("pack_query_matrix",
                     lambda: dk.pack_query_matrix(packed, q_m))
         qmat = phase("upload(qmat)",
                      lambda: jax.block_until_ready(jnp.asarray(qnp)))
         out_dev = phase("kernel(dispatch+wait)", lambda: jax.block_until_ready(
-            dk.calculate_deps_flat(table, qmat, q_m, s, k)))
-        phase("download", lambda: np.asarray(out_dev))
+            dk.calculate_deps_flat(table, qmat, q_m, s, k, wide)))
+        hdr_np = phase("download(header)",
+                       lambda: np.asarray(out_dev[0]))
+        from accord_tpu.local.device_index import _fetch_entry_prefix
+        phase("download(entry prefix)",
+              lambda: _fetch_entry_prefix(out_dev[1], 1, s,
+                                          int(hdr_np[0])))
         res = phase("begin+collect(e2e)", lambda: dev._batch_collect(
             dev.deps_query_batch_begin(queries)))
         b_idx, j_idx, overlap, ids, ivs, qnp2, qs = res
