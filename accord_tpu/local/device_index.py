@@ -3109,23 +3109,27 @@ class DeviceState:
         # fails over to host.
         cand_slots = None
         used_fused = False
+        mode = None
         if not (self.host_pinned or self._dev_quar_flushes > 0):
             if fused is not None and fused.serves(self):
                 try:
                     cand_slots = fused.result_for(self)
                     self.n_fused_ticks += 1
                     used_fused = True
+                    mode = "fused"
                 except faults.DEVICE_EXCEPTIONS as e:
                     fused.poison(e)
             else:
                 try:
+                    import time as _time
+                    _t0 = _time.perf_counter()
                     dk.launch_check("drain")
                     state, live = self.drain.state()
                     faults.check("transfer", "drain download")
                     if isinstance(state, drk.EllDrainState):
                         # large in-flight set: sparse gather sweep (no [N, N])
-                        ready = np.asarray(
-                            drk.ready_frontier_ell(state))[: len(live)]
+                        mode = "ell"
+                        fut = drk.ready_frontier_ell(state)
                     elif self.mesh is not None and \
                             state.status.shape[0] % \
                             len(self.mesh.devices.flat) == 0 \
@@ -3134,16 +3138,30 @@ class DeviceState:
                         # devices (fixpoint analogue: parallel.sharded.
                         # sharded_drain)
                         from ..parallel.sharded import sharded_ready_frontier
-                        ready = np.asarray(
-                            sharded_ready_frontier(self.mesh)(state))[: len(live)]
+                        mode = "mesh"
+                        fut = sharded_ready_frontier(self.mesh)(state)
                     else:
-                        ready = np.asarray(drk.ready_frontier(state))[: len(live)]
+                        mode = "device"
+                        fut = drk.ready_frontier(state)
+                    # drain forensics: split the sweep at the async-dispatch
+                    # boundary — upload+enqueue vs the result join — so a
+                    # drain-bound regime shows WHERE the tick pays
+                    # (kernel_times rows + devprof drain_tick_* slices)
+                    _t1 = _time.perf_counter()
+                    ready = np.asarray(fut)[: len(live)]
+                    self._ktime_span("drain_tick_dispatch", _t0, _t1)
+                    self._ktime("drain_tick_wait", _t1)
                     cand_slots = live[ready & self.drain.active[live]]
                 except faults.DEVICE_EXCEPTIONS as e:
                     self._device_fault(e, f"drain tick: {e}")
         if cand_slots is None:
             self.n_host_ticks += 1
             cand_slots = self._host_ready_slots()
+            mode = "host"
+        obs = getattr(getattr(self.store, "node", None),
+                      "drain_observer", None)
+        if obs is not None:
+            obs(self.store, mode, int(len(cand_slots)))
         if len(cand_slots) != 0:
             cands = sorted(
                 (self.drain.id_of[int(s)] for s in cand_slots

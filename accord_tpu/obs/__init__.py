@@ -17,6 +17,11 @@ single layer they all migrate onto:
 - :mod:`accord_tpu.obs.devprof` — wall-clock profiler around every device
   launch boundary (upload / kernel / harvest; fused vs solo) with a
   Chrome-trace (``chrome://tracing``) exporter.
+- :mod:`accord_tpu.obs.flight` — the black-box flight recorder: per-node
+  bounded event rings (spans, routes, fault-ladder transitions, fused
+  launches, drain sweeps) whose anomaly triggers (watchdog recovery,
+  quarantine escalation, phase-latency outlier) dump deterministic
+  post-mortem bundles the instant they fire.
 
 Knob: ``ACCORD_TPU_OBS=off`` disables span recording, histogram
 observation and the device profiler (mirroring ``ACCORD_TPU_FUSION=off``;
@@ -31,6 +36,7 @@ from __future__ import annotations
 import os
 from typing import Callable, Optional
 
+from .flight import FlightRecorder
 from .metrics import MetricsRegistry
 from .spans import SpanRecorder
 
@@ -54,6 +60,14 @@ class Observability:
         on = enabled() if spans_on is None else spans_on
         self.spans: Optional[SpanRecorder] = (
             SpanRecorder(now or (lambda: 0), self.metrics) if on else None)
+        # the black-box flight recorder stands down with the spans (the
+        # ACCORD_TPU_OBS=off escape hatch is total); when live it taps the
+        # span recorder so phase completions and txn events need no second
+        # instrumentation site
+        self.flight: Optional[FlightRecorder] = (
+            FlightRecorder(now or (lambda: 0), self.metrics) if on else None)
+        if self.spans is not None:
+            self.spans.flight = self.flight
 
 
 def spans_of(node) -> Optional[SpanRecorder]:

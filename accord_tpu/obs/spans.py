@@ -72,6 +72,9 @@ class SpanRecorder:
                  capacity: int = 200_000):
         self.clock = clock
         self.metrics = metrics
+        # flight-recorder tap (obs.flight): completions and txn events
+        # mirror into the black box's per-node rings; None = unarmed
+        self.flight = None
         self.capacity = capacity
         self._seq = itertools.count()
         self.roots: Dict[str, Span] = {}
@@ -104,6 +107,11 @@ class SpanRecorder:
         if root is not None and root.end is None:
             root.end = self.clock()
             root.attrs["outcome"] = outcome
+            if self.flight is not None:
+                # before the observe below: the outlier check compares
+                # against the distribution-so-far
+                self.flight.on_span(root.node, "txn", key,
+                                    root.end - root.start)
             if self.metrics is not None:
                 self.metrics.histogram("phase_micros", phase="txn").observe(
                     root.end - root.start)
@@ -132,6 +140,9 @@ class SpanRecorder:
         span.end = self.clock()
         if attrs:
             span.attrs.update(attrs)
+        if self.flight is not None:
+            self.flight.on_span(span.node, span.name, span.key,
+                                span.end - span.start)
         if self.metrics is not None:
             self.metrics.histogram("phase_micros", phase=span.name).observe(
                 span.end - span.start)
@@ -151,6 +162,8 @@ class SpanRecorder:
             ev.update(attrs)
         root.events.append(ev)
         self.n_events += 1
+        if self.flight is not None:
+            self.flight.on_txn_event(root.node, key, name)
 
     def decision(self, key: str, path: str) -> None:
         """The fast/slow decision (ref: CoordinateTransaction.java:71-101)

@@ -65,6 +65,34 @@ def blocking_matrix(state: DrainState) -> jnp.ndarray:
     return state.adj & gate & ~(invalidated | free)[None, :]
 
 
+def _drain_fix(state: DrainState):
+    """The dense fixpoint body shared by :func:`drain` (legacy 2-tuple) and
+    :func:`drain_levels` (forensic 3-tuple): returns (applied, newly,
+    sweeps) where ``sweeps`` counts while-loop iterations — one frontier
+    sweep per executeAt antichain plus the terminating empty sweep.  The
+    sweep count IS the serial-launch-equivalent cost of the drain (each
+    sweep is one [N, N] matvec the device cannot overlap with the next),
+    which is what makes a deep serial chain the regime's worst case."""
+    blocking = blocking_matrix(state)
+    blk = blocking.astype(jnp.bfloat16)               # [N, N] — MXU matvec
+    stable = state.status == SLOT_STABLE
+    applied0 = state.status == SLOT_APPLIED
+
+    def body(carry):
+        applied, _, sweeps = carry
+        unapplied = (~applied).astype(jnp.bfloat16)
+        waiting = (blk @ unapplied) > 0.5
+        ready = stable & ~applied & ~waiting
+        return applied | ready, jnp.any(ready), sweeps + 1
+
+    def cond(carry):
+        return carry[1]
+
+    applied, _, sweeps = lax.while_loop(
+        cond, body, (applied0, jnp.bool_(True), jnp.int32(0)))
+    return applied, applied & ~applied0, sweeps
+
+
 @jax.jit
 def drain(state: DrainState) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run the drain to fixpoint.
@@ -72,23 +100,15 @@ def drain(state: DrainState) -> Tuple[jnp.ndarray, jnp.ndarray]:
     Returns (applied bool[N], newly_executed bool[N]): the final applied set
     and which slots this call transitioned Stable -> executed.
     """
-    blocking = blocking_matrix(state)
-    blk = blocking.astype(jnp.bfloat16)               # [N, N] — MXU matvec
-    stable = state.status == SLOT_STABLE
-    applied0 = state.status == SLOT_APPLIED
+    applied, newly, _ = _drain_fix(state)
+    return applied, newly
 
-    def body(carry):
-        applied, _ = carry
-        unapplied = (~applied).astype(jnp.bfloat16)
-        waiting = (blk @ unapplied) > 0.5
-        ready = stable & ~applied & ~waiting
-        return applied | ready, jnp.any(ready)
 
-    def cond(carry):
-        return carry[1]
-
-    applied, _ = lax.while_loop(cond, body, (applied0, jnp.bool_(True)))
-    return applied, applied & ~applied0
+@jax.jit
+def drain_levels(state: DrainState):
+    """Forensic variant of :func:`drain`: (applied, newly, sweeps) — same
+    fixpoint, same bytes, plus the sweep count (see _drain_fix)."""
+    return _drain_fix(state)
 
 
 @jax.jit
@@ -211,20 +231,33 @@ def fused_ready_frontier_ell(states):
     return fn(tuple(states))
 
 
-@jax.jit
-def drain_ell(state: EllDrainState) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Fixpoint drain over the ELL adjacency: each sweep applies a whole
-    antichain, the per-sweep cost is an [N, D] gather (no [N, N] anywhere)."""
+def _drain_ell_fix(state: EllDrainState):
+    """ELL analogue of _drain_fix: (applied, newly, sweeps) with an [N, D]
+    gather per sweep instead of the dense matvec."""
     blocking, j = _ell_blocking(state)
     stable = state.status == SLOT_STABLE
     applied0 = state.status == SLOT_APPLIED
 
     def body(carry):
-        applied, _ = carry
+        applied, _, sweeps = carry
         waiting = jnp.any(blocking & ~applied[j], axis=1)
         ready = stable & ~applied & ~waiting
-        return applied | ready, jnp.any(ready)
+        return applied | ready, jnp.any(ready), sweeps + 1
 
-    applied, _ = lax.while_loop(lambda c: c[1], body,
-                                (applied0, jnp.bool_(True)))
-    return applied, applied & ~applied0
+    applied, _, sweeps = lax.while_loop(
+        lambda c: c[1], body, (applied0, jnp.bool_(True), jnp.int32(0)))
+    return applied, applied & ~applied0, sweeps
+
+
+@jax.jit
+def drain_ell(state: EllDrainState) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fixpoint drain over the ELL adjacency: each sweep applies a whole
+    antichain, the per-sweep cost is an [N, D] gather (no [N, N] anywhere)."""
+    applied, newly, _ = _drain_ell_fix(state)
+    return applied, newly
+
+
+@jax.jit
+def drain_ell_levels(state: EllDrainState):
+    """Forensic variant of :func:`drain_ell`: (applied, newly, sweeps)."""
+    return _drain_ell_fix(state)

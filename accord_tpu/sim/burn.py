@@ -61,6 +61,11 @@ class BurnResult:
         self.span_export: Optional[str] = None
         self.fast_path_rate: Optional[float] = None
         self.phase_latencies: Dict[str, Dict[str, int]] = {}
+        # black-box flight recorder (obs.flight): canonical JSON of every
+        # anomaly post-mortem bundle this run dumped — byte-identical
+        # across same-seed runs (None under ACCORD_TPU_OBS=off)
+        self.flight_export: Optional[str] = None
+        self.flight_postmortems = 0
 
     def __repr__(self):
         return (f"BurnResult(ok={self.ops_ok}, failed={self.ops_failed}, "
@@ -491,6 +496,10 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         result.span_export = spans.export_json()
         result.fast_path_rate = spans.fast_path_rate()
         result.phase_latencies = cluster.obs.metrics.phase_percentiles()
+    flight = cluster.obs.flight
+    if flight is not None:
+        result.flight_export = flight.export_json()
+        result.flight_postmortems = len(flight)
     return result
 
 

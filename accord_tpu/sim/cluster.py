@@ -311,6 +311,23 @@ class Cluster:
         from ..obs.metrics import LegacyStats
         self.obs = Observability(now=lambda: self.queue.now)
         self.stats = LegacyStats(self.obs.metrics)
+        if self.obs.flight is not None:
+            # post-mortem bundles capture the live per-store device gauges
+            # at the anomaly; read through self.nodes so restarts and
+            # topology growth stay covered (sorted for byte-determinism)
+            from ..obs.metrics import index_counters
+
+            def device_gauges():
+                out = {}
+                for nid in sorted(self.nodes):
+                    stores = self.nodes[nid].command_stores
+                    for s in stores.unsafe_all_stores():
+                        if s.device is not None:
+                            out[f"{nid}/{s.store_id}"] = \
+                                index_counters(s.device)
+                return out
+
+            self.obs.flight.gauge_source = device_gauges
         # structured event trace (ref: accord.impl.basic.Trace); off unless
         # a Trace instance is attached
         self.trace = None
@@ -361,6 +378,8 @@ class Cluster:
             self.obs.metrics.counter("deps_route_queries",
                                      node=nid, route=route).inc(nq)
             sid = getattr(store, "store_id", -1)
+            if self.obs.flight is not None:
+                self.obs.flight.on_route(nid, sid, route, nq)
             if self.trace is not None:
                 self.trace.record_route(self.queue.now, nid, sid, route, nq)
             sp = self.obs.spans
@@ -382,6 +401,9 @@ class Cluster:
             self.stats[key] = self.stats.get(key, 0) + 1
             self.obs.metrics.counter("device_fault_events",
                                      node=nid, event=event).inc()
+            if self.obs.flight is not None:
+                self.obs.flight.on_fault(nid, getattr(store, "store_id", -1),
+                                         event, detail)
             if self.trace is not None:
                 sid = getattr(store, "store_id", -1)
                 if event in ("quarantine", "reprobe", "restore"):
@@ -392,6 +414,21 @@ class Cluster:
                                             event, detail)
 
         node.fault_observer = fault_observer
+
+        def drain_observer(store, mode, frontier, nid=node.node_id):
+            """One drain-tick frontier sweep (mode device/fused/host/ell/
+            mesh, frontier = ready candidates): the drain-regime forensics
+            leg — per-tick frontier sizes as a registry histogram and a
+            flight-ring entry, so a drain stall's shape (many empty sweeps?
+            one giant antichain?) is in the post-mortem, not lost."""
+            m = self.obs.metrics
+            m.counter("drain_ticks", node=nid, mode=mode).inc()
+            m.histogram("drain_frontier_size", node=nid).observe(frontier)
+            if self.obs.flight is not None:
+                self.obs.flight.on_drain(nid, getattr(store, "store_id", -1),
+                                         mode, frontier)
+
+        node.drain_observer = drain_observer
 
         disp = getattr(node, "dispatcher", None)
         if disp is not None:
@@ -405,6 +442,8 @@ class Cluster:
                 m = self.obs.metrics
                 m.counter("fused_launches", node=nid, kind=kind).inc()
                 m.counter("fused_members", node=nid, kind=kind).inc(members)
+                if self.obs.flight is not None:
+                    self.obs.flight.on_fused(nid, kind, members, nq)
                 if self.trace is not None:
                     self.trace.record_fused(self.queue.now, nid, kind,
                                             members, nq)

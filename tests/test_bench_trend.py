@@ -1,0 +1,211 @@
+"""The r11 trajectory sentinel (tools/bench_trend.py).
+
+The tool's acceptance story is self-referential: run over the repo's own
+checked-in BENCH_r*.json artifacts it must FLAG the r05->r08
+``hot128_chain_drain_txns_per_sec`` collapse (23,008 -> 196 txn/s — the
+regression that motivated the tool, which slipped through because rounds
+r06/r07 emitted no artifact for any pairwise diff to straddle), and it
+must pass once tools/bench_waivers.json records the post-mortem verdict
+(a silent bench-platform change, ``# device=tpu`` -> ``# device=cpu``).
+
+Everything here is file parsing — no jax, no sim — so the whole module is
+fast tier-1.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import bench_trend  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# the self-proof: the checked-in trajectory
+# ---------------------------------------------------------------------------
+
+def test_flags_the_r05_r08_drain_collapse_without_waivers(capsys):
+    rc = bench_trend.main(["--dir", REPO, "--no-waivers"])
+    out = capsys.readouterr().out
+    assert rc == 2, "the known collapse must fail the unwaived gate"
+    assert "hot128_chain_drain_txns_per_sec: r05" in out
+    assert "REGRESSION" in out
+
+
+def test_passes_with_the_checked_in_waivers(capsys):
+    rc = bench_trend.main(["--dir", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, "every flagged step must carry a documented waiver"
+    assert "WAIVED" in out
+    assert "device=tpu" in out and "device=cpu" in out, \
+        "the drain waiver must record the platform-change verdict"
+
+
+def test_checked_in_waivers_all_match_real_steps(capsys):
+    """A waiver that matches nothing is dead documentation — every entry
+    must correspond to a step the walker actually flags."""
+    rounds = bench_trend.discover(REPO)
+    series = bench_trend.load_series(rounds)
+    violations = bench_trend.walk(series, 0.5, 0.5)
+    flagged = {(v["metric"], v["from"], v["to"]) for v in violations}
+    waivers = bench_trend.load_waivers(
+        os.path.join(REPO, "tools", "bench_waivers.json"))
+    assert waivers, "the waiver file must exist and be non-empty"
+    for w in waivers:
+        assert (w["metric"], w["from"], w["to"]) in flagged, \
+            f"stale waiver: {w['metric']} {w['from']}->{w['to']}"
+        assert len(w.get("reason", "")) > 40, \
+            "a waiver without a real post-mortem reason is not a waiver"
+
+
+def test_series_cover_the_documented_families():
+    """The sentinel must watch every family the issue names: headline,
+    config rows, vs_baseline, phase latencies, fast-path rate, index
+    counters — not just the headline."""
+    series = bench_trend.load_series(bench_trend.discover(REPO))
+    keys = set(series)
+    assert any(k.startswith("headline.") for k in keys)
+    assert "hot128_chain_drain_txns_per_sec" in keys
+    assert "hot_chain_drain_100k_ell_txns_per_sec" in keys
+    assert any(".vs_baseline" in k for k in keys)
+    assert any(".phase[" in k for k in keys)
+    assert any(".fast_path_rate" in k for k in keys)
+    assert any(k.startswith("index.") for k in keys)
+    # index counters other than download_bytes are drift-reported, not gated
+    assert series["index.download_bytes"]["dir"] == "down"
+    assert all(s["dir"] is None for k, s in series.items()
+               if k.startswith("index.") and "download_bytes" != k[6:])
+
+
+# ---------------------------------------------------------------------------
+# walker semantics on synthesized series
+# ---------------------------------------------------------------------------
+
+def _one_series(points, direction="up"):
+    return {"m": {"dir": direction, "points": points}}
+
+
+def test_walk_flags_drop_beyond_threshold_only():
+    ok = bench_trend.walk(_one_series([(1, 100.0), (2, 60.0)]), 0.5, 0.5)
+    assert ok == []
+    bad = bench_trend.walk(_one_series([(1, 100.0), (2, 49.0)]), 0.5, 0.5)
+    assert len(bad) == 1
+    assert bad[0]["from"] == "r01" and bad[0]["to"] == "r02"
+
+
+def test_walk_latency_direction_is_inverted():
+    worse = bench_trend.walk(
+        _one_series([(1, 10.0), (2, 21.0)], "down"), 0.5, 0.5)
+    assert len(worse) == 1, "latency doubling must flag"
+    better = bench_trend.walk(
+        _one_series([(1, 21.0), (2, 10.0)], "down"), 0.5, 0.5)
+    assert better == []
+
+
+def test_walk_spans_artifact_gaps():
+    """The r06/r07 lesson: missing rounds must not hide a cliff — the
+    step compares consecutive PRESENT points whatever their distance."""
+    v = bench_trend.walk(_one_series([(5, 23007.6), (8, 196.0)]), 0.5, 0.5)
+    assert len(v) == 1 and v[0]["from"] == "r05" and v[0]["to"] == "r08"
+
+
+def test_walk_skips_info_only_and_zero_base():
+    assert bench_trend.walk(
+        {"m": {"dir": None, "points": [(1, 100), (2, 1)]}}, 0.5, 0.5) == []
+    assert bench_trend.walk(_one_series([(1, 0), (2, 0)]), 0.5, 0.5) == []
+
+
+def test_metric_appearing_mid_trajectory_starts_clean():
+    v = bench_trend.walk(_one_series([(9, 5.0), (10, 5.1)]), 0.5, 0.5)
+    assert v == []
+
+
+def test_drift_notes_report_info_series_and_zero_base():
+    """The default output must not silently hide what it cannot gate: info
+    -only counter drift beyond threshold, and zero-base steps (e.g. a
+    phase p50 at the 0.0ms bucket floor regressing to 80ms — the gate
+    can't ratio it, but it must still print)."""
+    notes = bench_trend.drift_notes(
+        {"index.c": {"dir": None, "points": [(1, 100), (2, 5000)]}}, 0.5)
+    assert len(notes) == 1 and notes[0]["tag"] == "drift"
+    quiet = bench_trend.drift_notes(
+        {"index.c": {"dir": None, "points": [(1, 100), (2, 120)]}}, 0.5)
+    assert quiet == []
+    zb = bench_trend.drift_notes(
+        {"m.phase[apply].p50_ms": {"dir": "down",
+                                   "points": [(1, 0.0), (2, 80.0)]}}, 0.5)
+    assert len(zb) == 1 and zb[0]["tag"] == "zero-base"
+    # a step the walker CAN examine produces no note — no double report
+    assert bench_trend.drift_notes(
+        {"m": {"dir": "up", "points": [(1, 100.0), (2, 10.0)]}}, 0.5) == []
+    # an INFO counter appearing from a 0 base is a zero-base note too (a
+    # 0 -> 50,000 fallback-counter jump must not vanish from the output)
+    zc = bench_trend.drift_notes(
+        {"index.host_fallback_queries": {"dir": None,
+                                         "points": [(1, 0), (2, 50000)]}},
+        0.5)
+    assert len(zc) == 1 and zc[0]["tag"] == "zero-base"
+
+
+def test_waiver_matches_exact_step_only():
+    w = [{"metric": "m", "from": "r01", "to": "r02", "reason": "x"}]
+    hit = {"metric": "m", "from": "r01", "to": "r02"}
+    miss = {"metric": "m", "from": "r02", "to": "r03"}
+    assert bench_trend.match_waiver(hit, w) is w[0]
+    assert bench_trend.match_waiver(miss, w) is None
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over synthesized artifacts
+# ---------------------------------------------------------------------------
+
+def _write_artifact(dirpath, rnd, value, vs_baseline=None):
+    row = {"config": 3, "metric": "deep_drain", "value": value,
+           "unit": "txn/s"}
+    if vs_baseline is not None:
+        row["vs_baseline"] = vs_baseline
+    tail = "\n".join([
+        f"# CONFIG {json.dumps(row)}",
+        json.dumps({"metric": "headline_rate", "value": 100.0,
+                    "unit": "txn/s"}),
+    ])
+    path = os.path.join(dirpath, f"BENCH_r{rnd:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"tail": tail, "parsed": None}, f)
+    return path
+
+
+def test_e2e_regression_then_waiver(tmp_path, capsys):
+    d = str(tmp_path)
+    _write_artifact(d, 1, 1000.0)
+    _write_artifact(d, 2, 10.0)
+    rc = bench_trend.main(["--dir", d, "--no-waivers"])
+    assert rc == 2
+    wpath = os.path.join(d, "waivers.json")
+    with open(wpath, "w") as f:
+        json.dump({"waivers": [{"metric": "deep_drain", "from": "r01",
+                                "to": "r02", "reason": "synthesized"}]}, f)
+    rc = bench_trend.main(["--dir", d, "--waivers", wpath])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_e2e_vs_baseline_gated(tmp_path, capsys):
+    """The r11 drain-row contract: a platform flip moves raw txn/s AND
+    vs_baseline — the latter is gated even when the raw value is waived."""
+    d = str(tmp_path)
+    _write_artifact(d, 1, 1000.0, vs_baseline=1.5)
+    _write_artifact(d, 2, 900.0, vs_baseline=0.2)
+    rc = bench_trend.main(["--dir", d, "--no-waivers"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "deep_drain.vs_baseline" in out
+
+
+def test_e2e_needs_two_artifacts(tmp_path, capsys):
+    _write_artifact(str(tmp_path), 1, 1000.0)
+    assert bench_trend.main(["--dir", str(tmp_path)]) == 1

@@ -1,0 +1,249 @@
+"""The r11 black-box flight recorder (accord_tpu.obs.flight).
+
+Contracts under test:
+
+- ring buffers: bounded per node, oldest-evicted, sim-time stamped;
+- the anomaly-trigger matrix: watchdog_recover fires on the span event,
+  quarantine_escalation fires on the SECOND quarantine of the same
+  (node, store) — the ladder deepening, not a one-off fault —
+  phase_outlier fires only after ``min_samples`` observations and only
+  beyond ``2^margin x`` the phase's own observed max, and ``max_dumps``
+  suppresses (counts, never grows) everything past the bound;
+- post-mortem bundles: the triggering node's ring, the registry delta
+  since the previous dump, the per-store device gauges — sorted,
+  JSON-canonical;
+- determinism: same-seed burns export byte-identical bundles, INCLUDING
+  the device-fault nemesis leg (extends the burn determinism matrix);
+- the ACCORD_TPU_OBS=off escape hatch: the recorder never exists, the
+  burn stays green, protocol stats are unchanged — the black box is
+  never load-bearing (mirrored by the conftest canary on the tier-1).
+"""
+
+import json
+
+import pytest
+
+from accord_tpu.obs import Observability, enabled as obs_enabled
+from accord_tpu.obs.flight import TRIGGERS, FlightRecorder
+from accord_tpu.obs.metrics import MetricsRegistry
+from accord_tpu.obs.spans import SpanRecorder
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# ring buffers
+# ---------------------------------------------------------------------------
+
+def test_ring_is_bounded_and_evicts_oldest():
+    fr = FlightRecorder(Clock(), capacity=4)
+    for i in range(10):
+        fr.on_route(1, 0, "host", i)
+    ring = list(fr._ring(1))
+    assert len(ring) == 4
+    assert [ev["nq"] for ev in ring] == [6, 7, 8, 9]
+    assert fr.n_recorded == 10
+
+
+def test_rings_are_per_node():
+    fr = FlightRecorder(Clock(), capacity=4)
+    fr.on_route(1, 0, "host", 1)
+    fr.on_fused(2, "flush", 3, 12)
+    assert [ev["kind"] for ev in fr._ring(1)] == ["route"]
+    assert [ev["kind"] for ev in fr._ring(2)] == ["fused"]
+
+
+def test_events_carry_sim_time():
+    clk = Clock()
+    fr = FlightRecorder(clk)
+    clk.t = 123
+    fr.on_drain(1, 0, "device", 7)
+    ev = fr._ring(1)[-1]
+    assert ev == {"t": 123, "kind": "drain", "store": 0,
+                  "mode": "device", "frontier": 7}
+
+
+# ---------------------------------------------------------------------------
+# anomaly-trigger matrix
+# ---------------------------------------------------------------------------
+
+def test_watchdog_recover_triggers():
+    fr = FlightRecorder(Clock())
+    fr.on_txn_event(1, "[1,5,2(KW),1]", "deps_route")
+    assert len(fr) == 0
+    fr.on_txn_event(1, "[1,5,2(KW),1]", "watchdog_recover")
+    assert len(fr) == 1
+    assert fr.postmortems[0]["trigger"] == "watchdog_recover"
+    assert fr.postmortems[0]["attrs"]["txn"] == "[1,5,2(KW),1]"
+
+
+def test_quarantine_escalation_fires_on_second_same_store_only():
+    fr = FlightRecorder(Clock())
+    fr.on_fault(1, 0, "quarantine", "kernel_launch")
+    assert len(fr) == 0, "a one-off quarantine is the ladder working"
+    fr.on_fault(1, 1, "quarantine", "transfer")
+    assert len(fr) == 0, "a different store's first quarantine"
+    fr.on_fault(1, 0, "quarantine", "transfer")
+    assert len(fr) == 1, "the same store re-quarantined = escalation"
+    pm = fr.postmortems[0]
+    assert pm["trigger"] == "quarantine_escalation"
+    assert pm["attrs"]["quarantines"] == 2
+    # non-quarantine ladder events never count toward escalation
+    fr2 = FlightRecorder(Clock())
+    for ev in ("fallback", "reprobe", "restore", "compaction"):
+        fr2.on_fault(1, 0, ev)
+        fr2.on_fault(1, 0, ev)
+    assert len(fr2) == 0
+
+
+def test_phase_outlier_needs_samples_then_margin():
+    reg = MetricsRegistry()
+    fr = FlightRecorder(Clock(), metrics=reg, min_samples=8,
+                        outlier_margin=2)
+    h = reg.histogram("phase_micros", phase="preaccept")
+    for _ in range(7):
+        h.observe(100)
+    fr.on_span(1, "preaccept", "t1", 100_000)
+    assert len(fr) == 0, "below min_samples the detector stays quiet"
+    h.observe(100)                                  # 8th sample arms it
+    fr.on_span(1, "preaccept", "t2", 400)
+    assert len(fr) == 0, "4x the max is AT the 2^2 margin, not beyond"
+    fr.on_span(1, "preaccept", "t3", 401)
+    assert len(fr) == 1
+    pm = fr.postmortems[0]
+    assert pm["trigger"] == "phase_outlier"
+    assert pm["attrs"]["prior_max"] == 100 and pm["attrs"]["dur"] == 401
+
+
+def test_phase_outlier_never_fires_off_an_all_zero_distribution():
+    """A phase whose whole distribution is 0µs (completes within one
+    event-loop step) must not 'outlier' on every 1µs span — that would
+    burn max_dumps on noise and suppress the real anomalies."""
+    reg = MetricsRegistry()
+    fr = FlightRecorder(Clock(), metrics=reg, min_samples=4)
+    h = reg.histogram("phase_micros", phase="apply")
+    for _ in range(8):
+        h.observe(0)
+    fr.on_span(1, "apply", "t1", 1)
+    assert len(fr) == 0
+
+
+def test_max_dumps_suppresses_not_grows():
+    fr = FlightRecorder(Clock(), max_dumps=2)
+    for i in range(5):
+        fr.on_txn_event(1, f"t{i}", "watchdog_recover")
+    assert len(fr) == 2
+    assert fr.suppressed == 3
+    assert fr.export()["suppressed"] == 3
+
+
+def test_trigger_names_are_the_documented_matrix():
+    assert set(TRIGGERS) == {"watchdog_recover", "quarantine_escalation",
+                             "phase_outlier"}
+
+
+# ---------------------------------------------------------------------------
+# post-mortem bundle contents
+# ---------------------------------------------------------------------------
+
+def test_bundle_captures_ring_registry_delta_and_gauges():
+    clk = Clock()
+    reg = MetricsRegistry()
+    fr = FlightRecorder(clk, metrics=reg)
+    fr.gauge_source = lambda: {"1/0": {"n_dense_queries": 4},
+                               "1/1": {"n_dense_queries": 1}}
+    reg.counter("deps_route_queries", node=1, route="dense").inc(4)
+    fr.on_route(1, 0, "dense", 4)
+    clk.t = 500
+    pm = fr.trigger(1, "watchdog_recover", txn="t0")
+    assert pm["t"] == 500 and pm["seq"] == 0
+    assert [ev["kind"] for ev in pm["ring"]] == ["route"]
+    assert pm["metrics_delta"] == {
+        "deps_route_queries{node=1,route=dense}": 4}
+    assert list(pm["device_gauges"]) == ["1/0", "1/1"]
+    # the delta base advances: a second dump sees only what changed since
+    reg.counter("deps_route_queries", node=1, route="host").inc()
+    pm2 = fr.trigger(1, "watchdog_recover", txn="t1")
+    assert pm2["seq"] == 1
+    assert pm2["metrics_delta"] == {
+        "deps_route_queries{node=1,route=host}": 1}
+
+
+def test_export_json_is_canonical():
+    fr = FlightRecorder(Clock())
+    fr.on_txn_event(1, "t0", "watchdog_recover")
+    doc = json.loads(fr.export_json())
+    assert doc["recorded"] == 1 and len(doc["postmortems"]) == 1
+    # canonical: sorted keys, no whitespace — byte-stable across runs
+    assert fr.export_json() == json.dumps(
+        fr.export(), sort_keys=True, separators=(",", ":"))
+
+
+def test_span_recorder_tap_without_flight_is_safe():
+    sp = SpanRecorder(lambda: 0, None)
+    assert sp.flight is None
+    sp.begin_txn("t", 1)
+    span = sp.begin("t", "preaccept", 1)
+    sp.end(span)
+    sp.event("t", "watchdog_recover")
+    sp.end_txn("t", "ok")                    # every tap is one None check
+
+
+# ---------------------------------------------------------------------------
+# burn-level determinism (extends the matrix in test_burn.py)
+# ---------------------------------------------------------------------------
+
+def test_same_seed_burns_export_identical_bundles():
+    if not obs_enabled():
+        pytest.skip("ACCORD_TPU_OBS=off canary run")
+    from accord_tpu.sim.burn import run_burn
+    a = run_burn(7, n_ops=60, n_keys=8)
+    b = run_burn(7, n_ops=60, n_keys=8)
+    assert a.flight_export is not None
+    assert a.flight_export == b.flight_export, \
+        "same-seed flight post-mortems must be byte-identical"
+    json.loads(a.flight_export)              # and valid canonical JSON
+    assert a.flight_postmortems == b.flight_postmortems
+
+
+def test_device_fault_leg_bundles_deterministic():
+    """The nemesis leg: injected device faults produce fault-ladder ring
+    events and (when the ladder deepens) escalation dumps — all of it a
+    pure function of the seed."""
+    if not obs_enabled():
+        pytest.skip("ACCORD_TPU_OBS=off canary run")
+    from accord_tpu.sim.burn import run_burn
+    a = run_burn(5, n_ops=60, device_faults="kernel_launch")
+    b = run_burn(5, n_ops=60, device_faults="kernel_launch")
+    assert a.flight_export == b.flight_export
+    assert a.ops_unresolved == 0
+
+
+def test_obs_off_burn_green_without_recorder(monkeypatch):
+    """The conftest-canary contract at module scope: under
+    ACCORD_TPU_OBS=off the recorder never exists and nothing downstream
+    misses it."""
+    from accord_tpu.sim.burn import run_burn
+    on = run_burn(3, n_ops=20)
+    monkeypatch.setenv("ACCORD_TPU_OBS", "off")
+    off = run_burn(3, n_ops=20)
+    assert off.flight_export is None and off.flight_postmortems == 0
+    assert off.ops_unresolved == 0
+    assert on.stats == off.stats, \
+        "the flight recorder changed the protocol stream"
+
+
+def test_observability_off_has_no_flight(monkeypatch):
+    monkeypatch.setenv("ACCORD_TPU_OBS", "off")
+    o = Observability(now=lambda: 0)
+    assert o.flight is None and o.spans is None
+    monkeypatch.setenv("ACCORD_TPU_OBS", "on")
+    o = Observability(now=lambda: 0)
+    assert o.flight is not None
+    assert o.spans.flight is o.flight, "the span tap must be wired"

@@ -20,7 +20,15 @@ covers:
 - the r10 download-byte counters from the headline ``# index:`` line
   (``download_bytes`` / ``download_bytes_padded``): the two-stage
   compacted transfer's actual bytes are gated lower-is-better, and the
-  compaction ratio prints for every artifact that carries them.
+  compaction ratio prints for every artifact that carries them,
+- the r11 ``vs_baseline`` columns on every config row both sides carry
+  them (higher is better, base threshold) — the platform-independent
+  health signal the drain rows were missing when the r05->r08 collapse
+  slipped through,
+- metrics present on only one side: "NEW" rows print as the baseline a
+  future trend starts from, "GONE" rows print as a question — a deleted
+  metric can be a regression hiding by deletion.  Neither fails the
+  pairwise gate (``tools/bench_trend.py`` owns cross-round series).
 
 Exit status: 0 = no regression, 1 = usage/parse error, 2 = regression
 beyond threshold.  Every comparison prints either way — the tool is the
@@ -50,9 +58,11 @@ def parse_index_counters(text):
     return {}
 
 
-def parse_artifact(path):
+def parse_artifact(path, strict=True):
     """(headline dict, {metric_name: config_row}, index counters) from a
-    driver artifact or raw bench output."""
+    driver artifact or raw bench output.  ``strict=False`` returns a None
+    headline instead of exiting (bench_trend trends artifacts that predate
+    the r06 last-line-headline contract — BENCH_r05 lost its headline)."""
     with open(path) as f:
         text = f.read()
     headline, configs = None, {}
@@ -80,7 +90,9 @@ def parse_artifact(path):
             if row.get("metric") and "config" not in row:
                 headline = row
     if headline is None or headline.get("value") is None:
-        raise SystemExit(f"error: no headline metric in {path}")
+        if strict:
+            raise SystemExit(f"error: no headline metric in {path}")
+        headline = None
     return headline, configs, parse_index_counters(text)
 
 
@@ -157,6 +169,15 @@ def main(argv=None):
             m, o.get("value"), n.get("value"),
             args.latency_threshold if latency else args.threshold,
             lower_is_better=latency))
+        # vs_baseline is the platform-independent health signal (the r11
+        # drain-forensics lesson: a silent bench-platform flip moves raw
+        # txn/s 100x but moves vs_baseline only by the hardware's honest
+        # edge) — gated higher-is-better wherever both sides carry it
+        if o.get("vs_baseline") is not None \
+                and n.get("vs_baseline") is not None:
+            failures.append(check(f"{m}.vs_baseline",
+                                  o["vs_baseline"], n["vs_baseline"],
+                                  args.threshold))
         # r09 observability fields (phase p99s lower-better, fast-path
         # rate higher-better), gated at 2x threshold: the histograms are
         # log-bucketed, so single-bucket jitter is expected
@@ -171,6 +192,16 @@ def main(argv=None):
             failures.append(check(f"{m}.fast_path_rate",
                                   o["fast_path_rate"], n["fast_path_rate"],
                                   2 * args.threshold))
+    # a metric only one side carries is NEVER silently dropped: "new" rows
+    # are where tomorrow's regressions start their series (bench_trend picks
+    # them up from here), and a "gone" row may be a regression hiding by
+    # deletion — both print loudly, neither fails this pairwise gate
+    for m in sorted(set(new_cfg) - set(old_cfg)):
+        print(f"  {m:58s} {'(new)':>12} -> "
+              f"{new_cfg[m].get('value')!r:>12}  NEW (baseline for trend)")
+    for m in sorted(set(old_cfg) - set(new_cfg)):
+        print(f"  {m:58s} {old_cfg[m].get('value')!r:>12} -> "
+              f"{'(gone)':>12}  GONE (was this intentional?)")
     failures = [f for f in failures if f]
     if failures:
         print(f"\nFAIL: {len(failures)} regression(s):", file=sys.stderr)

@@ -5,12 +5,19 @@
 # fault runs must replay exactly) or deps_found divergence from the
 # fault-free baseline (the degradation ladder must be invisible to the
 # protocol).  Sized to stay well inside the tier-1 870s budget.
+#
+# r11 forensics: any failing leg dumps a post-mortem file (metrics
+# snapshots of both runs + the flight-recorder bundles + span exports) to
+# $FAULT_MATRIX_OUT (default /tmp) — the nondeterminism diff arrives WITH
+# the causal context, instead of a bare stat-key list.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 exec env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
     python - <<'PY'
+import json
+import os
 import sys
 
 from accord_tpu.sim.burn import run_burn
@@ -19,6 +26,26 @@ from accord_tpu.utils.faults import DEVICE_FAULT_KINDS
 SEEDS = (0, 5, 11)
 KINDS = sorted(DEVICE_FAULT_KINDS) + ["all"]
 N_OPS = 60
+OUT_DIR = os.environ.get("FAULT_MATRIX_OUT", "/tmp")
+
+
+def dump_postmortem(seed, kind, problems, runs):
+    """One failing leg's forensic bundle: every run's metrics snapshot,
+    flight post-mortems and span export, plus the problem list."""
+    bundle = {"seed": seed, "kind": kind, "problems": problems, "runs": {}}
+    for tag, r in runs.items():
+        bundle["runs"][tag] = {
+            "stats": dict(r.stats),
+            "metrics_snapshot": r.metrics_snapshot,
+            "flight": json.loads(r.flight_export)
+            if r.flight_export else None,
+            "spans": json.loads(r.span_export) if r.span_export else None,
+        }
+    os.makedirs(OUT_DIR, exist_ok=True)
+    path = os.path.join(OUT_DIR, f"fault_matrix_{seed}_{kind}.json")
+    with open(path, "w") as f:
+        json.dump(bundle, f, sort_keys=True, indent=1)
+    return path
 
 failures = []
 for seed in SEEDS:
@@ -51,6 +78,9 @@ for seed in SEEDS:
         if problems:
             failures.append(f"seed {seed} kind {kind}: " + "; ".join(problems))
             line += "  <-- " + "; ".join(problems)
+            path = dump_postmortem(seed, kind, problems,
+                                   {"base": base, "a": a, "b": b})
+            line += f"  [post-mortem: {path}]"
         print(line, flush=True)
 
 if failures:
