@@ -17,12 +17,14 @@ The workload is Maelstrom's list-append ``txn``: ops ``["r", k, null]`` and
 from __future__ import annotations
 
 import hashlib
+import heapq
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import api, wire
 from ..coordinate.errors import Timeout
 from ..impl.config_service import AbstractConfigurationService
 from ..local.node import Node
+from ..primitives.datum import datum_from_json, datum_to_json
 from ..primitives.keys import IntKey, Keys, Range, Ranges
 from ..primitives.txn import Txn
 from ..primitives.timestamp import TxnKind
@@ -34,9 +36,14 @@ from ..utils.random_source import RandomSource
 TOKEN_SPACE = 1 << 32
 # ref: Main.java uses a 1s sweeper; a cold JAX node stalls for seconds per
 # first-compile of each kernel shape, so the wall-clock bound here is wider
-# (the sim cluster keeps its own simulated-time timeouts)
+# (the sim cluster keeps its own simulated-time timeouts); the TCP serving
+# surface (accord_tpu.net.server) passes a much tighter bound
 REQUEST_TIMEOUT_MICROS = 20_000_000
 SWEEP_INTERVAL_MICROS = 200_000
+# small deterministic per-request timeout jitter (same bound as the sim
+# NodeSink's Cluster.timeout_jitter): co-scheduled fan-out requests must
+# not expire at the same instant and fire as a synchronized retry storm
+TIMEOUT_JITTER_MICROS = 4096
 
 
 def node_name_to_id(name: str) -> int:
@@ -68,22 +75,34 @@ def build_maelstrom_topology(node_ids: List[int], shards: int = 16,
 
 
 class _Pending:
-    __slots__ = ("callback", "to", "deadline")
+    __slots__ = ("callback", "to", "deadline", "entry")
 
-    def __init__(self, callback, to: int, deadline: int):
+    def __init__(self, callback, to: int, deadline: int, entry: List):
         self.callback = callback
         self.to = to
         self.deadline = deadline
+        # the pending-timeout heap entry ([deadline, msg_id]); tombstoned
+        # (msg_id -> None) the moment the callback resolves
+        self.entry = entry
 
 
 class MaelstromSink(api.MessageSink):
     """MessageSink over Maelstrom bodies (ref: Main.StdoutSink).  Replies
-    correlate on msg_id; unanswered callbacks time out via a sweeper."""
+    correlate on msg_id; unanswered callbacks time out via a sweeper over
+    a deadline HEAP whose entries are tombstoned the moment a reply
+    resolves — the r07 NodeSink fixes ported here (sim/cluster.py:128-159):
+    a completed request must not leave a dead callback reachable for the
+    full timeout horizon, and per-request deterministic jitter (dedicated
+    stream, protocol RNG untouched) desynchronizes co-scheduled timeouts
+    so they cannot fire as one retry storm."""
 
-    def __init__(self, process: "MaelstromProcess"):
+    def __init__(self, process: "MaelstromProcess",
+                 jitter: Optional[RandomSource] = None):
         self.process = process
         self._next_msg_id = 0
         self.pending: Dict[int, _Pending] = {}
+        self._timeouts: List[List] = []   # [deadline, msg_id] min-heap
+        self._jitter = jitter
 
     def _msg_id(self) -> int:
         self._next_msg_id += 1
@@ -98,16 +117,31 @@ class MaelstromSink(api.MessageSink):
 
     def send_with_callback(self, to: int, request, callback) -> None:
         msg_id = self._msg_id()
-        timeout = REQUEST_TIMEOUT_MICROS
+        timeout = self.process.request_timeout_micros
         # barrier reads (commit-fused reads, WaitOnCommit) reply only when
         # the replica's drain releases them — give them room before declaring
         # the replica dead (same policy as the sim NodeSink)
         if getattr(request, "is_slow_read", False):
             timeout *= 10
-        self.pending[msg_id] = _Pending(
-            callback, to, self.process.now_micros() + timeout)
+        if self._jitter is not None:
+            timeout += self._jitter.next_int(TIMEOUT_JITTER_MICROS)
+        deadline = self.process.now_micros() + timeout
+        # [deadline, tiebreak, msg_id]: the tiebreak copy stays immutable
+        # so equal-deadline entries never compare a tombstoned None
+        entry = [deadline, msg_id, msg_id]
+        self.pending[msg_id] = _Pending(callback, to, deadline, entry)
+        heapq.heappush(self._timeouts, entry)
         self._emit(to, {"type": "accord_req", "msg_id": msg_id,
                         "payload": wire.encode(request)})
+
+    def _resolve(self, msg_id: int) -> Optional[_Pending]:
+        """Pop a pending request and tombstone its heap entry in place
+        (the sweeper skips tombstones; no dead callback is held for the
+        remaining horizon)."""
+        p = self.pending.pop(msg_id, None)
+        if p is not None:
+            p.entry[2] = None
+        return p
 
     def reply(self, to: int, reply_context, reply) -> None:
         if reply_context is None:
@@ -129,27 +163,34 @@ class MaelstromSink(api.MessageSink):
                         "error": repr(failure)})
 
     def sweep(self) -> None:
+        """Fire every expired pending timeout: pop the deadline heap up to
+        ``now``, skipping tombstoned entries (already resolved) — O(expired
+        + resolved) per sweep instead of O(all pending)."""
         now = self.process.now_micros()
-        expired = [m for m, p in self.pending.items() if p.deadline <= now]
-        for m in expired:
-            p = self.pending.pop(m)
+        while self._timeouts and self._timeouts[0][0] <= now:
+            _deadline, _tie, msg_id = heapq.heappop(self._timeouts)
+            if msg_id is None:
+                continue   # tombstone: resolved before its deadline
+            p = self.pending.pop(msg_id, None)
+            if p is None:
+                continue
             p.callback.on_failure(p.to, Timeout(msg=f"timeout to {p.to}"))
 
     # -- inbound ------------------------------------------------------------
     def on_response(self, from_id: int, in_reply_to: int, reply) -> None:
         p = self.pending.get(in_reply_to)
         if p is None:
-            return
+            return   # idempotent: late duplicate / reply racing a timeout
         # multi-reply exchanges: a fused Stable+Read replies CommitOk
         # (non-final) then ReadOk — keep the callback until the final reply
         final = reply.is_final() if hasattr(reply, "is_final") else True
         if final:
-            del self.pending[in_reply_to]
+            self._resolve(in_reply_to)
         p.callback.on_success(from_id, reply)
 
     def on_failure_response(self, from_id: int, in_reply_to: int,
                             error: str) -> None:
-        p = self.pending.pop(in_reply_to, None)
+        p = self._resolve(in_reply_to)
         if p is not None:
             p.callback.on_failure(from_id, RuntimeError(error))
 
@@ -192,7 +233,8 @@ class MaelstromProcess:
                  shards: int = 16,
                  device_mode: Optional[bool] = None,
                  durability: bool = True,
-                 obs=None):
+                 obs=None,
+                 request_timeout_micros: Optional[int] = None):
         self._emit_raw = emit
         self.scheduler = scheduler
         self.now_micros = now_micros
@@ -203,6 +245,13 @@ class MaelstromProcess:
         # run so bench config rows read phase latencies + fast-path rate)
         self.obs = obs
         self.enable_durability = durability
+        # sink-owned request timeout (the TCP serving surface tightens it;
+        # the Maelstrom default stays wide for cold-compile stalls)
+        self.request_timeout_micros = (request_timeout_micros
+                                       or REQUEST_TIMEOUT_MICROS)
+        # admission gate in front of coordinate (accord_tpu.net.admission;
+        # None = admit everything — the sim runner and Maelstrom harness)
+        self.admission = None
         self.name: Optional[str] = None
         self.node: Optional[Node] = None
         self.sink: Optional[MaelstromSink] = None
@@ -265,7 +314,10 @@ class MaelstromProcess:
             ids.append(nid)
         my_id = node_name_to_id(self.name)
         topology = build_maelstrom_topology(ids, shards=self.shards)
-        self.sink = MaelstromSink(self)
+        # timeout jitter on a dedicated deterministic stream seeded from
+        # the node id — the protocol RandomSource below is untouched
+        self.sink = MaelstromSink(self, jitter=RandomSource(
+            0x51D ^ (my_id << 12)))
         self.node = Node(
             node_id=my_id, message_sink=self.sink,
             config_service=StaticConfigService(topology),
@@ -309,6 +361,46 @@ class MaelstromProcess:
     def _handle_txn(self, src: str, body: dict) -> None:
         ops = body["txn"]
         msg_id = body["msg_id"]
+        # admission gate (accord_tpu.net.admission) FIRST: a shed must be
+        # the cheapest possible outcome — no token hashing, no datum
+        # decode, no coordination state — just a fast, explicit Overloaded
+        # wire error (Maelstrom code 11, temporarily-unavailable) the
+        # client sink surfaces for retry-with-backoff
+        gate = self.admission
+        if gate is not None:
+            admitted, reason, retry_ms = gate.try_admit()
+            if not admitted:
+                self._reply_client(src, msg_id, {
+                    "type": "error", "code": 11, "text": "overloaded",
+                    "overloaded": True, "reason": reason,
+                    "retry_after_ms": retry_ms})
+                return
+        t_admit = self.now_micros()
+        released = [False]
+
+        def release_once(ok: bool, record: bool = True) -> None:
+            # at-most-once: on_done may have already released when a
+            # later exception propagates back through _handle_txn.
+            # record=False frees the slot without feeding the AIMD latency
+            # window — the instant error paths would otherwise teach the
+            # controller the node is microsecond-fast under poison traffic
+            if gate is not None and not released[0]:
+                released[0] = True
+                gate.release(self.now_micros() - t_admit if record else None,
+                             ok=ok)
+
+        try:
+            self._coordinate_txn(src, msg_id, ops, release_once)
+        except BaseException:
+            # any synchronous failure between admit and the coordination's
+            # own on_done (malformed op shapes, unhashable keys, a raising
+            # coordinate) must free the admission slot — a leaked slot is
+            # permanent and admit_max of them wedges the node at 100% shed
+            release_once(False, record=False)
+            raise
+
+    def _coordinate_txn(self, src: str, msg_id: int, ops,
+                        release_once) -> None:
         read_tokens: List[int] = []
         appends: Dict[int, tuple] = {}
         for op in ops:
@@ -317,8 +409,11 @@ class MaelstromProcess:
             if f == "r":
                 read_tokens.append(t)
             elif f == "append":
-                appends[t] = appends.get(t, ()) + (op[2],)
+                # multi-type datums (ref: maelstrom/Datum.java): string/
+                # long/double are native JSON; {"hash": n} becomes DatumHash
+                appends[t] = appends.get(t, ()) + (datum_from_json(op[2]),)
             else:
+                release_once(False, record=False)
                 self._reply_client(src, msg_id, {
                     "type": "error", "code": 10,
                     "text": f"unsupported op {f}"})
@@ -331,6 +426,9 @@ class MaelstromProcess:
                   KVUpdate(appends) if appends else None, KVQuery())
 
         def on_done(result, failure):
+            # the released duration IS the txn root span (admission ->
+            # client reply) — the admission controller's p99 signal
+            release_once(failure is None)
             if failure is not None:
                 # retryable per Maelstrom error semantics (the checker treats
                 # it as an indeterminate op, ref: MaelstromReply error paths)
@@ -343,7 +441,8 @@ class MaelstromProcess:
                 f, k = op[0], op[1]
                 t = token_of(k)
                 if f == "r":
-                    pre = list(result.reads.get(t, ()))
+                    pre = [datum_to_json(v)
+                           for v in result.reads.get(t, ())]
                     # intra-txn visibility: a read after an append in the
                     # same txn observes it (Elle list-append model)
                     out_ops.append(["r", k, pre + appended_so_far.get(t, [])])

@@ -143,13 +143,20 @@ class MaelstromRunner:
                      verify: bool = True,
                      keys_per_txn: Optional[int] = None,
                      zipf_skew: Optional[float] = None,
-                     spread_ring: bool = False) -> RunResult:
+                     spread_ring: bool = False,
+                     value_kinds: Optional[tuple] = None) -> RunResult:
         """``keys_per_txn`` pins the txn width (default 1..3 random);
         ``zipf_skew`` draws keys Zipf-distributed over [0, n_keys) —
         configs[1]'s 4-key multi-partition Zipf-0.9 shape.
         ``spread_ring`` strides key values across the whole token ring so
         an N-key space actually lands on every shard (small ints all hash
-        into shard 0 otherwise — a 'multi-partition' workload must be)."""
+        into shard 0 otherwise — a 'multi-partition' workload must be).
+        ``value_kinds`` cycles appended values through the reference's
+        datum kinds (subset of ("long", "string", "double", "hash");
+        default None keeps plain unique ints) — values cross the client
+        JSON boundary in wire form ({"hash": n} for HASH) and the verifier
+        compares their canonical decoded forms."""
+        from ..primitives.datum import datum_from_json
         wl = self.rs.fork()
         verifier = StrictSerializabilityVerifier()
         next_val = [0]
@@ -160,6 +167,25 @@ class MaelstromRunner:
             k = (wl.next_zipf(n_keys, zipf_skew) if zipf_skew is not None
                  else wl.next_int(n_keys))
             return k * stride
+
+        def make_value(i: int):
+            """(client-JSON form, canonical form) for unique value #i —
+            mixed datum kinds keep global uniqueness because ``i`` is
+            unique and the kind is a function of i."""
+            if not value_kinds:
+                return i, i
+            kind = value_kinds[i % len(value_kinds)]
+            if kind == "long":
+                vj = (1 << 33) + i       # past int32: a real 64-bit long
+            elif kind == "string":
+                vj = f"s{i}"
+            elif kind == "double":
+                vj = i + 0.5
+            elif kind == "hash":
+                vj = {"hash": i}
+            else:
+                raise ValueError(f"unknown datum kind {kind!r}")
+            return vj, datum_from_json(vj)
 
         def submit(i: int):
             node = self.names[wl.next_int(len(self.names))]
@@ -180,8 +206,8 @@ class MaelstromRunner:
             for k in keys:
                 if wl.decide(0.6):
                     next_val[0] += 1
-                    v = next_val[0]
-                    ops.append(["append", k, v])
+                    vj, v = make_value(next_val[0])
+                    ops.append(["append", k, vj])
                     writes[token_of(k)] = writes.get(token_of(k), ()) + (v,)
                 else:
                     ops.append(["r", k, None])
@@ -202,7 +228,9 @@ class MaelstromRunner:
                 for op in body["txn"]:
                     if op[0] == "r":
                         t = token_of(op[1])
-                        vals = tuple(op[2])
+                        # canonical datum forms: the store and the writes
+                        # census hold decoded values ({"hash": n} -> DatumHash)
+                        vals = tuple(datum_from_json(v) for v in op[2])
                         # strip intra-txn own-appends suffix: the verifier
                         # models reads as pre-state
                         own = writes.get(t, ())

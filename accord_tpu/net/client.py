@@ -1,0 +1,255 @@
+"""Client-side sink for the TCP serving surface.
+
+One :class:`ClusterClient` holds a connection per node, correlates replies
+by ``in_reply_to``, and surfaces outcomes with the semantics the admission
+layer defines:
+
+- ``txn_ok``   -> the reply body (commit latency is the caller's clock);
+- ``error`` with ``overloaded: true`` -> raises :class:`Overloaded` —
+  DISTINCT from failure, so callers retry with backoff
+  (``submit_retry``) instead of recording an indeterminate op;
+- other ``error`` bodies -> :class:`TxnFailed`;
+- no reply within the client timeout -> ``asyncio.TimeoutError``.
+
+Idempotent reply dispatch: a reply racing a timeout (or arriving twice
+after a server-side reconnect) resolves the pending future at most once;
+any further copy increments ``duplicate_replies`` — the kill-9 recovery
+test asserts that stays zero.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.random_source import RandomSource
+from .admission import Overloaded
+from .framing import FrameDecoder, encode_frame
+
+
+class TxnFailed(RuntimeError):
+    """Server replied with a non-overload error body (retryable per
+    Maelstrom semantics — the op is indeterminate)."""
+
+    def __init__(self, body: dict):
+        super().__init__(body.get("text", "error"))
+        self.body = body
+
+
+class NodeConnection:
+    """One client connection to one node; replies resolve futures keyed on
+    in_reply_to, duplicates counted, never double-resolved."""
+
+    # reply-id memory horizon: a genuine duplicate arrives within the
+    # request/timeout horizon, so remembering the most recent ids keeps
+    # the duplicate census exact while bounding a long-lived client's
+    # memory (a soak at ~100 txn/s would otherwise grow the set forever)
+    SEEN_CAP = 65536
+
+    def __init__(self, name: str, host: str, port: int, src: str):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.src = src
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._seen_replies: set = set()
+        self._seen_order: deque = deque()
+        self._task: Optional[asyncio.Task] = None
+        self.duplicate_replies = 0
+
+    def _mark_seen(self, irt) -> None:
+        if irt in self._seen_replies:
+            return
+        self._seen_replies.add(irt)
+        self._seen_order.append(irt)
+        while len(self._seen_order) > self.SEEN_CAP:
+            self._seen_replies.discard(self._seen_order.popleft())
+
+    async def connect(self) -> None:
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port)
+        self._task = asyncio.get_event_loop().create_task(self._read_loop())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.writer is not None:
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+
+    async def _read_loop(self) -> None:
+        decoder = FrameDecoder()
+        try:
+            while True:
+                chunk = await self.reader.read(65536)
+                if not chunk:
+                    break
+                for packet in decoder.feed(chunk):
+                    self._on_reply(packet.get("body") or {})
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass
+        # connection gone: fail everything still pending on it
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError(f"{self.name} closed"))
+        self._pending.clear()
+
+    def _on_reply(self, body: dict) -> None:
+        irt = body.get("in_reply_to")
+        if irt is None:
+            return
+        fut = self._pending.pop(irt, None)
+        if fut is None:
+            # no pending future: either a previous copy resolved it, or
+            # the client-side timeout already gave up on this msg_id.
+            # EITHER WAY this delivery is now on record — a further copy
+            # of the same reply is a genuine server-side duplicate and
+            # must count (the kill-9/overload tests assert zero)
+            if irt in self._seen_replies:
+                self.duplicate_replies += 1
+            else:
+                self._mark_seen(irt)
+            return
+        self._mark_seen(irt)
+        if not fut.done():
+            fut.set_result(body)
+
+    async def request(self, body: dict, msg_id: int,
+                      timeout: float) -> dict:
+        body = dict(body)
+        body["msg_id"] = msg_id
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[msg_id] = fut
+        self.writer.write(encode_frame(
+            {"src": self.src, "dest": self.name, "body": body}))
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msg_id, None)
+
+
+class ClusterClient:
+    """Round-robin client over every node of a serving cluster."""
+
+    def __init__(self, addrs: List[Tuple[str, str, int]], src: str = "c1",
+                 timeout: float = 10.0, retry_seed: int = 1):
+        self.addrs = addrs
+        self.src = src
+        self.timeout = timeout
+        self.conns: Dict[str, NodeConnection] = {}
+        self._msg_id = 0
+        self._rr = 0
+        self._backoff = RandomSource(retry_seed)
+        self.n_ok = 0
+        self.n_overloaded = 0
+        self.n_failed = 0
+        self.n_timeout = 0
+        self.n_retries = 0
+
+    def next_msg_id(self) -> int:
+        self._msg_id += 1
+        return self._msg_id
+
+    async def connect(self) -> None:
+        for name, host, port in self.addrs:
+            conn = NodeConnection(name, host, port, self.src)
+            await conn.connect()
+            self.conns[name] = conn
+
+    async def close(self) -> None:
+        for conn in self.conns.values():
+            await conn.close()
+
+    def duplicate_replies(self) -> int:
+        return sum(c.duplicate_replies for c in self.conns.values())
+
+    def _pick(self, node: Optional[str]) -> NodeConnection:
+        if node is not None:
+            return self.conns[node]
+        names = sorted(self.conns)
+        conn = self.conns[names[self._rr % len(names)]]
+        self._rr += 1
+        return conn
+
+    # -- verbs ----------------------------------------------------------------
+    async def submit(self, ops: list, node: Optional[str] = None,
+                     timeout: Optional[float] = None) -> dict:
+        """One list-append txn.  Raises Overloaded on an admission shed,
+        TxnFailed on other error bodies, TimeoutError on silence."""
+        conn = self._pick(node)
+        try:
+            body = await conn.request({"type": "txn", "txn": ops},
+                                      self.next_msg_id(),
+                                      timeout or self.timeout)
+        except asyncio.TimeoutError:
+            self.n_timeout += 1
+            raise
+        if body.get("type") == "txn_ok":
+            self.n_ok += 1
+            return body
+        if body.get("overloaded"):
+            self.n_overloaded += 1
+            raise Overloaded(retry_after_ms=body.get("retry_after_ms", 100),
+                             reason=body.get("reason", "inflight"))
+        self.n_failed += 1
+        raise TxnFailed(body)
+
+    async def submit_retry(self, ops: list, node: Optional[str] = None,
+                           retries: int = 8,
+                           timeout: Optional[float] = None) -> dict:
+        """Retry-with-backoff around Overloaded sheds (and transient
+        timeouts/failures): capped exponential from the server's
+        retry_after hint, with jitter so a shed storm does not retry in
+        lockstep."""
+        delay_ms = 25.0
+        for attempt in range(retries + 1):
+            try:
+                return await self.submit(ops, node=node, timeout=timeout)
+            except Overloaded as exc:
+                delay_ms = max(delay_ms, float(exc.retry_after_ms))
+            except (TxnFailed, asyncio.TimeoutError, ConnectionError,
+                    KeyError):
+                pass
+            if attempt == retries:
+                break
+            self.n_retries += 1
+            jitter = self._backoff.next_int(max(int(delay_ms / 2), 1))
+            await asyncio.sleep((delay_ms + jitter) / 1000.0)
+            delay_ms = min(delay_ms * 2, 2000.0)
+            node = None   # spread retries across the cluster
+        raise TxnFailed({"text": f"exhausted {retries} retries"})
+
+    async def ping(self, node: str, timeout: float = 5.0) -> dict:
+        return await self.conns[node].request(
+            {"type": "ping"}, self.next_msg_id(), timeout)
+
+    async def stats(self, node: str, timeout: float = 5.0) -> dict:
+        body = await self.conns[node].request(
+            {"type": "stats"}, self.next_msg_id(), timeout)
+        return body.get("stats") or {}
+
+    async def dump(self, node: str, timeout: float = 10.0) -> dict:
+        return await self.conns[node].request(
+            {"type": "dump"}, self.next_msg_id(), timeout)
+
+    async def reconnect(self, node: str) -> None:
+        """Re-dial one node (after a kill/restart)."""
+        old = self.conns.get(node)
+        if old is not None:
+            await old.close()
+        name, host, port = next(a for a in self.addrs if a[0] == node)
+        conn = NodeConnection(name, host, port, self.src)
+        await conn.connect()
+        # carry the dedupe census across the re-dial: duplicates are a
+        # cluster property the kill-9 test asserts on
+        conn.duplicate_replies = old.duplicate_replies if old else 0
+        self.conns[node] = conn

@@ -1,0 +1,71 @@
+"""Length-prefixed JSON framing for the TCP serving surface.
+
+One frame = 4-byte big-endian payload length + UTF-8 JSON payload (the same
+``{src, dest, body}`` packet dicts the Maelstrom adapter exchanges as
+stdin/stdout lines).  The decoder is a plain byte-stream state machine so a
+frame survives ANY segmentation the kernel chooses — partial reads mid-
+header, mid-payload, or many frames coalesced into one read — and the
+golden-frame test asserts byte-identical round trips over a real loopback
+socket under all three.
+
+A frame larger than ``MAX_FRAME`` is a protocol violation (a desynced or
+hostile peer), surfaced as :class:`FrameError` so the connection layer can
+drop the link instead of allocating unboundedly.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import List
+
+_LEN = struct.Struct(">I")
+
+# largest legal payload: generously above any protocol message (a full
+# CheckStatusOk with writes), far below anything that smells like reading
+# TLS/HTTP bytes as a length prefix
+MAX_FRAME = 16 * 1024 * 1024
+
+
+class FrameError(ValueError):
+    """Framing-layer protocol violation (oversized/garbage length)."""
+
+
+def encode_frame(packet: dict) -> bytes:
+    """One packet dict -> length-prefixed wire bytes.  Encoding is plain
+    ``json.dumps`` with compact separators; key order is preserved, so
+    decode -> re-encode reproduces the exact bytes (the golden-frame
+    contract)."""
+    payload = json.dumps(packet, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME:
+        raise FrameError(f"frame of {len(payload)} bytes exceeds MAX_FRAME")
+    return _LEN.pack(len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental decoder: ``feed(chunk)`` returns every COMPLETE packet
+    the stream holds so far, buffering any trailing partial frame."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[dict]:
+        self._buf.extend(data)
+        out: List[dict] = []
+        while True:
+            if len(self._buf) < _LEN.size:
+                return out
+            (n,) = _LEN.unpack_from(self._buf)
+            if n > MAX_FRAME:
+                raise FrameError(f"frame length {n} exceeds MAX_FRAME "
+                                 f"(desynced or non-protocol peer)")
+            if len(self._buf) < _LEN.size + n:
+                return out
+            payload = bytes(self._buf[_LEN.size:_LEN.size + n])
+            del self._buf[:_LEN.size + n]
+            out.append(json.loads(payload.decode("utf-8")))
+
+    def pending_bytes(self) -> int:
+        return len(self._buf)

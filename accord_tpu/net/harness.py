@@ -1,0 +1,483 @@
+"""Serving-cluster harness: spawn real node processes, drive open-loop load.
+
+Three consumers share this module (ISSUE r12 satellite: one harness, not
+three): ``tests/test_net.py`` (tier-1 loopback smoke, kill-9 recovery,
+slow overload sweep), ``tools/serve_bench.py`` (the 3-point offered-load
+sweep that lands in the BENCH artifact) and ``tools/run_fault_matrix.sh``
+(the socket-fault legs: ``python -m accord_tpu.net.harness --smoke
+--net-faults conn_reset:0.08:5``).
+
+The load generator is OPEN-LOOP: arrivals follow a seeded Poisson process
+at the offered rate regardless of completions — the regime where a server
+without admission control collapses (every arrival joins a queue that only
+grows) and a shedding server keeps its goodput.  Each arrival is submitted
+without retry; sheds/timeouts/failures are counted, latency is recorded
+for admitted txns only (the admitted-p99 the graceful-overload assertion
+bounds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .admission import Overloaded
+from .client import ClusterClient, TxnFailed
+
+TOKEN_SPACE = 1 << 32
+
+
+def free_ports(n: int) -> List[int]:
+    """n distinct ephemeral ports (bind-then-release; the tiny reuse race
+    is acceptable for a test harness)."""
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+class ServeCluster:
+    """N ``accord_tpu.net.server`` OS processes on loopback ports."""
+
+    def __init__(self, n_nodes: int = 3, stores: int = 2,
+                 admit_max: int = 64, target_p99_ms: int = 1000,
+                 request_timeout_ms: Optional[int] = 4000,
+                 durability: bool = False,
+                 net_faults: Optional[str] = None,
+                 log_dir: Optional[str] = None,
+                 extra_args: Optional[List[str]] = None):
+        self.names = [f"n{i}" for i in range(1, n_nodes + 1)]
+        ports = free_ports(n_nodes)
+        self.addrs: List[Tuple[str, str, int]] = [
+            (name, "127.0.0.1", port)
+            for name, port in zip(self.names, ports)]
+        self.stores = stores
+        self.admit_max = admit_max
+        self.target_p99_ms = target_p99_ms
+        self.request_timeout_ms = request_timeout_ms
+        self.durability = durability
+        self.net_faults = net_faults
+        self.extra_args = extra_args or []
+        self.log_dir = log_dir or tempfile.mkdtemp(prefix="accord_serve_")
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self._logs: Dict[str, object] = {}
+
+    def _peers_arg(self) -> str:
+        return ",".join(f"{n}={h}:{p}" for n, h, p in self.addrs)
+
+    def spawn(self, name: str) -> subprocess.Popen:
+        """(Re)start one node process (used for initial spawn AND the
+        kill-9 rejoin leg — same name, same port, fresh state)."""
+        _, host, port = next(a for a in self.addrs if a[0] == name)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_ENABLE_X64"] = "true"
+        env.setdefault("ACCORD_TPU_DEVICE", "0")   # host route: fast start
+        if self.net_faults:
+            env["ACCORD_TPU_NET_FAULTS"] = self.net_faults
+        cmd = [sys.executable, "-m", "accord_tpu.net.server",
+               "--name", name, "--listen", f"{host}:{port}",
+               "--peers", self._peers_arg(),
+               "--stores", str(self.stores),
+               "--admit-max", str(self.admit_max),
+               "--target-p99-ms", str(self.target_p99_ms)]
+        if self.request_timeout_ms is not None:
+            cmd += ["--request-timeout-ms", str(self.request_timeout_ms)]
+        if not self.durability:
+            cmd.append("--no-durability")
+        cmd += self.extra_args
+        log = open(os.path.join(self.log_dir, f"{name}.log"), "ab")
+        self._logs[name] = log
+        proc = subprocess.Popen(cmd, stdout=log, stderr=log,
+                                cwd=os.path.dirname(os.path.dirname(
+                                    os.path.dirname(
+                                        os.path.abspath(__file__)))),
+                                env=env)
+        self.procs[name] = proc
+        return proc
+
+    def spawn_all(self) -> None:
+        for name in self.names:
+            self.spawn(name)
+
+    def alive(self) -> Dict[str, bool]:
+        return {n: (p.poll() is None) for n, p in self.procs.items()}
+
+    def kill9(self, name: str) -> None:
+        self.procs[name].send_signal(signal.SIGKILL)
+        self.procs[name].wait(timeout=10)
+
+    def shutdown(self) -> None:
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 10
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for log in self._logs.values():
+            try:
+                log.close()
+            except Exception:
+                pass
+
+    def node_log(self, name: str) -> str:
+        path = os.path.join(self.log_dir, f"{name}.log")
+        try:
+            with open(path, "r", errors="replace") as f:
+                return f.read()
+        except OSError:
+            return ""
+
+
+async def wait_ready(cluster: ServeCluster, client: ClusterClient,
+                     timeout: float = 60.0) -> None:
+    """Connect + ping every node (retrying: process startup pays the jax
+    import).  Raises on deadline with each node's log tail."""
+    deadline = time.time() + timeout
+    for name, host, port in cluster.addrs:
+        fresh = False
+        while True:
+            try:
+                if name not in client.conns or not fresh:
+                    # always re-dial once per node: after a kill/restart
+                    # the client may hold a stale conn to the old process
+                    await client.reconnect(name)
+                    fresh = True
+                await client.ping(name, timeout=2.0)
+                break
+            except Exception:
+                fresh = False
+                if time.time() > deadline:
+                    tails = {n: cluster.node_log(n)[-800:]
+                             for n in cluster.names}
+                    raise TimeoutError(
+                        f"cluster not ready within {timeout}s: {tails}")
+                if cluster.procs.get(name) is not None \
+                        and cluster.procs[name].poll() is not None:
+                    raise RuntimeError(
+                        f"node {name} exited rc={cluster.procs[name].poll()}"
+                        f": {cluster.node_log(name)[-800:]}")
+                await asyncio.sleep(0.25)
+
+
+def percentile(sorted_xs: List[float], q: float) -> Optional[float]:
+    if not sorted_xs:
+        return None
+    return sorted_xs[min(len(sorted_xs) - 1, int(len(sorted_xs) * q))]
+
+
+def _r2(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v, 2)
+
+
+class LoadPointResult:
+    """One offered-load point's census."""
+
+    def __init__(self, offered: float, duration: float):
+        self.offered = offered
+        self.duration = duration
+        self.sent = 0
+        self.ok = 0
+        self.shed = 0
+        self.failed = 0
+        self.timeout = 0
+        self.latencies_ms: List[float] = []
+
+    @property
+    def goodput(self) -> float:
+        return self.ok / self.duration if self.duration else 0.0
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / self.sent if self.sent else 0.0
+
+    def latency_ms(self, q: float) -> Optional[float]:
+        return percentile(sorted(self.latencies_ms), q)
+
+    def row(self) -> dict:
+        lat = sorted(self.latencies_ms)
+        return {
+            "offered_txns_per_sec": round(self.offered, 1),
+            "duration_s": round(self.duration, 1),
+            "sent": self.sent, "ok": self.ok, "shed": self.shed,
+            "failed": self.failed, "timeout": self.timeout,
+            "goodput_txns_per_sec": round(self.goodput, 1),
+            "shed_rate": round(self.shed_rate, 4),
+            "p50_ms": _r2(percentile(lat, 0.50)),
+            "p99_ms": _r2(percentile(lat, 0.99)),
+            "p999_ms": _r2(percentile(lat, 0.999)),
+        }
+
+
+def _mk_ops(rng: random.Random, counter: List[int], n_keys: int) -> list:
+    """1-2 key list-append ops, keys strided across the whole token ring
+    (multi-shard by construction, same policy as the sim runner)."""
+    stride = TOKEN_SPACE // n_keys
+    ops = []
+    for _ in range(rng.randint(1, 2)):
+        key = rng.randrange(n_keys) * stride
+        if rng.random() < 0.6:
+            counter[0] += 1
+            ops.append(["append", key, counter[0]])
+        else:
+            ops.append(["r", key, None])
+    return ops
+
+
+async def open_loop(client: ClusterClient, rate: float, duration: float,
+                    seed: int = 0, n_keys: int = 64,
+                    txn_timeout: float = 8.0) -> LoadPointResult:
+    """Open-loop Poisson load at ``rate`` txn/s for ``duration`` seconds.
+    Arrivals never wait for completions; every arrival is submitted once
+    (no retry — the shed/timeout census IS the measurement)."""
+    rng = random.Random(seed)
+    counter = [0]
+    res = LoadPointResult(rate, duration)
+    tasks: List[asyncio.Task] = []
+    loop = asyncio.get_event_loop()
+    t0 = loop.time()
+    t_next = t0
+
+    async def one(ops):
+        res.sent += 1
+        start = loop.time()
+        try:
+            await client.submit(ops, timeout=txn_timeout)
+            res.ok += 1
+            res.latencies_ms.append((loop.time() - start) * 1e3)
+        except Overloaded:
+            res.shed += 1
+        except asyncio.TimeoutError:
+            res.timeout += 1
+        except (TxnFailed, ConnectionError):
+            res.failed += 1
+
+    while True:
+        t_next += rng.expovariate(rate)
+        now = loop.time()
+        if t_next - t0 > duration:
+            break
+        if t_next > now:
+            await asyncio.sleep(t_next - now)
+        tasks.append(loop.create_task(one(_mk_ops(rng, counter, n_keys))))
+    if tasks:
+        await asyncio.wait(tasks, timeout=txn_timeout + 5.0)
+    for t in tasks:
+        if not t.done():
+            t.cancel()
+    # measure over the actual window the arrivals spanned
+    res.duration = max(duration, 1e-9)
+    return res
+
+
+async def saturation_probe(client: ClusterClient, workers: int = 16,
+                           duration: float = 4.0, seed: int = 42,
+                           n_keys: int = 64) -> dict:
+    """Closed-loop saturation measurement: ``workers`` back-to-back
+    submitters for ``duration`` seconds.  Closed loop saturates BY
+    CONSTRUCTION whatever speed the box happens to run at (workers simply
+    complete slower), so both readouts are true at-saturation values: the
+    rate anchors the open-loop sweep's 0.5x/1x/3x offered points, and the
+    admitted-txn latency percentiles anchor the graceful-overload p99
+    bound on a box whose speed oscillates between sweep points."""
+    rng = random.Random(seed)
+    counter = [0]
+    done = [0]
+    lat_ms: List[float] = []
+    loop = asyncio.get_event_loop()
+    stop_at = loop.time() + duration
+
+    async def worker(wseed: int):
+        wrng = random.Random(wseed)
+        backoff = random.Random(wseed ^ 0x5EED)
+        while loop.time() < stop_at:
+            ops = _mk_ops(wrng, counter, n_keys)
+            # per-ATTEMPT timing: a shed's retry-backoff sleep must not
+            # land in the latency census — the percentile here anchors
+            # the graceful-overload bound, so it must be ADMITTED-txn
+            # commit latency, commensurable with the open-loop points'
+            # bare submit() measurement
+            t0 = loop.time()
+            try:
+                await client.submit(ops, timeout=6.0)
+                done[0] += 1
+                lat_ms.append((loop.time() - t0) * 1e3)
+            except Overloaded as exc:
+                await asyncio.sleep(
+                    (exc.retry_after_ms + backoff.randrange(25)) / 1e3)
+            except (TxnFailed, asyncio.TimeoutError, ConnectionError):
+                pass
+
+    await asyncio.gather(*(worker(seed + i) for i in range(workers)))
+    lat = sorted(lat_ms)
+    return {"rate": done[0] / duration,
+            "p50_ms": _r2(percentile(lat, 0.50)),
+            "p99_ms": _r2(percentile(lat, 0.99))}
+
+
+async def cluster_net_stats(client: ClusterClient,
+                            names: List[str]) -> dict:
+    """Aggregate serving stats across nodes: reconnect counters, sheds,
+    admission state — the bench-row columns."""
+    agg = {"reconnects": 0, "dial_failures": 0, "dropped_frames": 0,
+           "shed_total": 0, "admitted": 0, "per_node": {}}
+    for name in names:
+        try:
+            s = await client.stats(name)
+        except Exception:
+            agg["per_node"][name] = None
+            continue
+        agg["per_node"][name] = s
+        for link in (s.get("links") or {}).values():
+            agg["reconnects"] += link.get("reconnects", 0)
+            agg["dial_failures"] += link.get("dial_failures", 0)
+            agg["dropped_frames"] += link.get("dropped", 0)
+        adm = s.get("admission") or {}
+        agg["shed_total"] += adm.get("shed_total", 0)
+        agg["admitted"] += adm.get("admitted", 0)
+    return agg
+
+
+# ---------------------------------------------------------------------------
+# the 2-process smoke (tier-1 + the fault-matrix socket legs)
+# ---------------------------------------------------------------------------
+
+async def _smoke_async(cluster: ServeCluster, n_txns: int,
+                       concurrency: int = 8) -> dict:
+    client = ClusterClient(cluster.addrs, timeout=8.0)
+    try:
+        await wait_ready(cluster, client)
+        rng = random.Random(7)
+        counter = [0]
+        sem = asyncio.Semaphore(concurrency)
+        ok = [0]
+        errors: List[str] = []
+
+        async def one():
+            async with sem:
+                # NEVER raise out of the gather: a failed txn must reach
+                # the caller's census so the post-mortem dump runs — the
+                # forensic bundle is the whole point of the fault legs
+                try:
+                    await client.submit_retry(_mk_ops(rng, counter, 32),
+                                              retries=16, timeout=6.0)
+                    ok[0] += 1
+                except Exception as exc:
+                    errors.append(repr(exc))
+
+        await asyncio.gather(*(one() for _ in range(n_txns)))
+        stats = await cluster_net_stats(client, cluster.names)
+        return {"ok": ok[0], "n_txns": n_txns, "errors": errors[:8],
+                "duplicate_replies": client.duplicate_replies(),
+                "alive": cluster.alive(), "net": stats}
+    finally:
+        await client.close()
+
+
+async def _dump_postmortems(cluster: ServeCluster, out_dir: str,
+                            tag: str) -> Optional[str]:
+    """Fetch every reachable node's flight/metrics dump + harness-side
+    stats into one forensic bundle under ``out_dir``."""
+    client = ClusterClient(cluster.addrs, timeout=5.0, src="c-dump")
+    bundle = {"tag": tag, "alive": cluster.alive(), "nodes": {}}
+    for name, host, port in cluster.addrs:
+        try:
+            await client.reconnect(name)
+            bundle["nodes"][name] = {
+                "dump": await client.dump(name),
+                "stats": await client.stats(name),
+            }
+        except Exception as exc:
+            bundle["nodes"][name] = {"unreachable": repr(exc),
+                                     "log_tail": cluster.node_log(name)[-2000:]}
+    await client.close()
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"net_smoke_{tag}.json")
+    with open(path, "w") as f:
+        json.dump(bundle, f, sort_keys=True, indent=1)
+    return path
+
+
+def run_smoke(n_txns: int = 100, n_nodes: int = 2,
+              net_faults: Optional[str] = None,
+              out_dir: Optional[str] = None,
+              admit_max: int = 32) -> dict:
+    """Spawn an ``n_nodes`` cluster, run ``n_txns`` client txns (bounded
+    concurrency, retry-with-backoff), assert full success and cluster
+    liveness.  On failure under a fault leg, dumps flight post-mortems to
+    ``out_dir`` before raising."""
+    # tight inter-node timeout: under injected socket faults the sink's
+    # timeout owns recovery, and a lost frame must cost ~1s, not the
+    # Maelstrom adapter's cold-compile-sized 20s
+    cluster = ServeCluster(n_nodes=n_nodes, net_faults=net_faults,
+                           admit_max=admit_max,
+                           request_timeout_ms=800)
+    cluster.spawn_all()
+    try:
+        result = asyncio.run(_smoke_async(cluster, n_txns))
+        problems = []
+        if result["ok"] != n_txns:
+            problems.append(f"{n_txns - result['ok']} txns never succeeded "
+                            f"(first errors: {result['errors']})")
+        if result["duplicate_replies"]:
+            problems.append(
+                f"{result['duplicate_replies']} duplicate client replies")
+        if not all(result["alive"].values()):
+            problems.append(f"dead nodes: {result['alive']}")
+        if problems:
+            tag = (net_faults or "clean").replace(":", "_").replace(",", "+")
+            path = None
+            if out_dir:
+                path = asyncio.run(_dump_postmortems(cluster, out_dir, tag))
+            raise AssertionError(
+                f"serving smoke failed ({'; '.join(problems)})"
+                + (f" [post-mortem: {path}]" if path else ""))
+        return result
+    finally:
+        cluster.shutdown()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="serving-cluster smoke harness (fault-matrix legs)")
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--txns", type=int, default=100)
+    p.add_argument("--nodes", type=int, default=2)
+    p.add_argument("--net-faults", default=None,
+                   help="kind:prob:seed[,...] armed in every node process")
+    p.add_argument("--out", default=os.environ.get("FAULT_MATRIX_OUT",
+                                                   "/tmp"))
+    args = p.parse_args(argv)
+    if not args.smoke:
+        p.error("--smoke is the only mode")
+    t0 = time.time()
+    result = run_smoke(n_txns=args.txns, n_nodes=args.nodes,
+                       net_faults=args.net_faults, out_dir=args.out)
+    net = result["net"]
+    print(f"smoke ok: {result['ok']}/{result['n_txns']} txns in "
+          f"{time.time() - t0:.1f}s faults={args.net_faults or 'none'} "
+          f"reconnects={net['reconnects']} sheds={net['shed_total']} "
+          f"dup_replies={result['duplicate_replies']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

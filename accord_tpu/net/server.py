@@ -1,0 +1,365 @@
+"""One real serving node: the Maelstrom node wiring behind a TCP socket loop.
+
+``python -m accord_tpu.net.server --name n1 --listen 127.0.0.1:7001 \
+    --peers n1=127.0.0.1:7001,n2=127.0.0.1:7002,n3=127.0.0.1:7003``
+
+Reuses :class:`accord_tpu.maelstrom.node.MaelstromProcess` wholesale — the
+same node wiring, wire codec, request/reply correlation and (r12-fixed)
+sink-owned timeouts that speak to the Maelstrom harness over stdin/stdout —
+behind an asyncio event loop: inbound frames (peer protocol traffic AND
+client ``txn`` bodies) arrive over TCP, outbound packets route to per-peer
+:class:`PeerLink`\\ s (reconnect + backoff) or back to the client connection
+that sent the txn.  The process is single-threaded: protocol work, timers
+and socket I/O all run on the loop, exactly like the reference Maelstrom
+node's single listen loop.
+
+The admission gate (``--admit-max`` / ``--target-p99-ms``) sits in front of
+``coordinate`` via ``MaelstromProcess.admission``; shed replies are the
+explicit ``Overloaded`` wire error (code 11, ``overloaded: true``,
+``retry_after_ms``).  Control verbs (``ping`` / ``stats`` / ``dump``) serve
+liveness probes, the serving stats surface (admission + per-link reconnect
+counters) and flight-recorder post-mortem bundles without touching the
+protocol path.
+
+Socket faults arm from ACCORD_TPU_NET_FAULTS (see ``utils.faults``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from .. import api
+from ..utils import faults
+from ..utils.random_source import RandomSource
+from .admission import AdmissionGate, device_health_of
+from .framing import encode_frame
+from .transport import FrameServer, PeerLink
+
+
+class _Scheduled(api.Scheduled):
+    __slots__ = ("handle", "cancelled")
+
+    def __init__(self, handle=None):
+        self.handle = handle
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        if self.handle is not None:
+            self.handle.cancel()
+
+    def is_cancelled(self) -> bool:
+        return self.cancelled
+
+
+class AsyncioScheduler(api.Scheduler):
+    """api.Scheduler over the asyncio event loop (micros in, seconds out)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop
+
+    def now(self, run: Callable[[], None]) -> None:
+        self.loop.call_soon(run)
+
+    def once(self, delay_micros: int, run: Callable[[], None]) -> api.Scheduled:
+        sched = _Scheduled()
+
+        def fire():
+            if not sched.cancelled:
+                run()
+        sched.handle = self.loop.call_later(delay_micros / 1e6, fire)
+        return sched
+
+    def recurring(self, interval_micros: int,
+                  run: Callable[[], None]) -> api.Scheduled:
+        sched = _Scheduled()
+
+        def fire():
+            if sched.cancelled:
+                return
+            try:
+                run()
+            finally:
+                # reschedule even if run() raised: the timeout sweeper
+                # rides this — if one sweep's failure callback blows up,
+                # the node must keep detecting timeouts, not wedge with
+                # every future dead-peer request pending forever
+                sched.handle = self.loop.call_later(
+                    interval_micros / 1e6, fire)
+        sched.handle = self.loop.call_later(interval_micros / 1e6, fire)
+        return sched
+
+
+class NodeServer:
+    """One node process: FrameServer in, PeerLinks out, MaelstromProcess
+    in the middle, AdmissionGate in front of coordinate."""
+
+    def __init__(self, name: str, host: str, port: int,
+                 peers: Dict[str, Tuple[str, int]],
+                 stores: int = 2, shards: int = 16,
+                 device_mode: Optional[bool] = False,
+                 durability: bool = True,
+                 admit_max: int = 64,
+                 target_p99_ms: int = 1000,
+                 min_budget: int = 4,
+                 request_timeout_ms: Optional[int] = None):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.peers = {n: a for n, a in peers.items() if n != name}
+        self.stores = stores
+        self.shards = shards
+        self.device_mode = device_mode
+        self.durability = durability
+        self.admit_max = admit_max
+        self.target_p99_ms = target_p99_ms
+        self.min_budget = min_budget
+        self.request_timeout_ms = request_timeout_ms
+        self._start_ns = time.monotonic_ns()
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.links: Dict[str, PeerLink] = {}
+        self._clients: Dict[str, asyncio.StreamWriter] = {}
+        self.proc = None
+        self.gate: Optional[AdmissionGate] = None
+        self.frame_server: Optional[FrameServer] = None
+        self.n_client_replies = 0
+        self.n_unroutable = 0
+        self.n_reply_drops = 0
+
+    def now_micros(self) -> int:
+        return (time.monotonic_ns() - self._start_ns) // 1_000
+
+    # a client that stops READING its socket must not grow the node's
+    # memory: past this transport write-buffer bound its replies drop
+    # (at-most-once delivery allows it; the client's timeout owns
+    # recovery) — the admission contract is bounded resources everywhere
+    CLIENT_WRITE_BUFFER_CAP = 4 * 1024 * 1024
+
+    def _write_bounded(self, dest: str,
+                       writer: asyncio.StreamWriter, frame: bytes) -> bool:
+        try:
+            if (writer.transport.get_write_buffer_size()
+                    > self.CLIENT_WRITE_BUFFER_CAP):
+                self.n_reply_drops += 1
+                return False
+            writer.write(frame)
+            return True
+        except Exception:
+            self._clients.pop(dest, None)
+            return False
+
+    # -- outbound -------------------------------------------------------------
+    def _emit(self, dest, body: dict) -> None:
+        packet = {"src": self.name, "dest": dest, "body": body}
+        frame = encode_frame(packet)
+        if dest in self.links:
+            self.links[dest].send(frame)
+            return
+        writer = self._clients.get(dest)
+        if writer is not None:
+            self.n_client_replies += 1
+            self._write_bounded(dest, writer, frame)
+            return
+        # init_ok to the synthetic "boot" client, or a reply to a client
+        # whose connection is gone: at-most-once delivery — drop
+        self.n_unroutable += 1
+
+    def _client_gone(self, writer: asyncio.StreamWriter) -> None:
+        """Connection closed: evict every client-src entry bound to this
+        writer.  Without this the map grows one dead StreamWriter per
+        client src forever (write() on a closed transport does not raise,
+        so the lazy-evict path in _emit never fires), and replies to
+        departed clients count as delivered instead of unroutable."""
+        gone = [src for src, w in self._clients.items() if w is writer]
+        for src in gone:
+            del self._clients[src]
+
+    # -- inbound --------------------------------------------------------------
+    def _on_packet(self, packet: dict, writer: asyncio.StreamWriter) -> None:
+        body = packet.get("body") or {}
+        typ = body.get("type")
+        src = packet.get("src", "")
+        if typ in ("ping", "stats", "dump"):
+            self._control(typ, src, body, writer)
+            return
+        if typ == "txn":
+            # remember the connection this client speaks on: its replies
+            # (including sheds) route back over the same socket
+            self._clients[src] = writer
+        try:
+            self.proc.handle(packet)
+        except Exception as exc:   # a poisoned packet must not kill the node
+            print(f"[{self.name}] handler error on {typ}: {exc!r}",
+                  file=sys.stderr)
+
+    def _control(self, typ: str, src: str, body: dict,
+                 writer: asyncio.StreamWriter) -> None:
+        msg_id = body.get("msg_id")
+        if typ == "ping":
+            reply = {"type": "pong", "in_reply_to": msg_id,
+                     "name": self.name, "pid": os.getpid()}
+        elif typ == "stats":
+            reply = {"type": "stats_ok", "in_reply_to": msg_id,
+                     "stats": self.stats()}
+        else:   # dump: the flight-recorder post-mortems + metrics snapshot
+            obs = self.proc.obs if self.proc is not None else None
+            reply = {"type": "dump_ok", "in_reply_to": msg_id,
+                     "flight": (json.loads(obs.flight.export_json())
+                                if obs is not None and obs.flight is not None
+                                else None),
+                     "metrics": (obs.metrics.snapshot()
+                                 if obs is not None else None)}
+        self._write_bounded(src, writer, encode_frame(
+            {"src": self.name, "dest": src, "body": reply}))
+
+    def stats(self) -> dict:
+        proc = self.proc
+        return {
+            "name": self.name, "pid": os.getpid(),
+            "uptime_micros": self.now_micros(),
+            "admission": self.gate.stats() if self.gate else None,
+            "links": {n: l.stats() for n, l in sorted(self.links.items())},
+            "client_replies": self.n_client_replies,
+            "unroutable": self.n_unroutable,
+            "reply_drops": self.n_reply_drops,
+            "frame_errors": (self.frame_server.n_frame_errors
+                             if self.frame_server else 0),
+            "pending_requests": (len(proc.sink.pending)
+                                 if proc and proc.sink else 0),
+            "failures": len(proc.failures) if proc else 0,
+            "socket_faults": faults.active_socket_faults(),
+        }
+
+    # -- lifecycle ------------------------------------------------------------
+    async def start(self) -> None:
+        from ..maelstrom.node import MaelstromProcess
+        from ..obs import Observability
+        self.loop = asyncio.get_event_loop()
+        faults.arm_socket_faults_from_env()
+        scheduler = AsyncioScheduler(self.loop)
+        obs = Observability(now=self.now_micros)
+        self.proc = MaelstromProcess(
+            emit=self._emit, scheduler=scheduler,
+            now_micros=self.now_micros,
+            num_stores=self.stores, shards=self.shards,
+            device_mode=self.device_mode,
+            durability=self.durability, obs=obs)
+        if self.request_timeout_ms is not None:
+            self.proc.request_timeout_micros = self.request_timeout_ms * 1000
+        # admission gate in front of coordinate, composed with the r07
+        # device ladder (quarantine lowers the budget)
+        self.gate = AdmissionGate(
+            max_inflight=self.admit_max,
+            target_p99_micros=self.target_p99_ms * 1000,
+            min_budget=self.min_budget,
+            device_health=lambda: device_health_of(self.proc.node),
+            metrics=obs.metrics)
+        self.proc.admission = self.gate
+        # outbound links (deterministic per-(me, peer) jitter streams)
+        import zlib
+        for peer, (host, port) in sorted(self.peers.items()):
+            # stable per-(me, peer) seed: hash() is salted per process,
+            # crc32 is not — the backoff schedule must be reproducible
+            jitter = RandomSource(
+                0x7C9 ^ zlib.crc32(f"{self.name}->{peer}".encode()))
+            self.links[peer] = PeerLink(self.name, peer, host, port, jitter)
+        self.frame_server = FrameServer(self.host, self.port,
+                                        self._on_packet,
+                                        on_close=self._client_gone)
+        await self.frame_server.start()
+        for link in self.links.values():
+            link.start()
+        # self-init: same init body the Maelstrom harness would send
+        names = sorted(set(self.peers) | {self.name},
+                       key=lambda n: (len(n), n))
+        self.proc.handle({"src": "boot", "dest": self.name,
+                          "body": {"type": "init", "msg_id": 0,
+                                   "node_id": self.name,
+                                   "node_ids": names}})
+        print(f"[{self.name}] serving on {self.host}:{self.port} "
+              f"peers={sorted(self.peers)} pid={os.getpid()}",
+              file=sys.stderr, flush=True)
+
+    async def close(self) -> None:
+        for link in self.links.values():
+            await link.close()
+        if self.frame_server is not None:
+            await self.frame_server.close()
+
+
+def parse_addr(s: str) -> Tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def parse_peers(s: str) -> Dict[str, Tuple[str, int]]:
+    out = {}
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, addr = part.partition("=")
+        out[name] = parse_addr(addr)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="accord-tpu TCP serving node")
+    p.add_argument("--name", required=True)
+    p.add_argument("--listen", required=True, help="host:port to bind")
+    p.add_argument("--peers", required=True,
+                   help="n1=host:port,n2=host:port,... (includes self)")
+    p.add_argument("--stores", type=int, default=2)
+    p.add_argument("--shards", type=int, default=16)
+    p.add_argument("--device-mode", choices=("auto", "on", "off"),
+                   default="off",
+                   help="device kernels for deps scans (default off: host "
+                        "route, fast cold start — the right default for "
+                        "N processes sharing one small box)")
+    p.add_argument("--no-durability", action="store_true")
+    p.add_argument("--admit-max", type=int, default=64,
+                   help="hard in-flight coordination budget")
+    p.add_argument("--target-p99-ms", type=int, default=1000,
+                   help="admission controller's sliding-p99 target")
+    p.add_argument("--min-budget", type=int, default=4)
+    p.add_argument("--request-timeout-ms", type=int, default=None,
+                   help="sink-owned inter-node request timeout "
+                        "(default: the Maelstrom adapter's 20s)")
+    args = p.parse_args(argv)
+
+    host, port = parse_addr(args.listen)
+    device_mode = {"auto": None, "on": True, "off": False}[args.device_mode]
+    server = NodeServer(
+        args.name, host, port, parse_peers(args.peers),
+        stores=args.stores, shards=args.shards, device_mode=device_mode,
+        durability=not args.no_durability,
+        admit_max=args.admit_max, target_p99_ms=args.target_p99_ms,
+        min_budget=args.min_budget,
+        request_timeout_ms=args.request_timeout_ms)
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    stop = asyncio.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:   # pragma: no cover - non-unix
+            pass
+    loop.run_until_complete(server.start())
+    try:
+        loop.run_until_complete(stop.wait())
+    finally:
+        loop.run_until_complete(server.close())
+        loop.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,210 @@
+"""Asyncio TCP transport: per-peer outbound links + a frame server.
+
+Topology is a full mesh of DIRECTIONAL links: node A's :class:`PeerLink`
+to B carries every A->B packet; B's own link back carries B->A.  Inbound
+connections are receive-only.  This keeps reconnect state strictly
+per-outbound-link (no connection-dedup handshake) and means a one-way
+partition degrades exactly one direction.
+
+Delivery contract (SURVEY §2.10 MessageSink): **at-most-once, no ordering
+assumptions, timeouts owned by the sink.**  A link buffers a BOUNDED queue
+of frames while disconnected (drop-oldest beyond — the sink's request
+timeout owns recovery, not the transport), sends each frame at most once,
+and never replays on reconnect — so a reply racing a reconnect can only
+arrive zero or one times, and the sink's pending-table pop makes dispatch
+idempotent even against a reply racing its own timeout.
+
+Reconnect: capped exponential backoff with deterministic jitter drawn from
+a dedicated :class:`RandomSource` stream (same policy as the r07 device
+quarantine backoff — co-failed links must not re-dial in lockstep).
+
+Fault injection (``utils.faults`` socket kinds, armed per-process via
+ACCORD_TPU_NET_FAULTS): ``conn_reset`` aborts the link mid-frame,
+``stalled_peer`` holds the writer for a drawn interval, ``slow_link``
+delays each frame — all drawn from the injected seeded source only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils import faults
+from ..utils.random_source import RandomSource
+from .framing import FrameDecoder, FrameError, encode_frame
+
+# reconnect backoff: 50ms, 100ms, ... capped at 2s, plus up to 50% jitter
+BACKOFF_BASE_MICROS = 50_000
+BACKOFF_CAP_MICROS = 2_000_000
+# frames buffered per link while disconnected (drop-oldest beyond)
+LINK_QUEUE_FRAMES = 2048
+
+
+def backoff_micros(attempt: int, jitter: RandomSource) -> int:
+    """Backoff before reconnect ``attempt`` (0-based): capped exponential
+    plus deterministic jitter in [0, base/2)."""
+    base = min(BACKOFF_CAP_MICROS, BACKOFF_BASE_MICROS << min(attempt, 16))
+    return base + jitter.next_int(max(base // 2, 1))
+
+
+class PeerLink:
+    """One outbound connection to a peer, kept alive forever.
+
+    ``send`` enqueues a pre-encoded frame and never blocks the caller; the
+    writer task drains the queue into the socket, reconnecting with capped
+    backoff on any failure.  Counters feed the serving stats surface."""
+
+    def __init__(self, me: str, peer: str, host: str, port: int,
+                 jitter: RandomSource,
+                 max_queue: int = LINK_QUEUE_FRAMES):
+        self.me = me
+        self.peer = peer
+        self.host = host
+        self.port = port
+        self._jitter = jitter
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._task: Optional[asyncio.Task] = None
+        self.connected = False
+        self.n_connects = 0        # successful dials (first + re-)
+        self.n_reconnects = 0      # successful dials after the first
+        self.n_dial_failures = 0
+        self.n_sent = 0
+        self.n_dropped = 0         # frames dropped by the bounded queue
+        self.n_reset_faults = 0    # injected conn_reset firings
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._run())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    def send(self, frame: bytes) -> None:
+        """Enqueue one frame (drop-oldest beyond the bound: the transport
+        never buffers unboundedly — the sink's timeout owns recovery)."""
+        while True:
+            try:
+                self._queue.put_nowait(frame)
+                return
+            except asyncio.QueueFull:
+                try:
+                    self._queue.get_nowait()
+                    self.n_dropped += 1
+                except asyncio.QueueEmpty:
+                    pass
+
+    async def _run(self) -> None:
+        attempt = 0
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    self.host, self.port)
+            except (OSError, asyncio.TimeoutError):
+                self.n_dial_failures += 1
+                await asyncio.sleep(
+                    backoff_micros(attempt, self._jitter) / 1e6)
+                attempt += 1
+                continue
+            self.connected = True
+            self.n_connects += 1
+            if self.n_connects > 1:
+                self.n_reconnects += 1
+            attempt = 0
+            try:
+                await self._pump(writer)
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                pass
+            finally:
+                self.connected = False
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            # brief jittered pause even on a clean drop so a flapping
+            # acceptor isn't hammered at loop speed
+            await asyncio.sleep(backoff_micros(0, self._jitter) / 1e6)
+
+    async def _pump(self, writer: asyncio.StreamWriter) -> None:
+        while True:
+            frame = await self._queue.get()
+            # injected socket faults (seedable; see utils.faults) — drawn
+            # per frame, exactly like the device layer draws per launch
+            if faults.socket_fault_fires("slow_link"):
+                await asyncio.sleep(
+                    faults.socket_fault_delay_micros("slow_link") / 1e6)
+            if faults.socket_fault_fires("stalled_peer"):
+                await asyncio.sleep(
+                    faults.socket_fault_delay_micros("stalled_peer") / 1e6)
+            if faults.socket_fault_fires("conn_reset"):
+                self.n_reset_faults += 1
+                writer.transport.abort()   # frame lost, link reconnects
+                raise ConnectionResetError("injected conn_reset")
+            writer.write(frame)
+            self.n_sent += 1
+            await writer.drain()
+
+    def stats(self) -> dict:
+        return {"peer": self.peer, "connected": self.connected,
+                "connects": self.n_connects,
+                "reconnects": self.n_reconnects,
+                "dial_failures": self.n_dial_failures,
+                "sent": self.n_sent, "dropped": self.n_dropped,
+                "reset_faults": self.n_reset_faults,
+                "queued": self._queue.qsize()}
+
+
+class FrameServer:
+    """Accept loop: every inbound connection (peer or client) is decoded
+    frame-by-frame and handed to ``on_packet(packet, writer)``.  A framing
+    violation drops THAT connection only."""
+
+    def __init__(self, host: str, port: int,
+                 on_packet: Callable[[dict, asyncio.StreamWriter], None],
+                 on_close: Optional[
+                     Callable[[asyncio.StreamWriter], None]] = None):
+        self.host = host
+        self.port = port
+        self.on_packet = on_packet
+        self.on_close = on_close
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.n_accepted = 0
+        self.n_frame_errors = 0
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self.n_accepted += 1
+        decoder = FrameDecoder()
+        try:
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    return
+                for packet in decoder.feed(chunk):
+                    self.on_packet(packet, writer)
+        except FrameError:
+            self.n_frame_errors += 1
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            if self.on_close is not None:
+                try:
+                    self.on_close(writer)
+                except Exception:
+                    pass
+            try:
+                writer.close()
+            except Exception:
+                pass

@@ -154,6 +154,125 @@ def device_fault(kind: str, probability: float,
             _armed[kind] = prior
 
 
+# ---------------------------------------------------------------------------
+# socket faults (r12): the network-boundary analogue of the device faults —
+# seedable, drawn ONLY from the injected RandomSource, armed per-process
+# (the serving nodes are separate OS processes, so arming crosses the exec
+# boundary via the ACCORD_TPU_NET_FAULTS env var).
+# ---------------------------------------------------------------------------
+
+class SocketFaultError(RuntimeError):
+    """Base of every injected network-boundary failure."""
+
+
+class ConnResetFault(SocketFaultError):
+    """The connection is torn down abruptly mid-frame (RST-alike); the
+    frame is lost and the peer link must reconnect through its backoff."""
+
+
+class StalledPeerFault(SocketFaultError):
+    """The peer stops draining for a drawn interval (wedged process /
+    full socket buffer): writes stall, timeouts own the recovery."""
+
+
+class SlowLinkFault(SocketFaultError):
+    """Per-frame added latency (congested / lossy path)."""
+
+
+SOCKET_FAULT_KINDS: Dict[str, type] = {
+    "conn_reset": ConnResetFault,
+    "stalled_peer": StalledPeerFault,
+    "slow_link": SlowLinkFault,
+}
+
+# drawn stall/delay bounds per kind (micros) — the duration draw comes from
+# the SAME armed RandomSource as the fire decision, so a seeded run replays
+# the exact fault timeline
+_SOCKET_DELAY_BOUNDS = {
+    "slow_link": (5_000, 60_000),
+    "stalled_peer": (100_000, 600_000),
+}
+
+NET_FAULTS_ENV = "ACCORD_TPU_NET_FAULTS"
+
+# kind -> (probability, RandomSource); empty means no draws anywhere
+_socket_armed: Dict[str, Tuple[float, RandomSource]] = {}
+
+
+def inject_socket_fault(kind: str, probability: float,
+                        random: RandomSource) -> None:
+    """Arm one socket fault class (draws come from ``random`` ONLY)."""
+    if kind not in SOCKET_FAULT_KINDS:
+        raise ValueError(f"unknown socket fault kind {kind!r}; "
+                         f"one of {sorted(SOCKET_FAULT_KINDS)}")
+    _socket_armed[kind] = (probability, random)
+
+
+def clear_socket_faults(kind: Optional[str] = None) -> None:
+    if kind is None:
+        _socket_armed.clear()
+    else:
+        _socket_armed.pop(kind, None)
+
+
+def active_socket_faults() -> Dict[str, float]:
+    return {k: p for k, (p, _r) in _socket_armed.items()}
+
+
+def socket_fault_fires(kind: str) -> bool:
+    """One deterministic draw against ``kind``'s armed probability (no
+    draw — and False — when unarmed)."""
+    armed = _socket_armed.get(kind)
+    if armed is None:
+        return False
+    probability, random = armed
+    return random.decide(probability)
+
+
+def socket_fault_delay_micros(kind: str) -> int:
+    """Drawn duration for a fired slow_link/stalled_peer fault."""
+    armed = _socket_armed.get(kind)
+    lo, hi = _SOCKET_DELAY_BOUNDS.get(kind, (1_000, 10_000))
+    if armed is None:
+        return lo
+    _p, random = armed
+    return lo + random.next_int(hi - lo)
+
+
+def arm_socket_faults_from_env(spec: Optional[str] = None) -> Dict[str, float]:
+    """Parse ``kind:probability:seed[,kind:probability:seed...]`` (the
+    ACCORD_TPU_NET_FAULTS format the serving harness passes to spawned
+    node processes) and arm each class.  Returns {kind: probability};
+    empty/unset spec arms nothing."""
+    import os
+    if spec is None:
+        spec = os.environ.get(NET_FAULTS_ENV, "")
+    armed = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, prob, seed = part.split(":")
+        inject_socket_fault(kind, float(prob), RandomSource(int(seed)))
+        armed[kind] = float(prob)
+    return armed
+
+
+@contextlib.contextmanager
+def socket_fault(kind: str, probability: float,
+                 random: RandomSource) -> Iterator[None]:
+    """Arm ``kind`` for the block, restoring the prior arming on exit."""
+    prior = _socket_armed.get(kind)
+    inject_socket_fault(kind, probability, random)
+    try:
+        yield
+    finally:
+        if prior is None:
+            _socket_armed.pop(kind, None)
+        else:
+            _socket_armed[kind] = prior
+
+
 @contextlib.contextmanager
 def enabled(name: str) -> Iterator[None]:
     """Flip a module-level boolean fault flag for the block::

@@ -23,6 +23,7 @@ from __future__ import annotations
 import enum as _enum
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from .primitives.datum import DatumHash
 from .primitives.deps import Deps, KeyDeps, PartialDeps, RangeDeps
 from .primitives.keys import (IntKey, Key, Keys, Range, Ranges, Route,
                               RoutingKeys)
@@ -193,6 +194,12 @@ def _register_latest_deps() -> None:
 
 
 _register_latest_deps()
+
+# the HASH datum kind (string/long/double ride as native JSON scalars;
+# ref: maelstrom/Datum.java Kind {STRING, LONG, DOUBLE, HASH})
+register(DatumHash, "DHash",
+         lambda h: {"v": h.value},
+         lambda d: DatumHash(d["v"]))
 
 register_fields(Txn, ["kind", "keys", "read", "update", "query"])
 register_fields(PartialTxn,

@@ -505,7 +505,7 @@ def bench_hot_keys():
             {"config": 3,
              "metric": "hot_chain_drain_100k_ell_txns_per_sec",
              "value": round(ell_rate, 1), "unit": "txn/s",
-             "vs_baseline": round(ell_rate / kahn_ell_rate, 4),
+             "vs_baseline": round(ell_rate / kahn_ell_rate, 6),
              "vs_baseline_kind": "host-kahn",
              "baseline_qps": round(kahn_ell_rate, 1),
              "fixpoint_sweeps": ell_sweeps,
@@ -514,7 +514,10 @@ def bench_hot_keys():
             {"config": 3,
              "metric": "hot128_chain_drain_txns_per_sec",
              "value": round(deep_rate, 1), "unit": "txn/s",
-             "vs_baseline": round(deep_rate / kahn_deep_rate, 4),
+             # 6 decimals: at 4, this ~0.0005-scale ratio quantizes so
+             # coarsely that one rounding ULP reads as a 17-33% "step" to
+             # the bench_compare/bench_trend gates
+             "vs_baseline": round(deep_rate / kahn_deep_rate, 6),
              "vs_baseline_kind": "host-kahn",
              "baseline_qps": round(kahn_deep_rate, 1),
              "fixpoint_sweeps": deep_sweeps,
@@ -939,18 +942,52 @@ def main(em: Emitter):
 
     # -- BASELINE configs[0]/[1]/[3]/[4]: secondary metrics (buffered; the
     #    driver contract keeps stdout to the ONE headline JSON line, last) --
+
+    def best_of(fn, n=3):
+        """Per-row best-of-n for the wall-clock config sections: this box's
+        speed oscillates 2-4x on multi-minute scales (CHANGES r10/r11 both
+        quoted externally re-run cleanest-of-N artifacts for exactly this
+        reason — r12 moves that inside the artifact so one run is
+        reproducibly quotable).  Each metric row is taken WHOLE from the
+        invocation where its headline value peaked, so derived columns
+        (vs_baseline, baseline_qps, routes) stay internally consistent;
+        sim-time rows (configs 0/1) stay single-shot — they are
+        byte-deterministic and need no quoting policy."""
+        best, order = {}, []
+        last_err = None
+        for _ in range(n):
+            try:
+                rows = fn()
+            except Exception as e:
+                # one transient invocation failure must not discard the
+                # rows the other invocations measured
+                last_err = e
+                continue
+            for row in rows:
+                key = row["metric"]
+                if key not in best:
+                    order.append(key)
+                    best[key] = row
+                elif (row.get("value") or 0) > (best[key].get("value") or 0):
+                    best[key] = row
+        if not best and last_err is not None:
+            raise last_err
+        for key in order:
+            best[key]["quoted"] = f"best-of-{n}"
+        return [best[k] for k in order]
+
     try:
         for row in bench_maelstrom_configs():
             em.config(row)
     except Exception as e:   # secondary metric must not sink the headline
         em.note(f"# CONFIG 0/1 failed: {e!r}")
     try:
-        for row in bench_hot_keys():
+        for row in best_of(bench_hot_keys):
             em.config(row)
     except Exception as e:
         em.note(f"# CONFIG 3 failed: {e!r}")
     try:
-        for row in bench_launch_amortized():
+        for row in best_of(bench_launch_amortized):
             em.config(row)
     except Exception as e:
         em.note(f"# CONFIG 5 failed: {e!r}")
@@ -963,16 +1000,47 @@ def main(em: Emitter):
                             + " --xla_force_host_platform_device_count=8"
                             ).strip()
         env["JAX_ENABLE_X64"] = "true"
-        child = subprocess.run(
-            [sys.executable, __file__, "--config4"], env=env,
-            capture_output=True, text=True, timeout=420)
-        for line in child.stdout.splitlines():
-            if line.strip().startswith("{"):
-                em.config(json.loads(line.strip()))
-        if child.returncode != 0:
-            em.note(f"# CONFIG 4 failed: {child.stderr[-400:]}")
+
+        def config4_rows():
+            child = subprocess.run(
+                [sys.executable, __file__, "--config4"], env=env,
+                capture_output=True, text=True, timeout=420)
+            rows = [json.loads(line.strip())
+                    for line in child.stdout.splitlines()
+                    if line.strip().startswith("{")]
+            if child.returncode != 0 or not rows:
+                raise RuntimeError(
+                    f"config4 rc={child.returncode}: {child.stderr[-400:]}")
+            return rows
+
+        for row in best_of(config4_rows):
+            em.config(row)
     except Exception as e:
         em.note(f"# CONFIG 4 failed: {e!r}")
+
+    # -- CONFIG 6 (r12): the real serving surface — N OS processes on
+    #    loopback TCP, open-loop Poisson sweep at 0.5x/1x/3x saturation.
+    #    Wall-clock rows (platform column set); the graceful-overload
+    #    verdict is asserted by the child (rc!=0 on a collapse) --
+    try:
+        import os
+        import subprocess
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_ENABLE_X64"] = "true"
+        serve = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "serve_bench.py"), "--bench"],
+            env=env, capture_output=True, text=True, timeout=420)
+        for line in serve.stdout.splitlines():
+            if line.strip().startswith("{"):
+                em.config(json.loads(line.strip()))
+        if serve.returncode != 0:
+            em.note(f"# CONFIG 6 (serving) FAILED rc={serve.returncode}: "
+                    f"{serve.stderr[-600:]}")
+    except Exception as e:
+        em.note(f"# CONFIG 6 (serving) failed: {e!r}")
 
 
 if __name__ == "__main__":

@@ -62,6 +62,42 @@ def test_checked_in_waivers_all_match_real_steps(capsys):
             "a waiver without a real post-mortem reason is not a waiver"
 
 
+def test_compare_waivers_all_match_real_steps(capsys):
+    """r12: the pairwise tool's compare_waivers obey the same no-dead-
+    documentation contract — each entry must name a step bench_compare
+    actually flags between the two checked-in artifacts it cites, carry a
+    real verdict, and be silenced by the waiver (rc 0 with, rc 2 in the
+    --no-waivers self-proof mode)."""
+    import bench_compare
+    waivers = bench_compare.load_compare_waivers(
+        os.path.join(REPO, "tools", "bench_waivers.json"))
+    assert waivers, "r12 recorded at least one compare waiver"
+    for w in waivers:
+        assert len(w.get("reason", "")) > 40, \
+            "a waiver without a real post-mortem reason is not a waiver"
+        old = os.path.join(REPO, f"BENCH_{w['from']}.json")
+        new = os.path.join(REPO, f"BENCH_{w['to']}.json")
+        assert os.path.exists(old) and os.path.exists(new), w
+        with pytest.raises(SystemExit) as exc:
+            bench_compare.main([old, new, "--no-waivers"])
+        assert exc.value.code == 2, \
+            f"stale compare waiver: {w['metric']} {w['from']}->{w['to']}"
+        out = capsys.readouterr()
+        assert w["metric"] in out.err, \
+            f"waived metric never flags: {w['metric']}"
+        # with the waiver honored the pairwise gate passes and says so
+        bench_compare.main([old, new])   # SystemExit(2) would fail the test
+        out = capsys.readouterr()
+        assert f"WAIVED {w['metric']}" in out.out
+
+
+def test_compare_round_parse():
+    import bench_compare
+    assert bench_compare.artifact_round("/x/BENCH_r11.json") == "r11"
+    assert bench_compare.artifact_round("BENCH_r07_foo.json") == "r07"
+    assert bench_compare.artifact_round("/tmp/whatever.json") is None
+
+
 def test_series_cover_the_documented_families():
     """The sentinel must watch every family the issue names: headline,
     config rows, vs_baseline, phase latencies, fast-path rate, index

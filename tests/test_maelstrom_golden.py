@@ -94,6 +94,51 @@ def test_golden_error_frame_for_malformed_txn():
     assert isinstance(err.get("code"), int)
 
 
+def test_golden_datum_kind_frames():
+    """All four reference datum kinds (ref: maelstrom/Datum.java Kind
+    {STRING, LONG, DOUBLE, HASH}) survive the client JSON boundary
+    field-exact: strings/longs/doubles as native scalars (64-bit longs
+    intact), HASH as ``{"hash": n}`` — appended and read back in order."""
+    big = (1 << 33) + 7   # past int32: a real 64-bit long
+    lines = [
+        FIXTURE_IN[0],
+        {"id": 1, "src": "c1", "dest": "n1",
+         "body": {"type": "txn", "msg_id": 2,
+                  "txn": [["append", 5, "s1"], ["append", 5, big],
+                          ["r", 5, None]]}},
+        {"id": 2, "src": "c1", "dest": "n1",
+         "body": {"type": "txn", "msg_id": 3,
+                  "txn": [["append", 5, 2.5], ["append", 5, {"hash": 99}],
+                          ["r", 5, None]]}},
+        {"id": 3, "src": "c1", "dest": "n1",
+         "body": {"type": "txn", "msg_id": 4, "txn": [["r", 5, None]]}},
+    ]
+    out = _run_node(lines)
+    client = [m for m in out if m["dest"] == "c1"]
+    want = [
+        {"type": "init_ok", "in_reply_to": 1},
+        {"type": "txn_ok", "in_reply_to": 2,
+         "txn": [["append", 5, "s1"], ["append", 5, big],
+                 ["r", 5, ["s1", big]]]},
+        {"type": "txn_ok", "in_reply_to": 3,
+         "txn": [["append", 5, 2.5], ["append", 5, {"hash": 99}],
+                 ["r", 5, ["s1", big, 2.5, {"hash": 99}]]]},
+        {"type": "txn_ok", "in_reply_to": 4,
+         "txn": [["r", 5, ["s1", big, 2.5, {"hash": 99}]]]},
+    ]
+    assert len(client) == len(want), out
+    for msg, w in zip(client, want):
+        body = msg["body"]
+        assert body["type"] == w["type"]
+        assert body["in_reply_to"] == w["in_reply_to"]
+        if "txn" in w:
+            assert body["txn"] == w["txn"], (
+                f"datum frame mismatch: {body['txn']} != {w['txn']}")
+    # the long survived EXACTLY (json round-trip did not go through float)
+    final_read = client[-1]["body"]["txn"][0][2]
+    assert final_read[1] == big and isinstance(final_read[1], int)
+
+
 def test_golden_frames_are_deterministic():
     """Same stdin -> byte-identical stdout for the client-visible frames
     (msg_ids included): the framing layer has no hidden nondeterminism."""

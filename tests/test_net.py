@@ -1,0 +1,638 @@
+"""TCP serving surface (r12): framing, admission control, loopback
+golden-frame byte-identity, the 2-process cluster smoke, kill-9 recovery,
+and the (slow) open-loop overload sweep.
+
+The sim remains THE correctness story — these tests cover the layer the
+sim by construction cannot: real sockets (partial reads, coalesced
+writes, resets), real processes (kill -9, reconnect backoff), and real
+wall-clock queueing under open-loop overload (shed-not-collapse).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from accord_tpu.net.admission import (AdmissionGate, Overloaded,
+                                      device_health_of)
+from accord_tpu.net.framing import (MAX_FRAME, FrameDecoder, FrameError,
+                                    encode_frame)
+from accord_tpu.net.transport import (BACKOFF_BASE_MICROS,
+                                      BACKOFF_CAP_MICROS, backoff_micros)
+from accord_tpu.utils import faults
+from accord_tpu.utils.random_source import RandomSource
+
+
+# ---------------------------------------------------------------------------
+# framing: one frame survives ANY kernel segmentation
+# ---------------------------------------------------------------------------
+
+PACKETS = [
+    {"src": "c1", "dest": "n1", "body": {"type": "init", "msg_id": 1,
+                                         "node_id": "n1",
+                                         "node_ids": ["n1", "n2"]}},
+    {"src": "c1", "dest": "n1",
+     "body": {"type": "txn", "msg_id": 2,
+              "txn": [["append", 7, 1], ["r", 7, None]]}},
+    # the four reference datum kinds on the client boundary
+    {"src": "c1", "dest": "n1",
+     "body": {"type": "txn", "msg_id": 3,
+              "txn": [["append", 1, "s0"], ["append", 2, (1 << 33) + 5],
+                      ["append", 3, 2.5], ["append", 4, {"hash": 77}]]}},
+    {"src": "n1", "dest": "n2",
+     "body": {"type": "accord_req", "msg_id": 9,
+              "payload": {"_t": "PreAccept", "x": [1, 2, 3],
+                          "nested": {"deep": ["a", None, True]}}}},
+    {"src": "n2", "dest": "n1", "body": {"type": "accord_reply",
+                                         "in_reply_to": 9,
+                                         "payload": {"_t": "PreAcceptOk"}}},
+    # unicode + empty body edges
+    {"src": "cé", "dest": "n1", "body": {}},
+]
+
+
+def test_frame_roundtrip_each_packet():
+    for pkt in PACKETS:
+        dec = FrameDecoder()
+        out = dec.feed(encode_frame(pkt))
+        assert out == [pkt]
+        assert dec.pending_bytes() == 0
+
+
+def test_frame_decoder_partial_reads_byte_at_a_time():
+    """The most hostile segmentation the kernel can produce: one byte per
+    read, across every frame boundary."""
+    blob = b"".join(encode_frame(p) for p in PACKETS)
+    dec = FrameDecoder()
+    out = []
+    for i in range(len(blob)):
+        out.extend(dec.feed(blob[i:i + 1]))
+    assert out == PACKETS
+    assert dec.pending_bytes() == 0
+
+
+def test_frame_decoder_coalesced_single_read():
+    """All frames in one read() — plus a trailing partial frame that must
+    buffer, not deliver."""
+    blob = b"".join(encode_frame(p) for p in PACKETS)
+    tail = encode_frame(PACKETS[0])
+    dec = FrameDecoder()
+    out = dec.feed(blob + tail[:5])
+    assert out == PACKETS
+    assert dec.pending_bytes() == 5
+    assert dec.feed(tail[5:]) == [PACKETS[0]]
+
+
+def test_frame_decoder_random_segmentation():
+    """Deterministic random chunking over the concatenated stream."""
+    rs = RandomSource(13)
+    blob = b"".join(encode_frame(p) for p in PACKETS * 3)
+    dec = FrameDecoder()
+    out, i = [], 0
+    while i < len(blob):
+        n = 1 + rs.next_int(17)
+        out.extend(dec.feed(blob[i:i + n]))
+        i += n
+    assert out == PACKETS * 3
+
+
+def test_frame_error_on_oversized_length():
+    dec = FrameDecoder()
+    bad = (MAX_FRAME + 1).to_bytes(4, "big") + b"x"
+    with pytest.raises(FrameError):
+        dec.feed(bad)
+
+
+def test_frame_error_on_garbage_length():
+    """TLS/HTTP bytes read as a length prefix must be rejected, not
+    allocated."""
+    dec = FrameDecoder()
+    with pytest.raises(FrameError):
+        dec.feed(b"\xffGET / HTTP/1.1\r\n")
+
+
+def test_encode_rejects_oversized_payload():
+    with pytest.raises(FrameError):
+        encode_frame({"pad": "x" * (MAX_FRAME + 1)})
+
+
+# ---------------------------------------------------------------------------
+# reconnect backoff: capped exponential + deterministic jitter
+# ---------------------------------------------------------------------------
+
+def test_backoff_grows_and_caps():
+    js = RandomSource(5)
+    vals = [backoff_micros(a, js) for a in range(20)]
+    # base doubles until the cap; jitter adds < base/2 on top
+    assert vals[0] >= BACKOFF_BASE_MICROS
+    assert vals[0] < BACKOFF_BASE_MICROS * 1.5
+    for v in vals:
+        assert v < BACKOFF_CAP_MICROS * 1.5
+    assert max(vals) >= BACKOFF_CAP_MICROS
+
+
+def test_backoff_deterministic_per_seed():
+    a = [backoff_micros(i, RandomSource(9)) for i in range(8)]
+    b = [backoff_micros(i, RandomSource(9)) for i in range(8)]
+    c = [backoff_micros(i, RandomSource(10)) for i in range(8)]
+    assert a == b
+    assert a != c   # distinct streams desynchronize co-failed links
+
+
+# ---------------------------------------------------------------------------
+# admission gate: bounded budget + AIMD + ladder composition
+# ---------------------------------------------------------------------------
+
+def test_admission_hard_budget_bounds_inflight():
+    g = AdmissionGate(max_inflight=4, min_budget=1)
+    admits = [g.try_admit()[0] for _ in range(6)]
+    assert admits == [True] * 4 + [False] * 2
+    assert g.inflight == 4
+    ok, reason, retry_ms = g.try_admit()
+    assert not ok and reason == "inflight" and retry_ms >= 25
+    g.release(1000)
+    assert g.try_admit()[0]   # a freed slot admits again
+
+
+def test_admission_release_never_goes_negative():
+    g = AdmissionGate(max_inflight=2)
+    g.try_admit()
+    g.release(10)
+    g.release(10)   # spurious double-release must not corrupt state
+    assert g.inflight == 0
+    assert all(g.try_admit()[0] for _ in range(2))
+
+
+def test_admission_aimd_cuts_on_high_p99_and_recovers():
+    # window == one adjust period so the recovery phase's fast samples
+    # flush the overload samples out of the sliding p99 immediately
+    g = AdmissionGate(max_inflight=32, target_p99_micros=1000, min_budget=2,
+                      window=32)
+    # drive completions far over target: budget shrinks multiplicatively
+    for _ in range(3 * g.ADJUST_EVERY):
+        ok, _, _ = g.try_admit()
+        g.release(50_000)
+    assert g.n_latency_cuts >= 3
+    assert g.dyn_budget < 32
+    cut = g.dyn_budget
+    # now comfortably below target: budget recovers additively (+1/adjust)
+    for _ in range(4 * g.ADJUST_EVERY):
+        assert g.try_admit()[0]   # admit-release pairs: inflight 0 -> 1 -> 0
+        g.release(100)
+    assert g.dyn_budget > cut
+    assert g.dyn_budget <= 32
+
+
+def test_admission_budget_never_below_min():
+    g = AdmissionGate(max_inflight=16, target_p99_micros=1, min_budget=3)
+    for _ in range(20 * g.ADJUST_EVERY):
+        if g.try_admit()[0]:
+            g.release(10_000)
+    assert g.effective_budget() >= 3
+    assert g.try_admit()[0] or g.inflight >= 3
+
+
+def test_admission_latency_shed_reason():
+    g = AdmissionGate(max_inflight=32, target_p99_micros=1, min_budget=1)
+    for _ in range(2 * g.ADJUST_EVERY):   # force cuts
+        if g.try_admit()[0]:
+            g.release(10_000)
+    # fill the (cut) budget, then shed: the reason names the controller
+    while g.try_admit()[0]:
+        pass
+    assert g.n_shed.get("latency", 0) >= 1
+    assert g.stats()["shed"]["latency"] >= 1
+
+
+def test_admission_quarantine_scales_budget_down():
+    health = [1.0]
+    g = AdmissionGate(max_inflight=8, min_budget=1,
+                      device_health=lambda: health[0])
+    assert g.effective_budget() == 8
+    health[0] = 0.5   # half the stores quarantined -> half the budget
+    assert g.effective_budget() == 4
+    for _ in range(4):
+        assert g.try_admit()[0]
+    ok, reason, _ = g.try_admit()
+    assert not ok and reason == "quarantine"
+    health[0] = 1.0   # ladder restores -> budget restores
+    assert g.effective_budget() == 8
+    assert g.try_admit()[0]
+
+
+def test_admission_unrecorded_release_frees_slot_without_teaching():
+    """release(None) — the instant synchronous error paths — frees the
+    slot but must NOT feed the AIMD latency window: poison traffic that
+    fails in microseconds cannot argue the node is fast while genuine
+    coordinations are slow."""
+    g = AdmissionGate(max_inflight=8, target_p99_micros=1000, min_budget=1,
+                      window=32)
+    # genuine overload: window full of slow samples, budget cut
+    for _ in range(2 * g.ADJUST_EVERY):
+        g.try_admit()
+        g.release(50_000)
+    cut = g.dyn_budget
+    assert cut < 8
+    # a flood of instant failures frees slots but teaches nothing
+    for _ in range(4 * g.ADJUST_EVERY):
+        if g.try_admit()[0]:
+            g.release(None, ok=False)
+    assert g.dyn_budget == cut, "unrecorded releases moved the budget"
+    assert g.inflight == 0
+    assert g.sliding_p99() >= 50_000   # window still holds the truth
+
+
+def test_admission_sliding_p99_reads_window():
+    g = AdmissionGate(max_inflight=4, window=100)
+    assert g.sliding_p99() is None
+    for i in range(100):
+        g.try_admit()
+        g.release(i)
+    assert 95 <= g.sliding_p99() <= 99
+
+
+def test_device_health_of_counts_quarantined_stores():
+    class Dev:
+        host_pinned = False
+        _dev_quar_flushes = 0
+
+    class Store:
+        def __init__(self, dev):
+            self.device = dev
+
+    class Stores:
+        pass
+
+    class Node:
+        command_stores = Stores()
+
+    healthy, sick = Dev(), Dev()
+    sick._dev_quar_flushes = 3
+    Node.command_stores.stores = [Store(healthy), Store(sick)]
+    assert device_health_of(Node()) == 0.5
+    sick._dev_quar_flushes = 0
+    assert device_health_of(Node()) == 1.0
+    # host-mode stores (no device) count healthy
+    Node.command_stores.stores = [Store(None)]
+
+    class HostStore:
+        device = None
+    Node.command_stores.stores = [HostStore()]
+    assert device_health_of(Node()) == 1.0
+
+
+def test_overloaded_error_carries_retry_hint():
+    exc = Overloaded(retry_after_ms=250, reason="latency")
+    assert exc.retry_after_ms == 250
+    assert exc.reason == "latency"
+
+
+# ---------------------------------------------------------------------------
+# socket faults: seedable, env-armed, deterministic
+# ---------------------------------------------------------------------------
+
+def test_socket_fault_env_spec_parse():
+    armed = faults.arm_socket_faults_from_env(
+        "conn_reset:0.25:7,slow_link:0.5:9")
+    try:
+        assert armed == {"conn_reset": 0.25, "slow_link": 0.5}
+        assert faults.active_socket_faults() == armed
+    finally:
+        faults.clear_socket_faults()
+    assert faults.active_socket_faults() == {}
+
+
+def test_socket_fault_draws_deterministic():
+    with faults.socket_fault("conn_reset", 0.3, RandomSource(21)):
+        a = [faults.socket_fault_fires("conn_reset") for _ in range(64)]
+    with faults.socket_fault("conn_reset", 0.3, RandomSource(21)):
+        b = [faults.socket_fault_fires("conn_reset") for _ in range(64)]
+    assert a == b
+    assert any(a) and not all(a)
+    # unarmed: no draws anywhere, never fires
+    assert not faults.socket_fault_fires("conn_reset")
+
+
+def test_socket_fault_delay_bounds():
+    with faults.socket_fault("stalled_peer", 1.0, RandomSource(3)):
+        for _ in range(16):
+            d = faults.socket_fault_delay_micros("stalled_peer")
+            assert 100_000 <= d < 600_000
+    with faults.socket_fault("slow_link", 1.0, RandomSource(3)):
+        for _ in range(16):
+            assert 5_000 <= faults.socket_fault_delay_micros(
+                "slow_link") < 60_000
+
+
+def test_socket_fault_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        faults.inject_socket_fault("packet_gremlin", 0.5, RandomSource(1))
+
+
+# ---------------------------------------------------------------------------
+# golden frames over a REAL loopback socket: byte-identity through the
+# kernel under partial reads and coalesced writes
+# ---------------------------------------------------------------------------
+
+def _loopback_roundtrip(frames, write_plan):
+    """Echo ``frames`` (encoded bytes) through a real asyncio TCP loopback
+    server using ``write_plan(blob) -> [chunk, ...]`` to segment the
+    client->server stream; returns the decoded packets the server saw and
+    the raw bytes the client got echoed back."""
+    async def run():
+        seen = []
+        got = bytearray()
+        done = asyncio.Event()
+        want = sum(len(f) for f in frames)
+
+        async def handle(reader, writer):
+            dec = FrameDecoder()
+            while True:
+                chunk = await reader.read(7)   # tiny reads server-side too
+                if not chunk:
+                    break
+                for pkt in dec.feed(chunk):
+                    seen.append(pkt)
+                    writer.write(encode_frame(pkt))   # echo re-encoded
+                    await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+
+        async def read_back():
+            while len(got) < want:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                got.extend(chunk)
+            done.set()
+
+        task = asyncio.get_event_loop().create_task(read_back())
+        for chunk in write_plan(b"".join(frames)):
+            writer.write(chunk)
+            await writer.drain()
+        await asyncio.wait_for(done.wait(), 20)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        task.cancel()
+        return seen, bytes(got)
+    return asyncio.run(run())
+
+
+def _golden_packets():
+    """The golden frame corpus: Maelstrom client-boundary packets (all
+    four datum kinds) + REAL inter-node protocol payloads captured from an
+    in-process run through the full wire codec."""
+    from accord_tpu import wire
+    from accord_tpu.sim.cluster import Cluster
+    from accord_tpu.sim.kvstore import KVDataStore, kv_txn
+    from accord_tpu.sim.topology_factory import build_topology
+    from accord_tpu.sim import cluster as cluster_mod
+
+    pkts = list(PACKETS)
+    topology = build_topology(1, (1, 2, 3), 3, 4)
+    cluster = Cluster(topology=topology, seed=3,
+                      data_store_factory=KVDataStore)
+    captured = []
+    orig = cluster_mod.NodeSink.send_with_callback
+
+    def tap(self, to, request, cb):
+        captured.append((self.node_id, to, request))
+        return orig(self, to, request, cb)
+
+    cluster_mod.NodeSink.send_with_callback = tap
+    try:
+        for i in range(3):
+            cluster.nodes[1 + (i % 3)].coordinate(
+                kv_txn([i * 10, (i + 1) * 10], {i * 10: (i,)})).begin(
+                lambda r, f: None)
+        cluster.run_until_quiescent()
+    finally:
+        cluster_mod.NodeSink.send_with_callback = orig
+    assert len(captured) >= 10
+    for n, (src, dst, req) in enumerate(captured[:24]):
+        pkts.append({"src": f"n{src}", "dest": f"n{dst}",
+                     "body": {"type": "accord_req", "msg_id": 1000 + n,
+                              "payload": wire.encode(req)}})
+    return pkts
+
+
+def test_golden_frames_roundtrip_loopback_byte_identical():
+    """Every golden wire frame crosses a real kernel socket and comes back
+    BYTE-IDENTICAL, under three segmentations: one-shot coalesced write,
+    per-frame writes, and a deterministic shredder (partial frames across
+    write boundaries).  The server decodes with 7-byte reads (forced
+    partial reads) and re-encodes — so byte-identity also proves
+    decode -> re-encode is the identity on every frame."""
+    pkts = _golden_packets()
+    frames = [encode_frame(p) for p in pkts]
+    want = b"".join(frames)
+
+    def coalesced(blob):
+        return [blob]
+
+    def per_frame(_blob):
+        return list(frames)
+
+    def shredded(blob):
+        rs = RandomSource(99)
+        out, i = [], 0
+        while i < len(blob):
+            n = 1 + rs.next_int(23)
+            out.append(blob[i:i + n])
+            i += n
+        return out
+
+    for plan in (coalesced, per_frame, shredded):
+        seen, got = _loopback_roundtrip(frames, plan)
+        assert seen == pkts, f"decode mismatch under {plan.__name__}"
+        assert got == want, f"byte mismatch under {plan.__name__}"
+
+
+# ---------------------------------------------------------------------------
+# the real cluster: 2-process loopback smoke (tier-1), kill-9 recovery,
+# and the slow overload sweep
+# ---------------------------------------------------------------------------
+
+def test_tcp_cluster_smoke_two_nodes():
+    """Tier-1: 2 OS processes on loopback TCP, 100 client txns with
+    retry-with-backoff, tight sink timeouts.  Full success, zero duplicate
+    client replies, both nodes alive at the end."""
+    from accord_tpu.net.harness import run_smoke
+    result = run_smoke(n_txns=100, n_nodes=2)
+    assert result["ok"] == 100
+    assert result["duplicate_replies"] == 0
+    assert all(result["alive"].values())
+
+
+def test_kill9_recovery_and_rejoin():
+    """Kill -9 one node of three mid-run: the survivors keep committing
+    (quorum 2/3), no duplicate client replies ever, and the restarted
+    node rejoins through the peers' reconnect backoff."""
+    from accord_tpu.net.client import ClusterClient
+    from accord_tpu.net.harness import (ServeCluster, _mk_ops, wait_ready)
+    import random
+
+    cluster = ServeCluster(n_nodes=3, request_timeout_ms=800)
+    cluster.spawn_all()
+    try:
+        async def scenario():
+            client = ClusterClient(cluster.addrs, timeout=8.0)
+            try:
+                await wait_ready(cluster, client)
+                rng = random.Random(3)
+                counter = [0]
+
+                async def burst(n, nodes):
+                    ok = 0
+                    for i in range(n):
+                        await client.submit_retry(
+                            _mk_ops(rng, counter, 16), retries=12,
+                            timeout=6.0, node=nodes[i % len(nodes)])
+                        ok += 1
+                    return ok
+
+                # phase 1: all three nodes serving
+                assert await burst(12, cluster.names) == 12
+                # phase 2: kill -9 n2 mid-run; drive the survivors
+                cluster.kill9("n2")
+                assert await burst(12, ["n1", "n3"]) == 12
+                assert cluster.procs["n2"].poll() is not None
+                # phase 3: restart n2 (same name/port, fresh state) and
+                # wait for it to serve again — the client re-dials, the
+                # peers' outbound links reconnect through their backoff
+                cluster.spawn("n2")
+                await wait_ready(cluster, client)
+                assert (await client.ping("n2"))["type"] == "pong"
+                assert await burst(8, ["n1", "n3"]) == 8
+                # peers reconnected to the restarted node
+                reconnects = 0
+                for name in ("n1", "n3"):
+                    s = await client.stats(name)
+                    link = s["links"]["n2"]
+                    assert link["connected"], s["links"]
+                    reconnects += link["reconnects"]
+                assert reconnects >= 2, "peers never re-dialed n2"
+                # the at-most-once contract held through kill+reconnect
+                assert client.duplicate_replies() == 0
+                return True
+            finally:
+                await client.close()
+
+        assert asyncio.run(scenario())
+        alive = cluster.alive()
+        assert alive == {"n1": True, "n2": True, "n3": True}, alive
+    finally:
+        cluster.shutdown()
+
+
+def test_malformed_txns_do_not_leak_admission_slots():
+    """A txn that blows up AFTER admission (malformed op shape -> handler
+    exception; unsupported verb -> code-10 error) must release its slot:
+    admit_max such packets would otherwise wedge the node at 100% shed
+    forever.  One node, budget 4, 3x-budget poison, then service must
+    still work."""
+    import asyncio as aio
+    from accord_tpu.net.client import ClusterClient, TxnFailed
+    from accord_tpu.net.harness import ServeCluster, wait_ready
+
+    cluster = ServeCluster(n_nodes=1, admit_max=4, request_timeout_ms=800)
+    cluster.spawn_all()
+    try:
+        async def scenario():
+            client = ClusterClient(cluster.addrs, timeout=6.0)
+            try:
+                await wait_ready(cluster, client)
+                conn = client.conns["n1"]
+                for i in range(12):   # 3x the whole budget
+                    if i % 2 == 0:
+                        # crashes in the handler after admit: no reply
+                        try:
+                            await conn.request(
+                                {"type": "txn", "txn": [["append"]]},
+                                client.next_msg_id(), timeout=0.5)
+                        except aio.TimeoutError:
+                            pass
+                    else:
+                        # unsupported verb: explicit code-10 error reply
+                        try:
+                            await client.submit([["cas", 1, 2]])
+                        except TxnFailed:
+                            pass
+                # all 12 slots must have been released: normal txns fit
+                # the budget of 4 again (an Overloaded here = the leak)
+                for _ in range(6):
+                    body = await client.submit([["append", 3, 1]])
+                    assert body["type"] == "txn_ok"
+                stats = await client.stats("n1")
+                adm = stats["admission"]
+                assert adm["inflight"] == 0, adm
+                return True
+            finally:
+                await client.close()
+
+        assert aio.run(scenario())
+        assert all(cluster.alive().values())
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+def test_overload_sheds_instead_of_collapsing():
+    """The graceful-overload assertion (slow tier): at ~3x saturation the
+    cluster sheds explicitly, admitted p99 stays bounded, goodput holds,
+    nobody dies."""
+    from accord_tpu.net.client import ClusterClient
+    from accord_tpu.net.harness import (ServeCluster, open_loop,
+                                        saturation_probe, wait_ready)
+
+    cluster = ServeCluster(n_nodes=3, admit_max=16, target_p99_ms=2500,
+                           request_timeout_ms=3000)
+    cluster.spawn_all()
+    try:
+        async def scenario():
+            client = ClusterClient(cluster.addrs, timeout=10.0)
+            try:
+                await wait_ready(cluster, client, timeout=90.0)
+                await saturation_probe(client, workers=4, duration=1.0,
+                                       seed=3)   # warm
+                probe = await saturation_probe(client, workers=60,
+                                               duration=4.0, seed=42)
+                at1 = await open_loop(client, rate=probe["rate"],
+                                      duration=6.0, seed=17)
+                at3 = await open_loop(client, rate=3 * probe["rate"],
+                                      duration=6.0, seed=18)
+                return probe, at1, at3, client.duplicate_replies()
+            finally:
+                await client.close()
+
+        probe, at1, at3, dups = asyncio.run(scenario())
+        assert at3.shed > 0, "no explicit sheds at 3x saturation"
+        sat_p99 = max(x for x in (probe["p99_ms"], at1.latency_ms(0.99))
+                      if x is not None)
+        assert at3.latency_ms(0.99) <= 2.0 * sat_p99, \
+            (at3.latency_ms(0.99), sat_p99)
+        assert at3.goodput >= 0.8 * at1.goodput, (at3.goodput, at1.goodput)
+        assert dups == 0
+        assert all(cluster.alive().values())
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.parametrize("spec", ["conn_reset:0.04:5", "stalled_peer:0.03:5",
+                                  "slow_link:0.25:5"])
+def test_smoke_under_socket_faults(spec):
+    """Each socket-fault class, armed in every node process: the cluster
+    recovers every txn (sink timeouts + reconnect backoff own recovery)
+    with zero duplicate client replies.  tools/run_fault_matrix.sh runs
+    the same legs with post-mortem dumps."""
+    from accord_tpu.net.harness import run_smoke
+    result = run_smoke(n_txns=60, n_nodes=2, net_faults=spec)
+    assert result["ok"] == 60
+    assert result["duplicate_replies"] == 0
+    assert all(result["alive"].values())

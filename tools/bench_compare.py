@@ -30,6 +30,17 @@ covers:
   metric can be a regression hiding by deletion.  Neither fails the
   pairwise gate (``tools/bench_trend.py`` owns cross-round series).
 
+Waivers (r12): a flagged step can be downgraded to WAIVED by an entry in
+the ``compare_waivers`` list of ``tools/bench_waivers.json`` matching this
+exact (metric, from-round, to-round) pair — rounds are parsed from the
+``BENCH_rNN`` artifact filenames.  Same discipline as the trend sentinel's
+``waivers``: the reason must record a forensic verdict, ``--no-waivers``
+is the self-proof mode, and ``tests/test_bench_trend.py`` fails any waiver
+that does not match a step this tool actually flags (no dead
+documentation).  The lists are separate because the gates differ: the
+pairwise gate is 10%, the trend gate 50% — a step can be pairwise noise
+yet trend-visible, or vice versa.
+
 Exit status: 0 = no regression, 1 = usage/parse error, 2 = regression
 beyond threshold.  Every comparison prints either way — the tool is the
 artifact diff first, the CI gate second.
@@ -37,6 +48,8 @@ artifact diff first, the CI gate second.
 
 import argparse
 import json
+import os
+import re
 import sys
 
 
@@ -96,6 +109,25 @@ def parse_artifact(path, strict=True):
     return headline, configs, parse_index_counters(text)
 
 
+def artifact_round(path):
+    """"rNN" from a BENCH_rNN* filename, else None (waivers need both
+    sides' rounds to match an entry — unround-named files never waive)."""
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return f"r{int(m.group(1)):02d}" if m else None
+
+
+def load_compare_waivers(path):
+    """[{metric, from, to, reason}] from the ``compare_waivers`` key
+    (absent file or key = empty set; the trend sentinel's ``waivers`` key
+    is a different gate and is deliberately NOT read here)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    return doc.get("compare_waivers", []) if isinstance(doc, dict) else []
+
+
 def check(name, old, new, threshold, lower_is_better=False):
     """One comparison row; returns the failure message or None."""
     if old in (None, 0) or new is None:
@@ -113,7 +145,7 @@ def check(name, old, new, threshold, lower_is_better=False):
     if ratio < 1.0 - threshold:
         verdict = f"REGRESSION (-{(1 - ratio) * 100:.1f}% beyond "\
                   f"{threshold * 100:.0f}%)"
-        fail = f"{name}: {old} -> {new} ({verdict})"
+        fail = (name, f"{name}: {old} -> {new} ({verdict})")
     print(f"  {name:58s} {old:>12} -> {new:>12} {arrow} "
           f"[{ratio:.2f}x] {verdict}")
     return fail
@@ -129,6 +161,12 @@ def main(argv=None):
                         "0.10; this box's bench spread is ~1.15x)")
     p.add_argument("--latency-threshold", type=float, default=0.25,
                    help="allowed latency regression fraction (default 0.25)")
+    p.add_argument("--waivers", default=None,
+                   help="waiver file (default: tools/bench_waivers.json "
+                        "next to this script; the compare_waivers list)")
+    p.add_argument("--no-waivers", action="store_true",
+                   help="ignore the waiver file (self-proof mode: a waived "
+                        "step must still flag here)")
     args = p.parse_args(argv)
 
     old_head, old_cfg, old_idx = parse_artifact(args.old)
@@ -203,12 +241,27 @@ def main(argv=None):
         print(f"  {m:58s} {old_cfg[m].get('value')!r:>12} -> "
               f"{'(gone)':>12}  GONE (was this intentional?)")
     failures = [f for f in failures if f]
-    if failures:
-        print(f"\nFAIL: {len(failures)} regression(s):", file=sys.stderr)
-        for f in failures:
-            print(f"  {f}", file=sys.stderr)
+    # waivers: downgrade flagged steps whose (metric, from, to) carry a
+    # recorded forensic verdict — same discipline as the trend sentinel
+    waiver_path = args.waivers or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_waivers.json")
+    waivers = [] if args.no_waivers else load_compare_waivers(waiver_path)
+    r_old, r_new = artifact_round(args.old), artifact_round(args.new)
+    active, waived = [], []
+    for name, msg in failures:
+        w = next((w for w in waivers
+                  if w.get("metric") == name and w.get("from") == r_old
+                  and w.get("to") == r_new), None)
+        (waived if w else active).append((name, msg, w))
+    for name, _msg, w in waived:
+        print(f"\nWAIVED {name} [{r_old}->{r_new}]: {w.get('reason', '')}")
+    if active:
+        print(f"\nFAIL: {len(active)} regression(s):", file=sys.stderr)
+        for _name, msg, _w in active:
+            print(f"  {msg}", file=sys.stderr)
         raise SystemExit(2)
-    print("\nok: no regression beyond threshold")
+    print("\nok: no regression beyond threshold"
+          + (f" ({len(waived)} waived)" if waived else ""))
 
 
 if __name__ == "__main__":

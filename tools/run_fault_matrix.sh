@@ -10,12 +10,42 @@
 # snapshots of both runs + the flight-recorder bundles + span exports) to
 # $FAULT_MATRIX_OUT (default /tmp) — the nondeterminism diff arrives WITH
 # the causal context, instead of a bare stat-key list.
+# r12 adds the network-boundary leg: the 2-process TCP smoke under each
+# injectable SOCKET fault class (conn_reset / stalled_peer / slow_link,
+# seedable, drawn from the injected RandomSource only) — on any failing
+# leg the harness dumps every node's flight post-mortem + serving stats
+# to $FAULT_MATRIX_OUT before failing.  ACCORD_TPU_FAULT_MATRIX=device or
+# =net runs one half only.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-exec env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+HALF="${ACCORD_TPU_FAULT_MATRIX:-all}"
+
+run_net_leg() {
+    echo ""
+    echo "== network-boundary socket-fault legs (2-process TCP smoke) =="
+    local rc=0
+    for spec in "conn_reset:0.04:5" "stalled_peer:0.03:5" "slow_link:0.25:5"; do
+        echo "-- leg: $spec"
+        if ! env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+            python -m accord_tpu.net.harness --smoke --txns 60 --nodes 2 \
+            --net-faults "$spec" --out "${FAULT_MATRIX_OUT:-/tmp}"; then
+            echo "   LEG FAILED: $spec (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
+            rc=1
+        fi
+    done
+    return $rc
+}
+
+if [ "$HALF" = "net" ]; then
+    run_net_leg
+    exit $?
+fi
+
+device_rc=0
+env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
-    python - <<'PY'
+    python - <<'PY' || device_rc=$?
 import json
 import os
 import sys
@@ -91,3 +121,20 @@ if failures:
 print("\nfault matrix clean: every class x seed deterministic and "
       "byte-equivalent to the fault-free baseline")
 PY
+
+net_rc=0
+if [ "$HALF" != "device" ]; then
+    run_net_leg || net_rc=$?
+fi
+
+if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ]; then
+    echo ""
+    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc)"
+    exit 1
+fi
+echo ""
+if [ "$HALF" = "device" ]; then
+    echo "device fault matrix clean (network half skipped: ACCORD_TPU_FAULT_MATRIX=device)"
+else
+    echo "full fault matrix clean (device + network boundary)"
+fi

