@@ -1,0 +1,278 @@
+"""Group commit: one fsync acknowledges every append in the batch.
+
+The durability tax of a WAL is the fsync, not the write: appends are
+page-cache stores (~µs) while an fsync is device-dependent (~50µs on a
+fast NVMe, ~10ms on spinning rust, ~wild on a loaded CI box).  Syncing
+per record would put that full cost on EVERY transaction; group commit
+opens a short *batching window* after the first un-synced append and one
+fsync at window close acknowledges the whole batch — per-txn durability
+cost amortizes to fsync/batch_size, exactly the shape of the r08 fused
+launches (one launch answers every member store).
+
+The window is PRICED, never a hard threshold (the r06 router discipline):
+``probe_fsync_micros`` measures this directory's actual fsync cost once
+per process (median of a few 4KB write+fsync rounds) and the window is a
+small multiple of it, clamped to sane bounds — a fast device flushes
+almost eagerly (window ≈ its own fsync cost: batching can't win much, so
+latency isn't spent chasing it), a slow device batches harder (the window
+buys proportionally more amortization).
+
+``after_durable(fn)`` is the acknowledgement edge the serving node hangs
+replies on: fn runs once every record appended so far is fsynced — either
+immediately (nothing pending) or at the batch's fsync.
+
+Failed fsync is terminal for the durability PROMISE (the postgres
+fsync-gate lesson: the kernel may have dropped the dirty pages, so a
+retry that "succeeds" proves nothing).  Policy is the r07 ladder's:
+degrade loudly, never die — the journal marks itself failed, releases
+every waiter (availability over a guarantee it can no longer make),
+counts it, and the owner stands journaling down.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .wal import WriteAheadLog
+
+# window = clamp(WINDOW_FACTOR * probed_fsync, MIN, MAX) micros
+WINDOW_FACTOR = 2.0
+WINDOW_MIN_MICROS = 200
+WINDOW_MAX_MICROS = 8_000
+
+# once-per-process fsync cost per directory's filesystem (keyed on the
+# device id so every journal on one mount shares the probe)
+_probe_cache: Dict[int, int] = {}
+
+
+def probe_fsync_micros(directory: str, rounds: int = 5) -> int:
+    """Median write+fsync cost of a small record in ``directory``."""
+    try:
+        dev = os.stat(directory).st_dev
+    except OSError:
+        dev = -1
+    cached = _probe_cache.get(dev)
+    if cached is not None:
+        return cached
+    samples = []
+    try:
+        fd, path = tempfile.mkstemp(prefix=".fsync-probe-", dir=directory)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                payload = b"\x00" * 4096
+                for _ in range(rounds):
+                    t0 = time.perf_counter_ns()
+                    f.write(payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                    samples.append((time.perf_counter_ns() - t0) // 1_000)
+        finally:
+            os.unlink(path)
+    except OSError:
+        samples = [1_000]
+    samples.sort()
+    cost = max(1, samples[len(samples) // 2])
+    _probe_cache[dev] = cost
+    return cost
+
+
+def priced_window_micros(directory: str) -> int:
+    cost = probe_fsync_micros(directory)
+    return max(WINDOW_MIN_MICROS,
+               min(WINDOW_MAX_MICROS, int(cost * WINDOW_FACTOR)))
+
+
+class GroupCommit:
+    """Batching layer over one :class:`WriteAheadLog`.
+
+    ``defer(delay_seconds, fn)`` schedules the window-close flush (the
+    serving node passes ``loop.call_later``); with ``defer=None`` the
+    commit runs SYNCHRONOUS — every append flushes immediately (tests,
+    and any caller that wants classic sync-per-record semantics)."""
+
+    def __init__(self, wal: WriteAheadLog,
+                 defer: Optional[Callable[[float, Callable[[], None]],
+                                          object]] = None,
+                 window_micros: Optional[int] = None,
+                 metrics=None,
+                 async_exec: Optional[Callable] = None):
+        self.wal = wal
+        self.defer = defer
+        # async_exec(work, done): run ``work`` OFF the owning thread and
+        # call ``done(exception_or_None)`` back ON it.  The serving node
+        # passes run_in_executor: an fsync is milliseconds of IO-wait,
+        # and paying it inline would stall the single protocol thread
+        # for the whole batch window (measured: ~3x goodput loss on a
+        # slow /tmp).  None = fsync inline (tests, sim, CLI callers).
+        self.async_exec = async_exec
+        self.window_micros = (window_micros if window_micros is not None
+                              else priced_window_micros(wal.directory))
+        self.metrics = metrics
+        self.failed = False
+        self.n_flushes = 0
+        self.n_fsync_failures = 0
+        self.n_batch_records = 0
+        self._waiters: List[Tuple[int, Callable[[], None]]] = []
+        self._flush_scheduled = False
+        self._sync_inflight = False
+        # the async batch's captured files: a concurrent flush(sync=True)
+        # must fsync these TOO before it may advance durable_seq past
+        # records the worker has not confirmed yet
+        self._inflight_files: List[tuple] = []
+
+    # -- append / acknowledge ------------------------------------------------
+    def append(self, doc: dict) -> Optional[int]:
+        """One record into the current batch; returns its seq, or None
+        when the record did NOT land (journal already degraded, or this
+        very write failed and degraded it).  Raises nothing — after
+        degrade, appends are absorbed and acked immediately (the
+        in-memory journal remains the node's working state)."""
+        if self.failed:
+            return None
+        try:
+            seq = self.wal.append(doc)
+        except OSError as exc:
+            self._degrade(f"append failed: {exc!r}")
+            return None
+        if self.defer is None:
+            self.flush()
+        else:
+            self._schedule_flush()
+        return seq
+
+    def after_durable(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` once everything appended so far is durable."""
+        if self.failed or self.wal.durable_seq >= self.wal.tail_seq:
+            fn()
+            return
+        self._waiters.append((self.wal.tail_seq, fn))
+        self._schedule_flush()
+
+    def _schedule_flush(self) -> None:
+        if self._flush_scheduled or self.defer is None or self.failed:
+            return
+        self._flush_scheduled = True
+        self.defer(self.window_micros / 1e6, self._window_close)
+
+    def _window_close(self) -> None:
+        self._flush_scheduled = False
+        self.flush()
+
+    # -- the durability point ------------------------------------------------
+    def flush(self, sync: bool = False) -> None:
+        """fsync the batch and release every waiter it covers.  With
+        ``async_exec`` wired the fsync runs on a worker thread (one in
+        flight at a time; a batch that lands mid-sync triggers a
+        follow-up); ``sync=True`` forces the inline path — the
+        flush-before-issue HLC reservation needs a blocking guarantee."""
+        if self.failed:
+            self._release(self.wal.tail_seq)
+            return
+        pending = self.wal.tail_seq - self.wal.durable_seq
+        if pending <= 0:
+            self._release(self.wal.durable_seq)
+            return
+        if self.async_exec is not None and not sync:
+            self._flush_async()
+            return
+        # inline path (sync=True, or no worker wired).  If a worker batch
+        # is in flight its files were removed from the dirty set — fsync
+        # them HERE TOO before claiming their records durable (concurrent
+        # fsync of one fd is kernel-safe; the worker's own completion
+        # then lands as a no-op behind the max() guard).
+        t0 = time.perf_counter_ns()
+        tail, files = self.wal.begin_sync()
+        try:
+            self.wal.sync_files(files + self._inflight_files)
+        except OSError as exc:
+            self._degrade(f"fsync failed: {exc!r}")
+            self._release(self.wal.tail_seq)
+            return
+        self.wal.complete_sync(tail, reap=not self._sync_inflight)
+        self._account(pending, (time.perf_counter_ns() - t0) // 1_000)
+        self._release(tail)
+
+    def _flush_async(self) -> None:
+        if self._sync_inflight:
+            # the in-flight sync's completion re-checks for new records
+            return
+        self._sync_inflight = True
+        base = self.wal.durable_seq
+        tail, files = self.wal.begin_sync()
+        self._inflight_files = files
+        t0 = time.perf_counter_ns()
+
+        def work():
+            self.wal.sync_files(files)
+
+        def done(exc) -> None:
+            self._sync_inflight = False
+            self._inflight_files = []
+            if exc is not None:
+                # ValueError = file closed under the worker (shutdown
+                # race): same degrade path as a failed fsync, never an
+                # unhandled loop exception
+                if isinstance(exc, (OSError, ValueError)):
+                    self._degrade(f"fsync failed: {exc!r}")
+                    self._release(self.wal.tail_seq)
+                    return
+                raise exc
+            self.wal.complete_sync(tail)
+            self._account(tail - base,
+                          (time.perf_counter_ns() - t0) // 1_000)
+            self._release(tail)
+            # records that landed while the batch was syncing: open the
+            # next window (don't fsync back-to-back for a near-empty
+            # batch unless someone is waiting)
+            if self.wal.tail_seq > tail and (self._waiters
+                                             or self.defer is None):
+                if self.defer is not None:
+                    self._schedule_flush()
+                else:
+                    self.flush()
+
+        self.async_exec(work, done)
+
+    def _account(self, batch: int, micros: int) -> None:
+        self.n_flushes += 1
+        self.n_batch_records += batch
+        if self.metrics is not None:
+            self.metrics.counter("journal_fsyncs").inc()
+            self.metrics.histogram("journal_fsync_micros").observe(micros)
+            self.metrics.histogram("journal_commit_batch").observe(batch)
+
+    def _release(self, durable_seq: int) -> None:
+        if not self._waiters:
+            return
+        ready = [fn for seq, fn in self._waiters if seq <= durable_seq]
+        self._waiters = [(seq, fn) for seq, fn in self._waiters
+                         if seq > durable_seq]
+        for fn in ready:
+            fn()
+
+    def _degrade(self, why: str) -> None:
+        """Durability can no longer be promised: loud, counted, alive."""
+        if not self.failed:
+            self.failed = True
+            self.n_fsync_failures += 1
+            if self.metrics is not None:
+                self.metrics.counter("journal_fsync_failures").inc()
+            print(f"[journal] DEGRADED (durability off): {why}",
+                  file=sys.stderr, flush=True)
+        # a failed journal still releases everyone: availability over a
+        # promise it can no longer make
+        self._release(self.wal.tail_seq)
+
+    def stats(self) -> dict:
+        return {
+            "window_micros": self.window_micros,
+            "flushes": self.n_flushes,
+            "batch_records": self.n_batch_records,
+            "fsync_failures": self.n_fsync_failures,
+            "failed": self.failed,
+            "pending_waiters": len(self._waiters),
+        }

@@ -1,0 +1,244 @@
+"""Seeded disk-fault / crash-point self-test — the fault matrix's disk leg.
+
+    python -m accord_tpu.journal.selftest [--seeds 0 5 11]
+
+For every injectable disk-fault class (``utils.faults.DISK_FAULT_KINDS``:
+torn_write / short_read / failed_fsync) × seed, plus a seeded
+crash-point truncation sweep, the harness:
+
+1. writes a deterministic synthetic record stream (real wire-encoded
+   primitives across every record kind) through the full
+   WAL + group-commit stack with the fault armed;
+2. recovers the directory cold and asserts the PREFIX CONTRACT: the
+   recovered state is byte-identical (canonical JSON) to an in-memory
+   replay of exactly the records that survived on disk — a fault may
+   cost the un-synced tail, never a mis-replay, never a crash;
+3. runs every leg TWICE with the same seed and asserts the recovered
+   bytes match — the same determinism bar the device and socket halves
+   of ``tools/run_fault_matrix.sh`` hold.
+
+Exit 0 on a clean matrix, 1 with a per-leg problem list otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+from typing import List, Tuple
+
+from .. import wire
+from ..local.status import Durability, SaveStatus
+from ..primitives.keys import Range, Ranges
+from ..primitives.timestamp import Ballot, Domain, TxnId, TxnKind
+from ..utils import faults
+from ..utils.random_source import RandomSource
+from .durable import DurableJournal
+
+
+def gen_docs(seed: int, n: int) -> List[dict]:
+    """Deterministic mixed-kind record stream from real primitives."""
+    rs = RandomSource(seed)
+    enc = wire.encode
+    docs: List[dict] = []
+    for i in range(n):
+        tid = TxnId.create(1, 1000 + i * 3 + rs.next_int(2), TxnKind.Write,
+                           Domain.Key, 1 + rs.next_int(3))
+        kind = rs.next_int(6)
+        if kind == 0:
+            docs.append({"k": "reg", "sid": rs.next_int(2), "t": enc(tid),
+                         "ss": enc(SaveStatus(2 + rs.next_int(8))),
+                         "ex": enc(tid), "pr": enc(Ballot.ZERO),
+                         "ac": enc(Ballot.ZERO),
+                         "du": enc(Durability.NotDurable)})
+        elif kind == 1:
+            docs.append({"k": "hlc", "b": 1_000_000 + i * 1000})
+        elif kind == 2:
+            docs.append({"k": "reply", "src": f"c{rs.next_int(4)}",
+                         "m": i, "b": {"type": "txn_ok", "txn": [
+                             ["append", rs.next_int(64), i]]}})
+        elif kind == 3:
+            docs.append({"k": "apply", "tok": rs.next_int(64),
+                         "v": enc((i, f"v{i}")), "at": enc(tid),
+                         "t": enc(tid)})
+        elif kind == 4:
+            docs.append({"k": "wm", "sid": rs.next_int(2),
+                         "d": enc([(0, 1 << 32, tid, tid)]),
+                         "r": enc([(0, 1 << 16, tid)])})
+        else:
+            docs.append({"k": "bsat", "sid": rs.next_int(2),
+                         "rg": enc(Ranges.of(Range(0, 1 << 20))),
+                         "f": enc(tid)})
+    return docs
+
+
+def reference_state(docs: List[dict], upto_seq: int, workdir: str) -> str:
+    """Canonical state of an in-memory replay of records seq <= upto_seq
+    (seq is 1-based position in the stream)."""
+    ref_dir = os.path.join(workdir, "ref")
+    shutil.rmtree(ref_dir, ignore_errors=True)
+    j = DurableJournal(ref_dir, defer=None, window_micros=0)
+    j._replaying = True
+    try:
+        for i, doc in enumerate(docs):
+            if i + 1 > upto_seq:
+                break
+            j.apply_record(doc)
+    finally:
+        j._replaying = False
+    out = j.canonical_state_json()
+    j.close()
+    return out
+
+
+def write_stream(directory: str, docs: List[dict],
+                 segment_bytes: int = 2048) -> DurableJournal:
+    """Append the stream through the real stack (tiny segments so legs
+    cross roll boundaries); a fired fault stops the stream early, exactly
+    like the crash it models."""
+    j = DurableJournal(directory, defer=None, window_micros=0,
+                       segment_bytes=segment_bytes)
+    for doc in docs:
+        j.commit.append(doc)
+        if j.commit.failed:
+            break
+    return j
+
+
+def run_leg(kind: str, seed: int, workdir: str, n: int = 120) -> Tuple[str, dict]:
+    """One fault leg: returns (canonical recovered state, census)."""
+    docs = gen_docs(seed, n)
+    live_dir = os.path.join(workdir, f"live-{kind}-{seed}")
+    shutil.rmtree(live_dir, ignore_errors=True)
+    if kind == "clean":
+        j = write_stream(live_dir, docs)
+        written = j.wal.tail_seq
+        j.close()
+    else:
+        prob = {"torn_write": 0.05, "short_read": 0.0,
+                "failed_fsync": 0.04}[kind]
+        with faults.disk_fault(kind, prob, RandomSource(seed ^ 0xD15C)):
+            j = write_stream(live_dir, docs)
+        written = j.wal.tail_seq
+        # abandon, don't close: close() syncs, and the leg models a death
+        j.wal._dirty = []
+        try:
+            j.wal._active._f.close()
+        except Exception:
+            pass
+    # cold recovery (short_read armed HERE for its leg: the fault is a
+    # read-side failure)
+    if kind == "short_read":
+        with faults.disk_fault(kind, 0.5, RandomSource(seed ^ 0x5EAD)):
+            r = DurableJournal(live_dir, defer=None, window_micros=0)
+    else:
+        r = DurableJournal(live_dir, defer=None, window_micros=0)
+    recovered = r.canonical_state_json()
+    tail = r.wal.tail_seq
+    # census compares across the double-run: deterministic fields ONLY
+    # (replay wall-clock stays out)
+    census = {"written": written, "recovered_seq": tail,
+              "torn_bytes": r.wal.n_truncated_bytes,
+              "replayed": r.replay_stats["replayed"],
+              "bad": r.replay_stats["bad_records"]}
+    r.close()
+    # prefix contract: recovered == replay of exactly the surviving seqs
+    want = reference_state(docs, tail, workdir)
+    if recovered != want:
+        raise AssertionError(
+            f"{kind} seed {seed}: recovered state diverged from the "
+            f"replay of its own surviving prefix (seq<={tail})")
+    if tail > written:
+        raise AssertionError(
+            f"{kind} seed {seed}: recovered MORE records ({tail}) than "
+            f"were ever written ({written})")
+    return recovered, census
+
+
+def crash_point_sweep(seed: int, workdir: str, points: int = 40) -> int:
+    """Seeded truncation sweep: write a clean stream, then chop the WAL
+    at ``points`` drawn byte offsets (mid-frame included) and assert
+    every recovery equals the replay of its surviving prefix."""
+    docs = gen_docs(seed, 100)
+    base = os.path.join(workdir, f"sweep-{seed}")
+    shutil.rmtree(base, ignore_errors=True)
+    write_stream(base, docs).close()
+    seg_paths = sorted(
+        os.path.join(base, p) for p in os.listdir(base)
+        if p.startswith("wal-"))
+    blobs = [open(p, "rb").read() for p in seg_paths]
+    total = sum(len(b) for b in blobs)
+    rs = RandomSource(seed ^ 0xC4A5)
+    checked = 0
+    for _ in range(points):
+        cut = rs.next_int(total) + 1
+        case = os.path.join(workdir, "sweep-case")
+        shutil.rmtree(case, ignore_errors=True)
+        os.makedirs(case)
+        left = cut
+        for p, blob in zip(seg_paths, blobs):
+            take = min(left, len(blob))
+            left -= take
+            if take > 0:
+                with open(os.path.join(case, os.path.basename(p)),
+                          "wb") as f:
+                    f.write(blob[:take])
+        r = DurableJournal(case, defer=None, window_micros=0)
+        got = r.canonical_state_json()
+        tail = r.wal.tail_seq
+        r.close()
+        want = reference_state(docs, tail, workdir)
+        if got != want:
+            raise AssertionError(
+                f"sweep seed {seed} cut {cut}: recovered state != replay "
+                f"of surviving prefix (seq<={tail})")
+        checked += 1
+    return checked
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="journal disk-fault self-test")
+    p.add_argument("--seeds", type=int, nargs="+", default=[0, 5, 11])
+    p.add_argument("--workdir", default=None)
+    args = p.parse_args(argv)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="accord_journal_st_")
+    kinds = ["clean"] + sorted(faults.DISK_FAULT_KINDS)
+    failures = []
+    for seed in args.seeds:
+        for kind in kinds:
+            try:
+                a, ca = run_leg(kind, seed, workdir)
+                b, cb = run_leg(kind, seed, workdir)
+                line = (f"seed {seed} {kind:>13}: written={ca['written']} "
+                        f"recovered={ca['recovered_seq']} "
+                        f"torn_bytes={ca['torn_bytes']}")
+                if a != b or ca != cb:
+                    failures.append(f"seed {seed} {kind}: NONDETERMINISTIC "
+                                    f"recovery")
+                    line += "  <-- NONDETERMINISTIC"
+            except AssertionError as exc:
+                failures.append(str(exc))
+                line = f"seed {seed} {kind:>13}: FAILED {exc}"
+            print(line, flush=True)
+        try:
+            n = crash_point_sweep(seed, workdir)
+            print(f"seed {seed}   crash-sweep: {n} truncation points clean",
+                  flush=True)
+        except AssertionError as exc:
+            failures.append(str(exc))
+            print(f"seed {seed}   crash-sweep: FAILED {exc}", flush=True)
+    shutil.rmtree(workdir, ignore_errors=True)
+    if failures:
+        print("\nDISK FAULT LEG FAILED:")
+        for f in failures:
+            print("  " + f)
+        return 1
+    print("\ndisk fault leg clean: every class x seed deterministic, "
+          "recovery == replay of the surviving prefix")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -102,6 +102,7 @@ class MaelstromSink(api.MessageSink):
         self._next_msg_id = 0
         self.pending: Dict[int, _Pending] = {}
         self._timeouts: List[List] = []   # [deadline, msg_id] min-heap
+        self._tombstones = 0              # resolved entries still heaped
         self._jitter = jitter
 
     def _msg_id(self) -> int:
@@ -141,14 +142,41 @@ class MaelstromSink(api.MessageSink):
         p = self.pending.pop(msg_id, None)
         if p is not None:
             p.entry[2] = None
+            self._tombstones += 1
+            # r13 fix: a tombstone still OCCUPIES its heap slot until its
+            # deadline sweeps past — for slow-read requests that is 10x
+            # the base horizon, so a burst of requests resolved against a
+            # node that then restarts leaves dead [deadline, tie, None]
+            # entries heaped long past the horizon.  Once tombstones
+            # outnumber live entries, rebuild the heap from the live set
+            # (the entry lists are shared, so later tombstoning of a
+            # carried-over entry still works in place).
+            if self._tombstones > 64 and self._tombstones > len(self.pending):
+                self._compact_timeouts()
         return p
+
+    def _compact_timeouts(self) -> None:
+        self._timeouts = [q.entry for q in self.pending.values()]
+        heapq.heapify(self._timeouts)
+        self._tombstones = 0
 
     def reply(self, to: int, reply_context, reply) -> None:
         if reply_context is None:
             return   # local requests (Propagate) have no reply path
-        self._emit(to, {"type": "accord_rsp", "msg_id": self._msg_id(),
-                        "in_reply_to": reply_context,
-                        "payload": wire.encode(reply)})
+        body = {"type": "accord_rsp", "msg_id": self._msg_id(),
+                "in_reply_to": reply_context,
+                "payload": wire.encode(reply)}
+        journal = self.process.durable_journal()
+        if journal is not None and journal.gate_protocol_replies():
+            # strict mode (--journal-sync all): a protocol reply is a
+            # PROMISE about this node's state (a PreAcceptOk promises the
+            # witness, an AcceptReply the ballot) — it leaves only once
+            # the WAL records backing it (journaled at _process entry and
+            # during the store update) are fsynced.  One batch fsync
+            # releases every reply in the window.
+            journal.commit.after_durable(lambda: self._emit(to, body))
+        else:
+            self._emit(to, body)
 
     def reply_with_unknown_failure(self, to: int, reply_context,
                                    failure: BaseException) -> None:
@@ -170,6 +198,7 @@ class MaelstromSink(api.MessageSink):
         while self._timeouts and self._timeouts[0][0] <= now:
             _deadline, _tie, msg_id = heapq.heappop(self._timeouts)
             if msg_id is None:
+                self._tombstones = max(0, self._tombstones - 1)
                 continue   # tombstone: resolved before its deadline
             p = self.pending.pop(msg_id, None)
             if p is None:
@@ -234,7 +263,8 @@ class MaelstromProcess:
                  device_mode: Optional[bool] = None,
                  durability: bool = True,
                  obs=None,
-                 request_timeout_micros: Optional[int] = None):
+                 request_timeout_micros: Optional[int] = None,
+                 journal=None):
         self._emit_raw = emit
         self.scheduler = scheduler
         self.now_micros = now_micros
@@ -245,6 +275,9 @@ class MaelstromProcess:
         # run so bench config rows read phase latencies + fast-path rate)
         self.obs = obs
         self.enable_durability = durability
+        # on-disk journal (accord_tpu.journal.DurableJournal) — None means
+        # the r12 behaviour: a kill -9 rejoin is fresh-state
+        self.journal = journal
         # sink-owned request timeout (the TCP serving surface tightens it;
         # the Maelstrom default stays wide for cold-compile stalls)
         self.request_timeout_micros = (request_timeout_micros
@@ -259,6 +292,15 @@ class MaelstromProcess:
         self._names_by_id: Dict[int, str] = {}
         self._client_msg_id = 0
         self._sweeper = None
+
+    def durable_journal(self):
+        """The armed on-disk journal, or None (also None once its group
+        commit has degraded: no gating on a promise it can't keep)."""
+        j = self.journal
+        if j is None or getattr(j, "commit", None) is None \
+                or j.commit.failed:
+            return None
+        return j
 
     # -- outbound -----------------------------------------------------------
     def emit_packet(self, to, body: dict) -> None:
@@ -275,6 +317,34 @@ class MaelstromProcess:
     def _reply_client(self, dest: str, in_reply_to: int, body: dict) -> None:
         self._client_msg_id += 1
         body = dict(body)
+        body["msg_id"] = self._client_msg_id
+        body["in_reply_to"] = in_reply_to
+        journal = self.journal
+        if journal is not None and hasattr(journal, "record_reply") \
+                and body.get("type") == "txn_ok":
+            # at-most-once across death: the reply this node now OWES is a
+            # journal fact (keyed by the client's msg_id; our own msg_id
+            # is re-stamped on any re-send).  Under the "all"/"client"
+            # sync policies it leaves only once the txn's journal records
+            # — and the owed-reply record itself — are fsynced: acked =>
+            # durable.  A restarted incarnation answers a duplicate
+            # request from this table instead of re-coordinating.  On a
+            # DEGRADED journal the table still records in memory (the
+            # dedupe contract outlives durability) but nothing gates.
+            stored = {k: v for k, v in body.items() if k != "msg_id"}
+            journal.record_reply(dest, in_reply_to, stored)
+            if self.durable_journal() is not None \
+                    and journal.gate_client_replies():
+                journal.commit.after_durable(
+                    lambda: self._emit_raw(dest, body))
+                return
+        self._emit_raw(dest, body)
+
+    def _replay_client_reply(self, dest: str, in_reply_to: int,
+                             stored: dict) -> None:
+        """Re-serve an already-journaled reply to a duplicate request."""
+        self._client_msg_id += 1
+        body = dict(stored)
         body["msg_id"] = self._client_msg_id
         body["in_reply_to"] = in_reply_to
         self._emit_raw(dest, body)
@@ -318,18 +388,35 @@ class MaelstromProcess:
         # the node id — the protocol RandomSource below is untouched
         self.sink = MaelstromSink(self, jitter=RandomSource(
             0x51D ^ (my_id << 12)))
+        if self.journal is not None:
+            # the data store's appends become journal facts too — the
+            # premise 'the data store is durable' that restore() assumes
+            from ..journal import JournaledKVDataStore
+            data_store = JournaledKVDataStore(my_id, self.journal)
+        else:
+            data_store = KVDataStore(my_id)
         self.node = Node(
             node_id=my_id, message_sink=self.sink,
             config_service=StaticConfigService(topology),
             scheduler=self.scheduler,
-            data_store=KVDataStore(my_id),
+            data_store=data_store,
             agent=MaelstromAgent(self),
             random=RandomSource(my_id * 7919),
             now_micros=self.now_micros,
             num_stores=self.num_stores,
-            device_mode=self.device_mode)
+            device_mode=self.device_mode,
+            journal=self.journal)
         self.node.obs = self.obs
-        self.node.on_topology_update(topology)
+        if self.journal is not None and self.journal.has_restored_state():
+            # kill -9 recovery: re-ingest the (static) topology WITHOUT
+            # re-bootstrapping, seed the fresh data store with the
+            # recovered value logs, then rebuild every store's commands
+            # through the SAME restore path the sim's restart tests pin
+            self.node.restore_topologies([topology])
+            self.journal.install_data(data_store)
+            self.journal.restore(self.node)
+        else:
+            self.node.on_topology_update(topology)
         self._sweeper = self.scheduler.recurring(SWEEP_INTERVAL_MICROS,
                                                  self.sink.sweep)
         # background durability rounds -> watermarks -> truncation
@@ -361,6 +448,18 @@ class MaelstromProcess:
     def _handle_txn(self, src: str, body: dict) -> None:
         ops = body["txn"]
         msg_id = body["msg_id"]
+        journal = self.journal
+        if journal is not None and hasattr(journal, "replied_body"):
+            # the at-most-once table (journaled, restart-durable): a
+            # duplicate of an already-answered request gets the SAME
+            # reply back — never a second coordination, never silence.
+            # Consulted from the IN-MEMORY table even after the group
+            # commit degrades: losing durability must not also lose the
+            # dedupe contract for this incarnation's lifetime.
+            stored = journal.replied_body(src, msg_id)
+            if stored is not None:
+                self._replay_client_reply(src, msg_id, stored)
+                return
         # admission gate (accord_tpu.net.admission) FIRST: a shed must be
         # the cheapest possible outcome — no token hashing, no datum
         # decode, no coordination state — just a fast, explicit Overloaded

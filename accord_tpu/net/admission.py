@@ -51,6 +51,54 @@ class Overloaded(RuntimeError):
         self.reason = reason
 
 
+class SpanPhaseP99:
+    """Windowed per-phase p99 from the r09 span trees (ROADMAP item 4's
+    second open remainder): the coordinate FSMs already stamp every phase
+    into ``phase_micros{phase=}`` histograms — this reader diffs those
+    bucket counts between admission-controller adjust points and returns
+    the worst per-phase p99 of the DELTA, so the controller sees the same
+    sliding-window shape its own root measurement gave it, but sourced
+    from the span instrumentation (and able to flag a single ballooning
+    phase, e.g. a replica-side ``deps_wait``, before the root mean moves).
+
+    Returns None when the spans are disabled (``ACCORD_TPU_OBS=off``) or
+    the window holds too few samples — the gate then falls back to its
+    own root-span measurement, exactly the r12 behaviour."""
+
+    MIN_SAMPLES = 8
+
+    def __init__(self, metrics, name: str = "phase_micros"):
+        self.metrics = metrics
+        self.name = name
+        self._prev: Dict[Tuple, Dict[int, int]] = {}
+
+    def read(self) -> Optional[int]:
+        from ..obs.metrics import Histogram
+        worst = None
+        for (n, labels), h in sorted(self.metrics._m.items()):
+            if n != self.name or not hasattr(h, "buckets"):
+                continue
+            prev = self._prev.get(labels, {})
+            delta = {b: c - prev.get(b, 0) for b, c in h.buckets.items()
+                     if c - prev.get(b, 0) > 0}
+            self._prev[labels] = dict(h.buckets)
+            count = sum(delta.values())
+            if count < self.MIN_SAMPLES:
+                continue
+            # reuse the registry histogram's percentile (its min/max
+            # clamp keeps the log2 bucket's up-to-2x upper-bound bias
+            # out of the controller: a steady true p99 just over a
+            # power of two must not read as nearly double the target)
+            w = Histogram()
+            w.buckets = delta
+            w.count = count
+            w.vmin, w.vmax = h.vmin, h.vmax
+            p99 = w.percentile(0.99)
+            if p99 is not None and (worst is None or p99 > worst):
+                worst = p99
+        return worst
+
+
 class AdmissionGate:
     """Bounded in-flight budget + sliding-p99 AIMD controller.
 
@@ -58,6 +106,12 @@ class AdmissionGate:
     the completion latency into the sliding window the controller reads.
     All state is plain ints/floats — the hot-path cost of an admit is two
     comparisons and an increment.
+
+    When ``phase_p99`` is wired (a :class:`SpanPhaseP99` reader over the
+    obs registry), the controller's latency signal comes from the span
+    trees' per-phase histograms instead; the root-span sliding window is
+    kept as the fallback so the gate still works under
+    ``ACCORD_TPU_OBS=off``.
     """
 
     # controller shape: recompute every ADJUST_EVERY completions; cut the
@@ -73,17 +127,20 @@ class AdmissionGate:
                  min_budget: int = 4,
                  window: int = 512,
                  device_health: Optional[Callable[[], float]] = None,
-                 metrics=None):
+                 metrics=None,
+                 phase_p99: Optional[Callable[[], Optional[int]]] = None):
         self.max_inflight = max_inflight
         self.target_p99_micros = target_p99_micros
         self.min_budget = min(min_budget, max_inflight)
         self.device_health = device_health
         self.metrics = metrics
+        self.phase_p99 = phase_p99
         self.inflight = 0
         self.dyn_budget = float(max_inflight)
         self._lat = deque(maxlen=window)
         self._since_adjust = 0
         self._p99: Optional[int] = None
+        self._p99_source = "root"
         # counters (also mirrored into the metrics registry when wired)
         self.n_admitted = 0
         self.n_released = 0
@@ -157,7 +214,17 @@ class AdmissionGate:
             self._adjust()
 
     def _adjust(self) -> None:
-        p99 = self.sliding_p99()
+        p99 = None
+        self._p99_source = "root"
+        if self.phase_p99 is not None:
+            # span-tree feed (ROADMAP item 4 remainder): worst per-phase
+            # p99 of the window between adjust points; None (obs off /
+            # too few samples) falls through to the root measurement
+            p99 = self.phase_p99()
+            if p99 is not None:
+                self._p99_source = "spans"
+        if p99 is None:
+            p99 = self.sliding_p99()
         self._p99 = p99
         if p99 is None:
             return
@@ -184,6 +251,7 @@ class AdmissionGate:
             "shed_total": sum(self.n_shed.values()),
             "latency_cuts": self.n_latency_cuts,
             "sliding_p99_micros": self._p99,
+            "p99_source": self._p99_source,
         }
 
 

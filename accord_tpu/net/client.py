@@ -140,9 +140,21 @@ class NodeConnection:
 class ClusterClient:
     """Round-robin client over every node of a serving cluster."""
 
-    def __init__(self, addrs: List[Tuple[str, str, int]], src: str = "c1",
+    # distinct default src per client incarnation: the server's journaled
+    # at-most-once table keys on (src, msg_id) under Maelstrom's contract
+    # that a client process never reuses the pair — two clients both
+    # calling themselves "c1" with counters restarting at 1 would collide
+    # and the second would be served the first's cached reply
+    _incarnation = 0
+
+    def __init__(self, addrs: List[Tuple[str, str, int]],
+                 src: Optional[str] = None,
                  timeout: float = 10.0, retry_seed: int = 1):
+        import os
         self.addrs = addrs
+        if src is None:
+            ClusterClient._incarnation += 1
+            src = f"c{os.getpid()}i{ClusterClient._incarnation}"
         self.src = src
         self.timeout = timeout
         self.conns: Dict[str, NodeConnection] = {}

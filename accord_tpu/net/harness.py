@@ -60,7 +60,8 @@ class ServeCluster:
                  durability: bool = False,
                  net_faults: Optional[str] = None,
                  log_dir: Optional[str] = None,
-                 extra_args: Optional[List[str]] = None):
+                 extra_args: Optional[List[str]] = None,
+                 journal_root: Optional[str] = None):
         self.names = [f"n{i}" for i in range(1, n_nodes + 1)]
         ports = free_ports(n_nodes)
         self.addrs: List[Tuple[str, str, int]] = [
@@ -73,6 +74,9 @@ class ServeCluster:
         self.durability = durability
         self.net_faults = net_faults
         self.extra_args = extra_args or []
+        # per-node durable journal dirs (<root>/<name>): a kill -9'd node
+        # respawned with the same name recovers its pre-crash state
+        self.journal_root = journal_root
         self.log_dir = log_dir or tempfile.mkdtemp(prefix="accord_serve_")
         self.procs: Dict[str, subprocess.Popen] = {}
         self._logs: Dict[str, object] = {}
@@ -100,6 +104,9 @@ class ServeCluster:
             cmd += ["--request-timeout-ms", str(self.request_timeout_ms)]
         if not self.durability:
             cmd.append("--no-durability")
+        if self.journal_root:
+            cmd += ["--journal-dir",
+                    os.path.join(self.journal_root, name)]
         cmd += self.extra_args
         log = open(os.path.join(self.log_dir, f"{name}.log"), "ab")
         self._logs[name] = log

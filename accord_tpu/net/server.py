@@ -109,7 +109,12 @@ class NodeServer:
                  admit_max: int = 64,
                  target_p99_ms: int = 1000,
                  min_budget: int = 4,
-                 request_timeout_ms: Optional[int] = None):
+                 request_timeout_ms: Optional[int] = None,
+                 journal_dir: Optional[str] = None,
+                 journal_window_us: Optional[int] = None,
+                 journal_snapshot_every: Optional[int] = None,
+                 journal_segment_bytes: Optional[int] = None,
+                 journal_sync: Optional[str] = None):
         self.name = name
         self.host = host
         self.port = port
@@ -122,11 +127,17 @@ class NodeServer:
         self.target_p99_ms = target_p99_ms
         self.min_budget = min_budget
         self.request_timeout_ms = request_timeout_ms
+        self.journal_dir = journal_dir
+        self.journal_window_us = journal_window_us
+        self.journal_snapshot_every = journal_snapshot_every
+        self.journal_segment_bytes = journal_segment_bytes
+        self.journal_sync = journal_sync
         self._start_ns = time.monotonic_ns()
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.links: Dict[str, PeerLink] = {}
         self._clients: Dict[str, asyncio.StreamWriter] = {}
         self.proc = None
+        self.journal = None
         self.gate: Optional[AdmissionGate] = None
         self.frame_server: Optional[FrameServer] = None
         self.n_client_replies = 0
@@ -235,6 +246,8 @@ class NodeServer:
                                  if proc and proc.sink else 0),
             "failures": len(proc.failures) if proc else 0,
             "socket_faults": faults.active_socket_faults(),
+            "journal": (self.journal.stats()
+                        if self.journal is not None else None),
         }
 
     # -- lifecycle ------------------------------------------------------------
@@ -243,24 +256,53 @@ class NodeServer:
         from ..obs import Observability
         self.loop = asyncio.get_event_loop()
         faults.arm_socket_faults_from_env()
+        faults.arm_disk_faults_from_env()
         scheduler = AsyncioScheduler(self.loop)
         obs = Observability(now=self.now_micros)
+        if self.journal_dir:
+            # durable journal (r13): recover-or-create BEFORE the node
+            # exists — the restored state rides into MaelstromProcess's
+            # init handshake via the journal= parameter
+            from ..journal import open_journal
+
+            def _async_exec(work, done):
+                # batch fsyncs run on a worker thread: milliseconds of
+                # IO-wait must not stall the single protocol thread
+                fut = self.loop.run_in_executor(None, work)
+                fut.add_done_callback(lambda f: done(f.exception()))
+
+            self.journal = open_journal(
+                self.journal_dir,
+                defer=lambda delay_s, fn: self.loop.call_later(delay_s, fn),
+                window_micros=self.journal_window_us,
+                snapshot_every=self.journal_snapshot_every,
+                segment_bytes=self.journal_segment_bytes,
+                metrics=obs.metrics,
+                async_exec=_async_exec,
+                sync_policy=self.journal_sync)
         self.proc = MaelstromProcess(
             emit=self._emit, scheduler=scheduler,
             now_micros=self.now_micros,
             num_stores=self.stores, shards=self.shards,
             device_mode=self.device_mode,
-            durability=self.durability, obs=obs)
+            durability=self.durability, obs=obs,
+            journal=self.journal)
         if self.request_timeout_ms is not None:
             self.proc.request_timeout_micros = self.request_timeout_ms * 1000
         # admission gate in front of coordinate, composed with the r07
-        # device ladder (quarantine lowers the budget)
+        # device ladder (quarantine lowers the budget); when the r09
+        # span trees are live their per-phase p99 drives the AIMD signal
+        # (root-span fallback keeps ACCORD_TPU_OBS=off working)
+        from .admission import SpanPhaseP99
+        phase_feed = (SpanPhaseP99(obs.metrics).read
+                      if obs.spans is not None else None)
         self.gate = AdmissionGate(
             max_inflight=self.admit_max,
             target_p99_micros=self.target_p99_ms * 1000,
             min_budget=self.min_budget,
             device_health=lambda: device_health_of(self.proc.node),
-            metrics=obs.metrics)
+            metrics=obs.metrics,
+            phase_p99=phase_feed)
         self.proc.admission = self.gate
         # outbound links (deterministic per-(me, peer) jitter streams)
         import zlib
@@ -283,8 +325,21 @@ class NodeServer:
                           "body": {"type": "init", "msg_id": 0,
                                    "node_id": self.name,
                                    "node_ids": names}})
+        if self.journal is not None:
+            # periodic snapshot check: bounds replay length and recycles
+            # fully-snapshotted segments (the floor advance is the knob,
+            # the 2s cadence is just how often we look)
+            def snap_tick():
+                try:
+                    self.journal.maybe_snapshot(
+                        data_store=self.proc.node.data_store)
+                except Exception as exc:   # snapshotting must never kill
+                    print(f"[{self.name}] snapshot tick failed: {exc!r}",
+                          file=sys.stderr)
+            scheduler.recurring(2_000_000, snap_tick)
         print(f"[{self.name}] serving on {self.host}:{self.port} "
-              f"peers={sorted(self.peers)} pid={os.getpid()}",
+              f"peers={sorted(self.peers)} pid={os.getpid()} "
+              f"journal={'on' if self.journal is not None else 'off'}",
               file=sys.stderr, flush=True)
 
     async def close(self) -> None:
@@ -292,6 +347,11 @@ class NodeServer:
             await link.close()
         if self.frame_server is not None:
             await self.frame_server.close()
+        if self.journal is not None:
+            try:
+                self.journal.close()   # final flush (graceful exit only —
+            except OSError:            # kill -9 relies on recovery)
+                pass
 
 
 def parse_addr(s: str) -> Tuple[str, int]:
@@ -332,6 +392,26 @@ def main(argv=None) -> int:
     p.add_argument("--request-timeout-ms", type=int, default=None,
                    help="sink-owned inter-node request timeout "
                         "(default: the Maelstrom adapter's 20s)")
+    p.add_argument("--journal-dir", default=None,
+                   help="durable journal directory: segmented WAL + "
+                        "snapshots; a restart with the same dir recovers "
+                        "the pre-crash command state (default: none — "
+                        "kill -9 rejoins fresh-state)")
+    p.add_argument("--journal-window-us", type=int, default=None,
+                   help="group-commit batching window in micros "
+                        "(default: priced off a once-per-process fsync "
+                        "micro-probe)")
+    p.add_argument("--journal-snapshot-every", type=int, default=None,
+                   help="WAL records between snapshots (default 8192)")
+    p.add_argument("--journal-segment-bytes", type=int, default=None,
+                   help="WAL segment size (default 4MiB)")
+    p.add_argument("--journal-sync", choices=("all", "client", "periodic"),
+                   default=None,
+                   help="what gates on the batch fsync: every protocol "
+                        "reply (all), only the client txn_ok (client, "
+                        "default — acked => durable; protocol promises "
+                        "ride the page cache like Cassandra's periodic "
+                        "commitlog), or nothing (periodic)")
     args = p.parse_args(argv)
 
     host, port = parse_addr(args.listen)
@@ -342,7 +422,12 @@ def main(argv=None) -> int:
         durability=not args.no_durability,
         admit_max=args.admit_max, target_p99_ms=args.target_p99_ms,
         min_budget=args.min_budget,
-        request_timeout_ms=args.request_timeout_ms)
+        request_timeout_ms=args.request_timeout_ms,
+        journal_dir=args.journal_dir,
+        journal_window_us=args.journal_window_us,
+        journal_snapshot_every=args.journal_snapshot_every,
+        journal_segment_bytes=args.journal_segment_bytes,
+        journal_sync=args.journal_sync)
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)

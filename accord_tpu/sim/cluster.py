@@ -267,10 +267,15 @@ class Cluster:
                  mean_latency_micros: int = 1_000,
                  request_timeout_micros: int = 1_000_000,
                  device_mode: Optional[bool] = None,
-                 paged_limit: Optional[int] = None):
+                 paged_limit: Optional[int] = None,
+                 journal_factory: Optional[Callable[[int], object]] = None):
         node_ids = list(node_ids if node_ids is not None else topology.nodes())
         self._device_mode = device_mode
         self._paged_limit = paged_limit
+        # per-node journal constructor override (default: the in-memory
+        # Journal; tests pass accord_tpu.journal.DurableJournal to run the
+        # whole sim over the on-disk WAL stack)
+        self._journal_factory = journal_factory
         self.random = RandomSource(seed)
         # dedicated stream for request-timeout jitter: seeded from the run
         # seed WITHOUT consuming a draw from ``self.random`` (node/restart
@@ -345,7 +350,8 @@ class Cluster:
             self.sinks[nid] = sink
             data_store = (data_store_factory(nid) if data_store_factory
                           else _NullDataStore())
-            self.journals[nid] = Journal()
+            self.journals[nid] = (journal_factory(nid) if journal_factory
+                                  else Journal())
             node = Node(
                 node_id=nid, message_sink=sink,
                 config_service=SimConfigService(self, nid),
@@ -569,7 +575,9 @@ class Cluster:
         self.sinks[nid] = sink
         data_store = (self._data_store_factory(nid) if self._data_store_factory
                       else _NullDataStore())
-        self.journals.setdefault(nid, Journal())
+        if nid not in self.journals:
+            self.journals[nid] = (self._journal_factory(nid)
+                                  if self._journal_factory else Journal())
         node = Node(node_id=nid, message_sink=sink,
                     config_service=SimConfigService(self, nid),
                     scheduler=scheduler, data_store=data_store,

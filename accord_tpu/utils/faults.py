@@ -273,6 +273,122 @@ def socket_fault(kind: str, probability: float,
             _socket_armed[kind] = prior
 
 
+# ---------------------------------------------------------------------------
+# disk faults (r13): the storage-boundary analogue of the device and socket
+# faults — seedable, drawn ONLY from the injected RandomSource, consulted by
+# the durable journal (accord_tpu.journal) at every write/fsync/read
+# boundary.  Armed cross-process via ACCORD_TPU_DISK_FAULTS (same
+# kind:prob:seed format as the socket faults).
+# ---------------------------------------------------------------------------
+
+class DiskFaultError(OSError):
+    """Base of every injected storage-boundary failure (an OSError: the
+    journal must treat an injected fault exactly like the real thing)."""
+
+
+class TornWriteFault(DiskFaultError):
+    """A write persisted only a drawn prefix before the process died
+    (page-cache loss / power cut mid-sector).  The journal's CRC framing
+    must detect the torn tail on reopen and truncate, never mis-replay."""
+
+
+class ShortReadFault(DiskFaultError):
+    """A read returned fewer bytes than asked (transient I/O error).
+    Recovery must treat it as an unreadable tail, not crash or loop."""
+
+
+class FailedFsyncFault(DiskFaultError):
+    """fsync itself failed (the postgres lesson: the page cache may have
+    DROPPED the dirty pages — retrying is not safe).  The group commit
+    must degrade loudly: stop promising durability, keep serving."""
+
+
+DISK_FAULT_KINDS: Dict[str, type] = {
+    "torn_write": TornWriteFault,
+    "short_read": ShortReadFault,
+    "failed_fsync": FailedFsyncFault,
+}
+
+DISK_FAULTS_ENV = "ACCORD_TPU_DISK_FAULTS"
+
+# kind -> (probability, RandomSource); empty means no draws anywhere
+_disk_armed: Dict[str, Tuple[float, RandomSource]] = {}
+
+
+def inject_disk_fault(kind: str, probability: float,
+                      random: RandomSource) -> None:
+    """Arm one disk fault class (draws come from ``random`` ONLY)."""
+    if kind not in DISK_FAULT_KINDS:
+        raise ValueError(f"unknown disk fault kind {kind!r}; "
+                         f"one of {sorted(DISK_FAULT_KINDS)}")
+    _disk_armed[kind] = (probability, random)
+
+
+def clear_disk_faults(kind: Optional[str] = None) -> None:
+    if kind is None:
+        _disk_armed.clear()
+    else:
+        _disk_armed.pop(kind, None)
+
+
+def active_disk_faults() -> Dict[str, float]:
+    return {k: p for k, (p, _r) in _disk_armed.items()}
+
+
+def disk_fault_fires(kind: str) -> bool:
+    """One deterministic draw against ``kind``'s armed probability (no
+    draw — and False — when unarmed)."""
+    armed = _disk_armed.get(kind)
+    if armed is None:
+        return False
+    probability, random = armed
+    return random.decide(probability)
+
+
+def disk_fault_fraction(kind: str) -> float:
+    """Drawn cut point for a fired torn_write/short_read: the fraction of
+    the buffer that actually persisted / was returned.  Same armed source
+    as the fire decision, so a seeded run replays the exact fault
+    timeline."""
+    armed = _disk_armed.get(kind)
+    if armed is None:
+        return 0.0
+    _p, random = armed
+    return random.next_int(1000) / 1000.0
+
+
+def arm_disk_faults_from_env(spec: Optional[str] = None) -> Dict[str, float]:
+    """Parse ``kind:probability:seed[,...]`` (the ACCORD_TPU_DISK_FAULTS
+    format) and arm each class.  Returns {kind: probability}."""
+    import os
+    if spec is None:
+        spec = os.environ.get(DISK_FAULTS_ENV, "")
+    armed = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, prob, seed = part.split(":")
+        inject_disk_fault(kind, float(prob), RandomSource(int(seed)))
+        armed[kind] = float(prob)
+    return armed
+
+
+@contextlib.contextmanager
+def disk_fault(kind: str, probability: float,
+               random: RandomSource) -> Iterator[None]:
+    """Arm ``kind`` for the block, restoring the prior arming on exit."""
+    prior = _disk_armed.get(kind)
+    inject_disk_fault(kind, probability, random)
+    try:
+        yield
+    finally:
+        if prior is None:
+            _disk_armed.pop(kind, None)
+        else:
+            _disk_armed[kind] = prior
+
+
 @contextlib.contextmanager
 def enabled(name: str) -> Iterator[None]:
     """Flip a module-level boolean fault flag for the block::

@@ -1018,10 +1018,12 @@ def main(em: Emitter):
     except Exception as e:
         em.note(f"# CONFIG 4 failed: {e!r}")
 
-    # -- CONFIG 6 (r12): the real serving surface — N OS processes on
-    #    loopback TCP, open-loop Poisson sweep at 0.5x/1x/3x saturation.
-    #    Wall-clock rows (platform column set); the graceful-overload
-    #    verdict is asserted by the child (rc!=0 on a collapse) --
+    # -- CONFIG 6 (r12) + CONFIG 7 (r13): the real serving surface — N OS
+    #    processes on loopback TCP, open-loop Poisson sweep at
+    #    0.5x/1x/3x saturation, then the durability leg (journal-on 1x +
+    #    kill -9 recovery replay).  Wall-clock rows (platform column
+    #    set); the graceful-overload AND durability verdicts are
+    #    asserted by the child (rc!=0 on a violation) --
     try:
         import os
         import subprocess
@@ -1032,15 +1034,15 @@ def main(em: Emitter):
             [sys.executable,
              os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "serve_bench.py"), "--bench"],
-            env=env, capture_output=True, text=True, timeout=420)
+            env=env, capture_output=True, text=True, timeout=600)
         for line in serve.stdout.splitlines():
             if line.strip().startswith("{"):
                 em.config(json.loads(line.strip()))
         if serve.returncode != 0:
-            em.note(f"# CONFIG 6 (serving) FAILED rc={serve.returncode}: "
+            em.note(f"# CONFIG 6/7 (serving) FAILED rc={serve.returncode}: "
                     f"{serve.stderr[-600:]}")
     except Exception as e:
-        em.note(f"# CONFIG 6 (serving) failed: {e!r}")
+        em.note(f"# CONFIG 6/7 (serving) failed: {e!r}")
 
 
 if __name__ == "__main__":

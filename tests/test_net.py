@@ -580,6 +580,164 @@ def test_malformed_txns_do_not_leak_admission_slots():
         cluster.shutdown()
 
 
+def test_kill9_restart_with_journal_recovers_state():
+    """The r13 durability contract end to end: kill -9 a node mid-load,
+    restart it with the same --journal-dir — it recovers its pre-crash
+    command state (WAL replay), answers a duplicate of an
+    already-answered request from the journaled at-most-once table
+    (same reply, no re-coordination, the append lands exactly once),
+    and zero duplicate client replies are ever observed."""
+    import random
+    import tempfile
+
+    from accord_tpu.net.client import ClusterClient
+    from accord_tpu.net.harness import ServeCluster, _mk_ops, wait_ready
+
+    cluster = ServeCluster(n_nodes=3, request_timeout_ms=800,
+                           journal_root=tempfile.mkdtemp(prefix="accord_jr_"))
+    cluster.spawn_all()
+    try:
+        async def scenario():
+            client = ClusterClient(cluster.addrs, timeout=8.0)
+            try:
+                await wait_ready(cluster, client)
+                rng = random.Random(5)
+                counter = [0]
+
+                async def burst(n, nodes):
+                    for i in range(n):
+                        await client.submit_retry(
+                            _mk_ops(rng, counter, 16), retries=12,
+                            timeout=6.0, node=nodes[i % len(nodes)])
+
+                # phase 1: journaled load through every node
+                await burst(10, cluster.names)
+                # one append with a pinned msg_id so the SAME request can
+                # be replayed across the death
+                ops = [["append", 7, 424242], ["r", 7, None]]
+                mid = client.next_msg_id()
+                conn = client.conns["n2"]
+                first = await conn.request({"type": "txn", "txn": ops},
+                                           mid, timeout=6.0)
+                assert first["type"] == "txn_ok", first
+                # duplicate BEFORE the crash: the dedupe table answers
+                dup = await conn.request({"type": "txn", "txn": ops},
+                                         mid, timeout=6.0)
+                assert dup["txn"] == first["txn"]
+                s = await client.stats("n2")
+                assert s["journal"]["registers"] > 0, s["journal"]
+                assert s["journal"]["replied"] > 0
+                # phase 2: kill -9 mid-run; survivors keep committing
+                cluster.kill9("n2")
+                await burst(6, ["n1", "n3"])
+                # phase 3: restart with the SAME journal dir
+                cluster.spawn("n2")
+                await wait_ready(cluster, client)
+                s = await client.stats("n2")
+                jr = s["journal"]["replay"]
+                assert jr["replayed"] > 0 or jr["snapshot_loaded"], jr
+                assert s["journal"]["registers"] > 0, \
+                    "pre-crash command state was not reconstructed"
+                assert s["journal"]["replied"] > 0, \
+                    "the at-most-once reply table did not survive"
+                # duplicate AFTER the restart: the recovered table still
+                # answers with the SAME reply — no re-coordination
+                dup2 = await client.conns["n2"].request(
+                    {"type": "txn", "txn": ops}, mid, timeout=6.0)
+                assert dup2["txn"] == first["txn"]
+                # ...and the append landed exactly once across
+                # kill + restart + three deliveries of the same request
+                # (retry: the freshly-rejoined node may still be
+                # re-establishing its peer links)
+                read = await client.submit_retry([["r", 7, None]],
+                                                 node="n2", retries=12,
+                                                 timeout=6.0)
+                vals = read["txn"][0][2]
+                assert vals.count(424242) == 1, vals
+                # the restarted node serves fresh traffic
+                await burst(6, cluster.names)
+                assert client.duplicate_replies() == 0
+                return True
+            finally:
+                await client.close()
+
+        assert asyncio.run(scenario())
+        assert all(cluster.alive().values())
+    finally:
+        cluster.shutdown()
+
+
+def test_sink_tombstoned_heap_compacts_and_peer_death_times_out():
+    """r13 sink fix: requests resolved long before their deadline must
+    not leave tombstones occupying the heap for the remaining horizon
+    (slow-read entries linger 10x the base timeout), and pending
+    callbacks to a peer that dies mid-request must still resolve as
+    Timeouts — compaction may never lose a live entry."""
+    from accord_tpu.coordinate.errors import Timeout
+    from accord_tpu.maelstrom.node import MaelstromSink
+    from accord_tpu.primitives.timestamp import Timestamp
+
+    class Proc:
+        request_timeout_micros = 1_000_000
+
+        def __init__(self):
+            self.t = 0
+            self.sent = []
+
+        def now_micros(self):
+            return self.t
+
+        def emit_packet(self, to, body):
+            self.sent.append((to, body))
+
+    class CB:
+        def __init__(self):
+            self.ok = []
+            self.fail = []
+
+        def on_success(self, frm, reply):
+            self.ok.append(frm)
+
+        def on_failure(self, frm, exc):
+            self.fail.append(exc)
+
+    class Reply:
+        def is_final(self):
+            return True
+
+    proc = Proc()
+    sink = MaelstromSink(proc)
+    req = Timestamp.from_values(1, 1, 1)   # any wire-encodable request
+    # a burst of requests all resolved immediately: pre-fix, 500 dead
+    # [deadline, tie, None] entries sit heaped for the full 1s horizon
+    for i in range(500):
+        sink.send_with_callback(2, req, CB())
+        sink.on_response(2, i + 1, Reply())
+    assert len(sink.pending) == 0
+    assert len(sink._timeouts) <= 64, \
+        f"{len(sink._timeouts)} tombstones leaked past the compaction bound"
+    # now requests to a peer that dies (never replies): compaction must
+    # have kept the machinery intact — they resolve as timeouts at the
+    # horizon, not never
+    cbs = [CB() for _ in range(5)]
+    for cb in cbs:
+        sink.send_with_callback(3, req, cb)
+    proc.t = 2_000_000
+    sink.sweep()
+    for cb in cbs:
+        assert len(cb.fail) == 1 and isinstance(cb.fail[0], Timeout)
+    assert len(sink.pending) == 0
+    # interleaved resolve/expire: tombstone accounting stays exact
+    for i in range(200):
+        sink.send_with_callback(2, req, CB())
+        if i % 2 == 0:
+            sink.on_response(2, sink._next_msg_id, Reply())
+    proc.t = 4_000_000
+    sink.sweep()
+    assert len(sink.pending) == 0
+    assert len(sink._timeouts) <= 64
+
+
 @pytest.mark.slow
 def test_overload_sheds_instead_of_collapsing():
     """The graceful-overload assertion (slow tier): at ~3x saturation the

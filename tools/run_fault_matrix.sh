@@ -16,10 +16,28 @@
 # leg the harness dumps every node's flight post-mortem + serving stats
 # to $FAULT_MATRIX_OUT before failing.  ACCORD_TPU_FAULT_MATRIX=device or
 # =net runs one half only.
+# r13 adds the storage-boundary leg: every injectable DISK fault class
+# (torn_write / short_read / failed_fsync) x seed through the durable
+# journal's full WAL + group-commit + recovery stack, double-run for
+# determinism, plus a seeded crash-point truncation sweep asserting
+# recovery == replay of the surviving prefix.  ACCORD_TPU_FAULT_MATRIX=disk
+# runs it alone.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 HALF="${ACCORD_TPU_FAULT_MATRIX:-all}"
+
+run_disk_leg() {
+    echo ""
+    echo "== storage-boundary disk-fault legs (durable journal self-test) =="
+    env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+        python -m accord_tpu.journal.selftest --seeds 0 5 11
+}
+
+if [ "$HALF" = "disk" ]; then
+    run_disk_leg
+    exit $?
+fi
 
 run_net_leg() {
     echo ""
@@ -123,18 +141,20 @@ print("\nfault matrix clean: every class x seed deterministic and "
 PY
 
 net_rc=0
+disk_rc=0
 if [ "$HALF" != "device" ]; then
     run_net_leg || net_rc=$?
+    run_disk_leg || disk_rc=$?
 fi
 
-if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ]; then
+if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ] || [ "$disk_rc" -ne 0 ]; then
     echo ""
-    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc)"
+    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc, disk rc=$disk_rc)"
     exit 1
 fi
 echo ""
 if [ "$HALF" = "device" ]; then
-    echo "device fault matrix clean (network half skipped: ACCORD_TPU_FAULT_MATRIX=device)"
+    echo "device fault matrix clean (network/disk halves skipped: ACCORD_TPU_FAULT_MATRIX=device)"
 else
-    echo "full fault matrix clean (device + network boundary)"
+    echo "full fault matrix clean (device + network + storage boundary)"
 fi
