@@ -164,6 +164,7 @@ class Recover(api.Callback):
         self.done = False
 
     def _start(self) -> None:
+        _count_recovery(self.node, "attempt")
         sp = spans_of(self.node)
         if sp is not None:
             # one recovery HOP on the txn's span tree (recovery may run on
@@ -183,8 +184,10 @@ class Recover(api.Callback):
         if isinstance(reply, RecoverNack):
             self.done = True
             if reply.superseded_by is None:
+                _count_recovery(self.node, "truncated")
                 self.result.set_failure(Truncated(self.txn_id))
             else:
+                _count_recovery(self.node, "preempted")
                 self.result.set_failure(Preempted(self.txn_id))
             return
         ok: RecoverOk = reply
@@ -199,6 +202,7 @@ class Recover(api.Callback):
             return
         if self.tracker.record_failure(from_id) is RequestStatus.Failed:
             self.done = True
+            _count_recovery(self.node, "timeout")
             self.result.set_failure(Timeout(self.txn_id))
 
     # -- decision (ref: Recover.java:239-345) -------------------------------
@@ -215,6 +219,7 @@ class Recover(api.Callback):
             if status is Status.Invalidated:
                 _commit_invalidate_broadcast(node, txn_id, self.route,
                                              self.tracker.nodes())
+                _count_recovery(node, "invalidated")
                 self.result.set_success(("invalidated", None))
                 return
             if status in (Status.Applied, Status.PreApplied):
@@ -292,15 +297,31 @@ class Recover(api.Callback):
         if failure is not None:
             self.result.set_failure(failure)
         else:
+            _count_recovery(self.node, "executed")
             self.result.set_success(("executed", value))
 
     def _invalidate(self) -> None:
         _propose_invalidate(
             self.node, self.txn_id, self.route, self.ballot, self.topologies,
-            on_invalidated=lambda: self.result.set_success(("invalidated", None)),
+            on_invalidated=lambda: (
+                _count_recovery(self.node, "invalidated"),
+                self.result.set_success(("invalidated", None))),
             on_redundant=lambda: Recover(self.node, self.txn_id, self.txn,
                                          self.route, self.result)._start(),
             on_failed=self.result.set_failure)
+
+
+def _count_recovery(node, event: str) -> None:
+    """Recovery lifecycle counters (r14): attempts and terminal outcomes,
+    labeled per node, on the shared obs registry — the burn's
+    recovery-under-chaos nemesis and the bench ``recovery_rate`` row read
+    them back via ``counter_totals("recoveries", by="event")``.  Pure
+    counting: no randomness, no protocol effect (one getattr when a node
+    carries no registry)."""
+    o = getattr(node, "obs", None)
+    if o is not None:
+        o.metrics.counter("recoveries", node=node.node_id,
+                          event=event).inc()
 
 
 def _next_ballot_bits(node):
@@ -380,6 +401,7 @@ def _repersist(node, txn_id, txn, route, max_ok: RecoverOk, deps: Deps,
     from .persist import persist
     persist(node, txn_id, txn, route, max_ok.execute_at, deps,
             max_ok.writes, max_ok.result)
+    _count_recovery(node, "applied")
     result.set_success(("applied", max_ok.result))
 
 
@@ -440,7 +462,9 @@ def _fetch_definition_then_recover(node, txn_id: TxnId, route: Route,
                                                txn_id.epoch())
         _propose_invalidate(
             node, txn_id, route, ballot, topologies,
-            on_invalidated=lambda: result.set_success(("invalidated", None)),
+            on_invalidated=lambda: (
+                _count_recovery(node, "invalidated"),
+                result.set_success(("invalidated", None))),
             on_redundant=lambda: _fetch_definition_then_recover(
                 node, txn_id, route, result),
             on_failed=result.set_failure)

@@ -155,7 +155,21 @@ class CommandsForKey:
             # skew the elision pivot and recovery scans) — guard here rather
             # than relying on every caller's ordering guards
             if execute_at is not None and status.has_execute_at() \
-                    and (status >= prev or prev < InternalStatus.COMMITTED):
+                    and (status >= prev or prev < InternalStatus.COMMITTED) \
+                    and execute_at != info.execute_at:
+                if InternalStatus.COMMITTED <= prev <= InternalStatus.APPLIED \
+                        and txn_id.kind().is_write():
+                    # r14 torture-rig find: a decided-grade update moving an
+                    # already-indexed write's executeAt left the OLD value in
+                    # _committed_write_execs and never inserted the new one —
+                    # elision then pivots on a ghost timestamp.  Keep the
+                    # pivot list in lockstep with the executeAt it indexes.
+                    i = bisect.bisect_left(self._committed_write_execs,
+                                           info.execute_at)
+                    if i < len(self._committed_write_execs) \
+                            and self._committed_write_execs[i] == info.execute_at:
+                        del self._committed_write_execs[i]
+                    bisect.insort(self._committed_write_execs, execute_at)
                 info.execute_at = execute_at
             if info.status is InternalStatus.INVALIDATED \
                     and InternalStatus.COMMITTED <= prev <= InternalStatus.APPLIED \
@@ -254,6 +268,20 @@ class CommandsForKey:
             if info.status in (InternalStatus.TRANSITIVELY_KNOWN,
                                InternalStatus.INVALIDATED):
                 self._n_unwitnessable -= 1
+            if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED \
+                    and txn_id.kind().is_write():
+                # r14 torture-rig find: the pivot followed the entry out of
+                # the index only when a LATER prune happened to drop
+                # something (the cut==0 early return skipped the rebuild) —
+                # until then elision pivoted on a write no scan can return.
+                # Retract it with the entry: conservative (more deps
+                # scanned), and the pivot list's invariant becomes simply
+                # "the decided writes present in the index".
+                i = bisect.bisect_left(self._committed_write_execs,
+                                       info.execute_at)
+                if i < len(self._committed_write_execs) \
+                        and self._committed_write_execs[i] == info.execute_at:
+                    del self._committed_write_execs[i]
             del self._infos[txn_id]
             i = bisect.bisect_left(self._ids, txn_id)
             if i < len(self._ids) and self._ids[i] == txn_id:

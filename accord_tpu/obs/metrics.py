@@ -132,6 +132,19 @@ class MetricsRegistry:
         return m.value if m is not None else 0
 
     # -- export -------------------------------------------------------------
+    def counter_totals(self, name: str, by: str) -> Dict[str, int]:
+        """Sum every counter named ``name`` grouped by one label's value
+        (sorted iteration: deterministic).  The burn/bench recovery-rate
+        aggregation: counter_totals("recoveries", by="event")."""
+        out: Dict[str, int] = {}
+        for (n, labels) in sorted(self._m):
+            m = self._m[(n, labels)]
+            if n != name or not isinstance(m, Counter):
+                continue
+            key = str(dict(labels).get(by, ""))
+            out[key] = out.get(key, 0) + m.value
+        return out
+
     def snapshot(self) -> dict:
         """Flat {rendered_key: value} in SORTED key order (deterministic
         regardless of registration order).  Histograms render as nested

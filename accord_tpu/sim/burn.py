@@ -66,6 +66,13 @@ class BurnResult:
         # across same-seed runs (None under ACCORD_TPU_OBS=off)
         self.flight_export: Optional[str] = None
         self.flight_postmortems = 0
+        # r14 recovery-under-chaos: recovery lifecycle totals (attempt /
+        # executed / applied / invalidated / preempted / timeout /
+        # truncated, from coordinate.recover's counters) and, when the
+        # nemesis is armed, its per-leg fire counts — both also mirrored
+        # into ``stats`` so the same-seed determinism gates compare them
+        self.recoveries: Dict[str, int] = {}
+        self.nemesis: Dict[str, int] = {}
 
     def __repr__(self):
         return (f"BurnResult(ok={self.ops_ok}, failed={self.ops_failed}, "
@@ -81,7 +88,8 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
              probe=None, probe_micros: int = 0,
              boundary_churn_only: bool = False,
              device_faults: Optional[str] = None,
-             device_fault_p: float = 0.05) -> BurnResult:
+             device_fault_p: float = 0.05,
+             recovery_nemesis: bool = False) -> BurnResult:
     if device_faults is not None:
         # DEVICE-FAULT NEMESIS: arm the accelerator-boundary fault
         # registry (utils.faults) for the whole run — one fault class, or
@@ -106,7 +114,8 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
                             churn=churn, restarts=restarts,
                             drain_micros=drain_micros, probe=probe,
                             probe_micros=probe_micros,
-                            boundary_churn_only=boundary_churn_only)
+                            boundary_churn_only=boundary_churn_only,
+                            recovery_nemesis=recovery_nemesis)
         finally:
             faults.PARANOIA = prior_paranoia
             for k in kinds:
@@ -366,18 +375,22 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     # DelayedCommandStores random isLoadedCheck evictions)
     rst = rs.fork()
 
-    def maybe_restart():
-        if cluster.queue.now > workload_micros:
-            return
-        nid = sorted(cluster.nodes)[rst.next_int(len(cluster.nodes))]
+    def crash_node(nid: int) -> None:
         # the crash kills the node's client sessions: their ops become
-        # indeterminate for the client (not fed to the verifier)
+        # indeterminate for the client (not fed to the verifier) — shared
+        # by the ambient restarts and the recovery nemesis's kill leg so
+        # crash accounting can never diverge between them
         for op in outstanding:
             if not op["done"] and op["node"] == nid:
                 op["done"] = True
                 result.ops_failed += 1
         cluster.restart_node(nid)
         result.restarts += 1
+
+    def maybe_restart():
+        if cluster.queue.now > workload_micros:
+            return
+        crash_node(sorted(cluster.nodes)[rst.next_int(len(cluster.nodes))])
         cluster.queue.add(cluster.queue.now + 6_000_000 +
                           rst.next_int(6_000_000), maybe_restart)
 
@@ -399,6 +412,58 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     if restarts:
         cluster.queue.add(4_000_000 + rst.next_int(4_000_000), maybe_restart)
         cluster.queue.add(1_000_000 + rst.next_int(1_000_000), evict_tick)
+
+    # RECOVERY-UNDER-CHAOS NEMESIS (r14, ISSUE 10): aim chaos AT live
+    # recoveries instead of around them.  The cluster records the most
+    # recent BeginRecovery it routed (coordinator, txn, route); each tick
+    # fires one leg at it:
+    #   kill      — crash-restart the recovery coordinator mid-recovery
+    #               (its promise ballot dies with it; peers must re-recover)
+    #   partition — cut the coordinator off from part of its recovery
+    #               quorum for a window, then heal
+    #   race      — start a SECOND concurrent recoverer for the same txn
+    #               from another node (the ballot race: exactly one wins,
+    #               the loser must observe Preempted, never a double apply)
+    # The stream is a dedicated fork appended after every existing fork,
+    # so arming the nemesis perturbs no other stream and a nemesis-off run
+    # is byte-identical to r13.  Composes with --device-faults.
+    nem = rs.fork()
+
+    def nemesis_tick():
+        if cluster.queue.now > workload_micros:
+            return
+        seen = cluster.last_recovery
+        if seen is not None:
+            cluster.last_recovery = None   # each observation drives one leg
+            src, txn_id, route = seen
+            leg = nem.next_int(3)
+            if leg == 0 and src in cluster.nodes:
+                crash_node(src)
+                result.nemesis["kill"] = result.nemesis.get("kill", 0) + 1
+            elif leg == 1:
+                others = [n for n in sorted(cluster.nodes) if n != src]
+                if others:
+                    other = others[nem.next_int(len(others))]
+                    cluster.partition(src, other)
+                    pair = frozenset((src, other))
+                    cluster.queue.add(
+                        cluster.queue.now + 1_500_000,
+                        lambda p=pair: cluster.partitioned.discard(p))
+                    result.nemesis["partition"] = \
+                        result.nemesis.get("partition", 0) + 1
+            else:
+                others = [n for n in sorted(cluster.nodes) if n != src]
+                if others:
+                    other = others[nem.next_int(len(others))]
+                    cluster.nodes[other].recover(txn_id, route).begin(
+                        lambda r, f: None)   # Preempted losses are the point
+                    result.nemesis["race"] = \
+                        result.nemesis.get("race", 0) + 1
+        cluster.queue.add(cluster.queue.now + 1_200_000
+                          + nem.next_int(800_000), nemesis_tick)
+
+    if recovery_nemesis:
+        cluster.queue.add(3_000_000 + nem.next_int(1_000_000), nemesis_tick)
 
     # run the workload window + drain until every op resolves
     cluster.run_for(workload_micros)
@@ -500,6 +565,15 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     if flight is not None:
         result.flight_export = flight.export_json()
         result.flight_postmortems = len(flight)
+    # recovery lifecycle totals + nemesis leg counts ride the stats dict so
+    # the same-seed double-run compares them byte-for-byte like everything
+    # else (all sourced from sim-deterministic counters)
+    result.recoveries = cluster.obs.metrics.counter_totals("recoveries",
+                                                           by="event")
+    for ev, n in sorted(result.recoveries.items()):
+        result.stats[f"Recovery.{ev}"] = n
+    for leg, n in sorted(result.nemesis.items()):
+        result.stats[f"RecoveryNemesis.{leg}"] = n
     return result
 
 
@@ -519,6 +593,10 @@ def main(argv=None):
                         "stale_result | all")
     p.add_argument("--device-fault-p", type=float, default=0.05,
                    help="per-boundary-crossing fault probability")
+    p.add_argument("--recovery-nemesis", action="store_true",
+                   help="aim chaos at live recoveries: coordinator kill "
+                        "mid-recovery, partition/heal around the recovery "
+                        "quorum, concurrent-recoverer ballot races")
     args = p.parse_args(argv)
 
     if args.loop_seed is not None:
@@ -528,7 +606,8 @@ def main(argv=None):
                          churn=not args.no_churn,
                          restarts=not args.no_restarts,
                          device_faults=args.device_faults,
-                         device_fault_p=args.device_fault_p)
+                         device_fault_p=args.device_fault_p,
+                         recovery_nemesis=args.recovery_nemesis)
             print(f"seed {seed}: {r}")
             seed += 1
     start = args.seed if args.seed is not None else 0
@@ -536,7 +615,8 @@ def main(argv=None):
         r = run_burn(seed, n_ops=args.ops, chaos=not args.no_chaos,
                      churn=not args.no_churn, restarts=not args.no_restarts,
                      device_faults=args.device_faults,
-                     device_fault_p=args.device_fault_p)
+                     device_fault_p=args.device_fault_p,
+                     recovery_nemesis=args.recovery_nemesis)
         print(f"seed {seed}: {r}")
 
 

@@ -306,6 +306,12 @@ class Cluster:
         self._link_last: Dict[tuple, int] = {}
         # test hook (ref: test NetworkFilter): return True to drop a request
         self.message_filter: Optional[Callable[[int, int, object], bool]] = None
+        # recovery-nemesis hook (r14): the most recent BeginRecovery
+        # observed on the wire — (coordinator id, txn_id, route).  Purely
+        # observational (set from the deterministic routing path), consumed
+        # by the burn's recovery-under-chaos nemesis to aim its legs
+        # (coordinator kill / partition / ballot race) at a LIVE recovery.
+        self.last_recovery: Optional[Tuple[int, object, object]] = None
         # unified observability (obs.Observability): the metrics registry
         # is ALWAYS live — it is the store behind ``stats`` — while span
         # recording obeys the ACCORD_TPU_OBS knob.  ``stats`` keeps its
@@ -502,7 +508,10 @@ class Cluster:
         return at
 
     def route_request(self, src: int, dst: int, request, callback_id: int) -> None:
-        self.stats[type(request).__name__] = self.stats.get(type(request).__name__, 0) + 1
+        verb = type(request).__name__
+        self.stats[verb] = self.stats.get(verb, 0) + 1
+        if verb == "BeginRecovery":
+            self.last_recovery = (src, request.txn_id, request.route)
         action = self._action(src, dst)
         filtered = (action in (Action.DROP, Action.FAILURE)
                     or (self.message_filter is not None

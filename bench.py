@@ -917,6 +917,27 @@ def main(em: Emitter):
     # BENCH artifact, now shared with the burn/sim exporters
     from accord_tpu.obs.metrics import index_counters
     idx = " ".join(f"{k}={v}" for k, v in index_counters(dev).items())
+    # r14: recovery behavior joins the watched counters — one short
+    # recovery-nemesis chaos burn (SIM time, fixed seed: the counts are a
+    # pure function of the build, so a protocol change that shifts recovery
+    # behavior flags in bench_compare/bench_trend from now on).  Lifecycle
+    # counts ride the # index: line (ints only — the parsers int() every
+    # token, so the rate is quoted per-mille) and a CONFIG 8 row below.
+    recovery_burn = None
+    try:
+        from accord_tpu.sim.burn import run_burn as _run_burn
+        recovery_burn = _run_burn(5, n_ops=80, recovery_nemesis=True)
+        _ra = recovery_burn.recoveries.get("attempt", 0)
+        _rs = recovery_burn.recoveries.get("executed", 0) + \
+            recovery_burn.recoveries.get("applied", 0)
+        _ri = recovery_burn.recoveries.get("invalidated", 0)
+        idx += (f" recovery_attempted={_ra} recovery_succeeded={_rs}"
+                f" recovery_invalidated={_ri}"
+                f" recovery_rate_permille="
+                f"{round(1000 * _rs / _ra) if _ra else 0}")
+    except Exception as e:
+        recovery_burn = None
+        em.note(f"# recovery-nemesis burn failed: {e!r}")
     em.note(
         f"# device={jax.devices()[0].platform} N={N} B={B} "
         f"queries_per_rep={B * BATCHES} reps={REPS}\n"
@@ -981,6 +1002,27 @@ def main(em: Emitter):
             em.config(row)
     except Exception as e:   # secondary metric must not sink the headline
         em.note(f"# CONFIG 0/1 failed: {e!r}")
+    # -- CONFIG 8 (r14): recovery under the recovery-aimed chaos nemesis —
+    #    sim-time and seed-pinned (byte-deterministic per build), so
+    #    bench_trend gates the recovered/attempt ratio across rounds and a
+    #    protocol change that degrades recovery convergence flags loudly --
+    if recovery_burn is not None:
+        # _ra/_rs computed once with the # index: line above — the gated
+        # CONFIG 8 ratio and the index counters must never disagree
+        em.config({
+            "config": 8,
+            "metric": "recovery_rate_under_chaos_nemesis_80ops_seed5",
+            "value": round(_rs / _ra, 4) if _ra else None,
+            "unit": "recovered/attempt",
+            "recovery_attempted": _ra,
+            "recovery_succeeded": _rs,
+            "recovery_invalidated":
+                recovery_burn.recoveries.get("invalidated", 0),
+            "nemesis_legs": {k: recovery_burn.nemesis[k]
+                             for k in sorted(recovery_burn.nemesis)},
+            "ok": recovery_burn.ops_ok, "failed": recovery_burn.ops_failed,
+            "unresolved": recovery_burn.ops_unresolved,
+        })
     try:
         for row in best_of(bench_hot_keys):
             em.config(row)

@@ -5,11 +5,26 @@ Property.java and AccordGens.java — the home-grown generator/property
 framework the reference's unit tiers run on.  Deterministic: every example
 derives from (base_seed + index), and a failure message carries the exact
 seed so the case replays as a one-liner.
+
+r14 grows this into the shared torture-rig infrastructure (the reference's
+Property.qt().withSeed() + shrinking loop, built ONCE instead of per-file):
+
+- ``case_budget(default)``: the ``ACCORD_TPU_PROPTEST_CASES`` env knob —
+  tier-1 runs a small deterministic subset, the ``-m slow`` sweeps (and CI
+  soak runs) crank it up without touching code.
+- ``case_seeds(n, base)``: the seeded case stream; honors
+  ``ACCORD_TPU_PROPTEST_SEED`` to replay exactly one failing case.
+- ``run_property(...)``: generate -> check -> on failure SHRINK to a minimal
+  counterexample (greedy over caller-provided shrink candidates) and raise
+  with a pretty-printed counterexample plus a copy-pasteable ``--seed``
+  replay line.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Generic, List, Sequence, TypeVar
+import os
+from typing import Callable, Generic, Iterable, Iterator, List, Optional, \
+    Sequence, Tuple, TypeVar
 
 from accord_tpu.primitives.deps import Deps, DepsBuilder
 from accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges, Route
@@ -156,6 +171,119 @@ class AccordGens:
             home = ks[rng.next_int(len(ks))].token()
             return Route.full(home, ks.to_unseekables())
         return Gen(fn)
+
+
+# ---------------------------------------------------------------------------
+# Seeded case streams + shrinking property runner (the r14 torture-rig kit)
+# ---------------------------------------------------------------------------
+
+CASES_ENV = "ACCORD_TPU_PROPTEST_CASES"
+SEED_ENV = "ACCORD_TPU_PROPTEST_SEED"
+
+
+def case_budget(default: int) -> int:
+    """How many cases a sweep runs: the ``ACCORD_TPU_PROPTEST_CASES`` env
+    knob wins (big soak sweeps without code changes), else ``default`` —
+    callers pass a small deterministic count for tier-1 and the >=1k /
+    >=500 counts for their ``-m slow`` variants."""
+    v = os.environ.get(CASES_ENV, "").strip()
+    if v:
+        return max(1, int(v))
+    return default
+
+
+def case_seeds(n_cases: int, base_seed: int = 0) -> Iterator[Tuple[int, int]]:
+    """The deterministic case stream: yields (index, case_seed).  Every
+    case seed derives from (base_seed, index) alone, so a failure replays
+    from its printed seed.  ``ACCORD_TPU_PROPTEST_SEED`` pins the stream to
+    exactly one case — the replay one-liner a failure message prints."""
+    pinned = os.environ.get(SEED_ENV, "").strip()
+    if pinned:
+        yield 0, int(pinned)
+        return
+    for i in range(n_cases):
+        yield i, base_seed * 1_000_003 + i
+
+
+def _check_failure(check: Callable[[object], None],
+                   case: object) -> Optional[BaseException]:
+    """None if the property holds for ``case``; the raised failure
+    otherwise (assertion failures AND harness crashes both count — a case
+    that makes the system under test throw is a counterexample too)."""
+    try:
+        check(case)
+        return None
+    except BaseException as e:  # noqa: BLE001 — any failure is a witness
+        return e
+
+
+def shrink_case(case: object,
+                still_fails: Callable[[object], bool],
+                candidates: Callable[[object], Iterable[object]],
+                max_steps: int = 400) -> object:
+    """Greedy shrink loop (ref: Property.java shrink): ``candidates(case)``
+    yields strictly-smaller variants in preference order; the first variant
+    that still fails becomes the new case and the loop restarts.  Stops at
+    a fixpoint (no candidate fails) or the step budget — deterministic, no
+    randomness, so the minimal counterexample is stable per seed."""
+    steps = 0
+    improved = True
+    while improved and steps < max_steps:
+        improved = False
+        for cand in candidates(case):
+            steps += 1
+            if still_fails(cand):
+                case = cand
+                improved = True
+                break
+            if steps >= max_steps:
+                break
+    return case
+
+
+def pretty_case(case: object) -> str:
+    """Counterexample pretty-printer: a case that knows how to describe
+    itself (``describe()``) does; everything else gets indented repr."""
+    describe = getattr(case, "describe", None)
+    text = describe() if callable(describe) else repr(case)
+    return "\n".join("    " + line for line in str(text).splitlines())
+
+
+def run_property(n_cases: int, base_seed: int,
+                 make_case: Callable[[RandomSource], object],
+                 check: Callable[[object], None],
+                 shrink_candidates: Optional[
+                     Callable[[object], Iterable[object]]] = None,
+                 replay_hint: str = "",
+                 max_shrink_steps: int = 400) -> int:
+    """The seeded sweep runner: ``n_cases`` cases from the deterministic
+    stream, each built by ``make_case(RandomSource(case_seed))`` and fed to
+    ``check`` (which raises on a property violation).  On the first failure
+    the case is shrunk to a minimal counterexample and re-raised with the
+    pretty-printed case and a ``--seed`` replay line.  Returns the number
+    of cases that ran (for sweep-size assertions)."""
+    ran = 0
+    for i, case_seed in case_seeds(n_cases, base_seed):
+        case = make_case(RandomSource(case_seed))
+        failure = _check_failure(check, case)
+        ran += 1
+        if failure is None:
+            continue
+        shrunk = case
+        if shrink_candidates is not None:
+            shrunk = shrink_case(
+                case, lambda c: _check_failure(check, c) is not None,
+                shrink_candidates, max_steps=max_shrink_steps)
+        final = _check_failure(check, shrunk)
+        if final is None:     # shrinking raced a flaky check: keep original
+            shrunk, final = case, failure
+        raise AssertionError(
+            f"property failed (example #{i} of {n_cases})\n"
+            f"replay: {SEED_ENV}={case_seed} {CASES_ENV}=1 {replay_hint}\n"
+            f"--seed {case_seed}\n"
+            f"shrunk counterexample:\n{pretty_case(shrunk)}\n"
+            f"failure: {final}") from final
+    return ran
 
 
 def for_all(*gens: Gen, examples: int = 200, seed: int = 0):

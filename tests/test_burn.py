@@ -182,6 +182,70 @@ def test_burn_device_faults_equivalent_and_deterministic(kind):
             base.stats.get("device_fused_tick_launches", 0) > 0, base.stats
 
 
+@pytest.mark.parametrize("seed", [0, 5, 11])
+def test_burn_recovery_nemesis_converges(seed):
+    """r14 recovery-under-chaos nemesis: with chaos aimed AT live
+    recoveries — coordinator kill mid-recovery, partition/heal around the
+    recovery quorum, concurrent-recoverer ballot races — every burn must
+    still converge with zero unresolved ops and zero node-level failures,
+    and the nemesis must actually have bitten."""
+    result = run_burn(seed, n_ops=80, recovery_nemesis=True)
+    assert result.ops_unresolved == 0, (
+        f"seed {seed}: {result.ops_unresolved} ops never resolved "
+        f"(repro: python -m accord_tpu.sim.burn -s {seed} -o 80 "
+        f"--recovery-nemesis)")
+    # targeted coordinator kills legitimately fail more client sessions
+    # than ambient chaos, but the vast majority must still commit
+    assert result.ops_ok >= 2 * result.ops_failed, f"seed {seed}: {result}"
+    assert sum(result.nemesis.values()) >= 3, (
+        f"seed {seed}: nemesis barely fired: {result.nemesis}")
+    assert result.recoveries.get("attempt", 0) > 0, result.recoveries
+
+
+def test_burn_recovery_nemesis_deterministic():
+    """Same-seed nemesis runs must replay byte-for-byte — protocol stats,
+    recovery/nemesis counters, metrics snapshot, and the canonical span
+    AND flight exports (the acceptance bar: chaos aimed at recovery stays
+    inside the determinism matrix)."""
+    a = run_burn(5, n_ops=60, recovery_nemesis=True)
+    b = run_burn(5, n_ops=60, recovery_nemesis=True)
+    assert a.stats == b.stats
+    assert a.metrics_snapshot == b.metrics_snapshot
+    assert a.span_export == b.span_export
+    assert a.flight_export == b.flight_export
+    assert a.recoveries == b.recoveries and a.nemesis == b.nemesis
+    assert (a.ops_ok, a.ops_failed, a.epochs, a.restarts, a.evictions) == \
+        (b.ops_ok, b.ops_failed, b.epochs, b.restarts, b.evictions)
+    # every leg class must have fired at this seed (pinned so the sweep
+    # can't silently degenerate to one leg)
+    assert set(a.nemesis) == {"kill", "partition", "race"}, a.nemesis
+
+
+@pytest.mark.faults
+def test_burn_recovery_nemesis_composes_with_device_faults():
+    """The r07 device-fault nemesis and the r14 recovery nemesis compose:
+    with both armed, the burn converges, replays deterministically, and
+    the degradation ladder stays protocol-invisible — the composed run's
+    protocol stats equal the recovery-nemesis-only run's (ladder counters
+    and routing stripped, recovery lifecycle counters INCLUDED)."""
+    base = run_burn(5, n_ops=60, recovery_nemesis=True)
+    a = run_burn(5, n_ops=60, recovery_nemesis=True,
+                 device_faults="transfer")
+    b = run_burn(5, n_ops=60, recovery_nemesis=True,
+                 device_faults="transfer")
+    assert a.ops_unresolved == 0
+    assert a.stats == b.stats, "same-seed composed run must replay exactly"
+    ladder = ("DepsRoute.", "DeviceFault.", "DeviceDispatch.")
+    skip = {"device_fallback_queries", "device_dispatches",
+            "device_fused_launches", "device_fused_tick_launches"}
+    strip = lambda st: {k: v for k, v in st.items()          # noqa: E731
+                        if not k.startswith(ladder) and k not in skip}
+    assert strip(a.stats) == strip(base.stats)
+    assert a.recoveries == base.recoveries
+    assert a.nemesis == base.nemesis
+    assert any(k.startswith("DeviceFault.fault.") for k in a.stats), a.stats
+
+
 @pytest.mark.parametrize("seed", [21, 22])
 def test_post_chaos_quiescence_gate(seed):
     """After chaos/churn stop and the drain completes, a silent window must

@@ -210,6 +210,46 @@ def test_hot_key_dep_sets_stay_bounded():
     assert cfk.size() <= 110
 
 
+def test_recommit_moved_execute_at_keeps_pivot_list_exact():
+    """r14 torture-rig find #1 (tests/torture/test_cfk_properties.py,
+    shrunk from seed 29000139): a decided-grade update moving an
+    already-COMMITTED write's executeAt updated info.execute_at but left
+    the OLD value in _committed_write_execs and never inserted the new one
+    — transitive elision then pivoted on a ghost timestamp no scan could
+    reach.  The pivot list must follow the executeAt it indexes."""
+    cfk = CommandsForKey(7)
+    t = tid(230)
+    cfk.update(t, InternalStatus.COMMITTED, ts(251), witnessed_deps=[])
+    assert cfk._committed_write_execs == [ts(251)]
+    # a second decided-grade update legitimately carries a moved executeAt
+    cfk.update(t, InternalStatus.COMMITTED, ts(243), witnessed_deps=[])
+    assert cfk._infos[t].execute_at == ts(243)
+    assert cfk._committed_write_execs == [ts(243)], \
+        "pivot list diverged from the executeAt it indexes"
+    assert cfk.max_committed_write_before(ts(250)) == ts(243)
+    assert cfk.max_committed_write_before(ts(10_000)) == ts(243)
+
+
+def test_remove_retracts_elision_pivot():
+    """r14 torture-rig find #2 (shrunk from seed 30000274): remove() — the
+    truncation-time index release — left the removed write's executeAt in
+    the pivot list; it only cleared when a LATER prune happened to drop
+    something (the cut==0 early return skips the rebuild).  Until then,
+    elision pivoted on a write absent from every scan result."""
+    cfk = CommandsForKey(7)
+    w = tid(100)
+    cfk.update(w, InternalStatus.STABLE)          # decided on arrival
+    assert cfk._committed_write_execs == [w]
+    cfk.remove(w)
+    assert cfk._committed_write_execs == [], \
+        "stale pivot survived remove()"
+    # the exact shrunk interleaving: a no-op prune must find nothing stale
+    cfk.set_prune_before(tid(100))
+    assert cfk.prune() == 0
+    assert cfk._committed_write_execs == []
+    assert cfk.max_committed_write_before(ts(10_000)) is None
+
+
 def test_late_accepted_update_keeps_decided_execute_at():
     """A stale ACCEPTED-grade update carrying a *proposed* executeAt must not
     regress the decided executeAt of a COMMITTED+ entry (the elision pivot

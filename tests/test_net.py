@@ -738,6 +738,87 @@ def test_sink_tombstoned_heap_compacts_and_peer_death_times_out():
     assert len(sink._timeouts) <= 64
 
 
+def test_sink_recovery_callbacks_tombstone_and_time_out():
+    """r14 satellite: the r07/r13 tombstone contract extended to the
+    RECOVERY callbacks.  WaitOnCommit is a slow-read request (10x timeout
+    horizon): a recovery that resolves its waits early must not leave
+    tombstones heaped for the 10x horizon, and recovery requests
+    (BeginRecovery fan-out, WaitOnCommit) pending against a dead peer must
+    every one resolve as Timeout at their horizon — compaction may never
+    lose a live recovery callback."""
+    from accord_tpu.coordinate.errors import Timeout
+    from accord_tpu.maelstrom.node import MaelstromSink
+    from accord_tpu.messages.begin_recovery import BeginRecovery, WaitOnCommit
+    from accord_tpu.primitives.keys import Route, RoutingKeys
+    from accord_tpu.primitives.timestamp import (Ballot, Domain, TxnId,
+                                                 TxnKind)
+
+    class Proc:
+        request_timeout_micros = 1_000_000
+
+        def __init__(self):
+            self.t = 0
+
+        def now_micros(self):
+            return self.t
+
+        def emit_packet(self, to, body):
+            pass
+
+    class CB:
+        def __init__(self):
+            self.fail = []
+
+        def on_success(self, frm, reply):
+            pass
+
+        def on_failure(self, frm, exc):
+            self.fail.append(exc)
+
+    class Reply:
+        def is_final(self):
+            return True
+
+    txn_id = TxnId.create(1, 100, TxnKind.Write, Domain.Key, 1)
+    wait = WaitOnCommit(txn_id, RoutingKeys.of(5))
+    assert getattr(wait, "is_slow_read", False), \
+        "WaitOnCommit lost its slow-read marking"
+    proc = Proc()
+    sink = MaelstromSink(proc)
+    # a recovery storm's worth of WaitOnCommits all resolved promptly:
+    # pre-compaction these tombstones would sit heaped for the 10x horizon
+    for i in range(300):
+        sink.send_with_callback(2, wait, CB())
+        sink.on_response(2, i + 1, Reply())
+    assert len(sink.pending) == 0
+    assert len(sink._timeouts) <= 64, \
+        f"{len(sink._timeouts)} slow-read tombstones leaked"
+    # recovery requests against a peer that died mid-recovery: the
+    # BeginRecovery fan-out times out at the base horizon, the
+    # WaitOnCommit at its 10x horizon — neither lost by compaction
+    from accord_tpu.sim.kvstore import kv_txn
+    begin = BeginRecovery(txn_id, kv_txn([5], {}),
+                          Route.full(5, RoutingKeys.of(5)), Ballot.ZERO)
+    fast_cbs = [CB() for _ in range(4)]
+    slow_cbs = [CB() for _ in range(4)]
+    for cb in fast_cbs:
+        sink.send_with_callback(3, begin, cb)
+    for cb in slow_cbs:
+        sink.send_with_callback(3, wait, cb)
+    proc.t = 2_000_000          # past base horizon, before the 10x one
+    sink.sweep()
+    for cb in fast_cbs:
+        assert len(cb.fail) == 1 and isinstance(cb.fail[0], Timeout)
+    for cb in slow_cbs:
+        assert cb.fail == [], "slow-read timed out at the base horizon"
+    proc.t = 11_000_000         # past the 10x slow-read horizon
+    sink.sweep()
+    for cb in slow_cbs:
+        assert len(cb.fail) == 1 and isinstance(cb.fail[0], Timeout)
+    assert len(sink.pending) == 0
+    assert len(sink._timeouts) <= 64
+
+
 @pytest.mark.slow
 def test_overload_sheds_instead_of_collapsing():
     """The graceful-overload assertion (slow tier): at ~3x saturation the

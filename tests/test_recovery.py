@@ -377,6 +377,126 @@ def test_latest_deps_randomized_vs_model():
                 assert have_local == model_local, (trial, token)
 
 
+def test_recovery_quorum_timeout_retries_higher_ballot_no_timeout_leak():
+    """r14 satellite: a Recover whose quorum never answers must (a) fail
+    each attempt as a Timeout, (b) be retried by the progress log on the
+    jittered doubling backoff — NOT at full scan cadence — with a strictly
+    higher ballot per attempt, and (c) never leak a pending-timeout heap
+    entry in the NodeSink (the r07 tombstone contract extended to the
+    recovery callbacks)."""
+    from accord_tpu.messages.begin_recovery import BeginRecovery
+
+    cluster = _make_cluster(seed=41)   # progress log ON: it drives retries
+    attempts = []   # (sim_time, src node, ballot) per BeginRecovery fan-out
+
+    def flt(src, dst, req):
+        if isinstance(req, Commit) and src == 1:
+            return True                      # stall the original txn
+        if isinstance(req, BeginRecovery):
+            key = (cluster.queue.now, src, req.ballot)
+            if key not in attempts:
+                attempts.append(key)
+            return True                      # the recovery quorum is mute
+        return False
+
+    cluster.message_filter = flt
+    txn = kv_txn([10], {10: ("orphan",)})
+    out = submit(cluster, 1, txn)
+    cluster.run_for(40_000_000)
+    assert out and out[0][1] is not None, "txn should have stalled"
+    assert len(attempts) >= 2, \
+        f"recovery never retried: {len(attempts)} attempts"
+    # backoff must bite: full-cadence scanning would fire ~60+ attempts
+    # across three home replicas in this window
+    assert len(attempts) <= 25, \
+        f"recovery retry storm — backoff not applied: {len(attempts)}"
+    # each retry runs under a FRESH, higher ballot (per recovering node:
+    # ballots derive from unique_now, which advances between attempts)
+    per_node = {}
+    for _at, src, ballot in attempts:
+        per_node.setdefault(src, []).append(ballot)
+    for src, ballots in per_node.items():
+        assert all(b2 > b1 for b1, b2 in zip(ballots, ballots[1:])), \
+            f"node {src} retried without raising its ballot: {ballots}"
+    # no pending-timeout leak while the quorum is mute: every timed-out
+    # attempt's heap entry must have been cancelled/popped with it
+    for nid, sink in cluster.sinks.items():
+        if sink.dead:
+            continue
+        assert len(sink._timeout_entries) == len(sink._callbacks), \
+            f"node {nid}: timeout entries out of step with live callbacks"
+    # heal: the next backoff retry must complete the orphaned txn
+    cluster.message_filter = None
+    cluster.run_until_quiescent(max_micros=120_000_000)
+    assert cluster.failures == []
+    read = submit(cluster, 2, kv_txn([10], {}))
+    cluster.run_until_quiescent()
+    assert read[0][1] is None
+    assert read[0][0].reads == {10: ("orphan",)}
+    # ... and at quiescence nothing is left: no live callback, no live
+    # timeout entry, on any sink
+    for nid, sink in cluster.sinks.items():
+        if sink.dead:
+            continue
+        assert sink._callbacks == {}, f"node {nid} leaked callbacks"
+        assert sink._timeout_entries == {}, \
+            f"node {nid} leaked pending-timeout heap entries"
+
+
+# ---------------------------------------------------------------------------
+# r14 torture-rig pins: the recovery vote-set reconciler sweep
+# (tests/torture/test_recovery_reconciler.py) came up CLEAN over the
+# decision path, so per ISSUE 10 the three nastiest generated vote sets are
+# pinned here as scripted scenarios — replayed from their sweep seeds
+# through the real Recover decision path AND the spec model, with the
+# concrete decision frozen.
+# ---------------------------------------------------------------------------
+
+
+def _replay_rig_seed(seed):
+    from accord_tpu.utils.random_source import RandomSource
+    from torture.recovery_rig import make_case, model_decide, run_real
+    case = make_case(RandomSource(seed))
+    real, model = run_real(case), model_decide(case)
+    assert real == model, (real, model, case.describe())
+    return case, real
+
+
+def test_pinned_vote_set_ballot_tiebreak_inside_quorum_prefix():
+    """Sweep seed 7000063: an AcceptedInvalidate@b2 and a stale
+    Accepted@ZERO complete the quorum before a HIGHER-ballot Accepted@b3
+    can vote.  The decision must derive from the quorum prefix alone
+    (the late vote never existed), and within it the invalidation wins the
+    Accept-phase ballot tie-break — recovery completes the invalidation
+    instead of re-proposing the stale executeAt."""
+    _case, real = _replay_rig_seed(7000063)
+    assert real == ("invalidate",)
+
+
+def test_pinned_vote_set_late_accepted_after_quorum_is_ignored():
+    """Sweep seed 7000198: two PreAccepted votes reach quorum on every
+    shard; an Accepted@b4 vote arrives after.  The reconstruction must run
+    on the all-PreAccepted prefix: earlier txns accepted to execute after
+    us without witnessing us gate the decision -> WaitOnCommit for all
+    three, never a propose from the ghost Accepted vote."""
+    _case, real = _replay_rig_seed(7000198)
+    assert real[0] == "await" and len(real[1]) == 3
+
+
+def test_pinned_vote_set_committed_with_proposed_deps_collects():
+    """Sweep seed 7000060: the ranking winner is Committed (phase beats
+    the higher-ballot Accepted@b4), but its deps report only a
+    PROPOSED-grade LatestDeps segment and executeAt moved past txnId — the
+    quorum's knowledge is NOT commit-sufficient, so recovery must
+    re-execute at the known executeAt AND CollectDeps the uncovered
+    range instead of trusting local scans (ref: Recover.java:353)."""
+    case, real = _replay_rig_seed(7000060)
+    assert real[0] == "execute"
+    from torture.recovery_rig import txn_id_of
+    assert real[1] != txn_id_of(case)        # the moved executeAt
+    assert real[3] == frozenset({50})        # the CollectDeps'd token
+
+
 def test_recovery_determinism():
     """Same seed -> identical recovery outcome and message counts."""
     def run(seed):

@@ -1,0 +1,22 @@
+"""The protocol torture rig (r14).
+
+Seeded, shrinking, model-checked property sweeps over the densest protocol
+logic in the repo — the shape of the reference's own defense (SURVEY: the
+simulation harness plus an independent checker), reproduced as:
+
+- ``recovery_rig``: the recovery vote-set reconciler — every generated
+  RecoverOk vote set is fed both to the REAL ``Recover`` decision path
+  (driven through a harness node; no production code is forked) and to an
+  independent, spec-derived decision model written straight from the
+  reference's BeginRecovery/Recover semantics, and the decisions must match.
+- ``test_recovery_reconciler``: the >=1k-case seeded sweep (tier-1 runs a
+  reduced deterministic subset) plus the forced-divergence meta-test proving
+  a divergence prints the shrunk vote set and a replay seed.
+- ``test_cfk_properties``: >=500 seeded random lifecycle interleavings of
+  CommandsForKey (register / freeze / commit / apply / invalidate /
+  transitive witness / prune / remove) against a brute-force oracle model of
+  the missing[]-encoding and transitive-elision rules.
+
+Shared infrastructure (case streams, shrinking, replay seeds, the
+``ACCORD_TPU_PROPTEST_CASES`` knob) lives in ``tests/proptest.py``.
+"""

@@ -1,0 +1,736 @@
+"""Recovery vote-set reconciler: real ``Recover`` vs a spec-derived model.
+
+The densest decision procedure in the repo is the recovery quorum
+reconciliation in ``coordinate/recover.py`` (``Recover.on_success`` ->
+``_recover``): rank the votes, adopt the most advanced accept-phase-or-later
+decision, otherwise reconstruct whether the original fast-path commit can
+have happened from the earlierCommittedWitness / earlierAcceptedNoWitness /
+supersedingRejects facts.  This module tortures it:
+
+- ``make_case`` samples the RecoverOk space: statuses x ballots x executeAt
+  x deps proposals (LOCAL/PROPOSED/DECIDED LatestDeps grades) x
+  earlier_committed_witness / earlier_accepted_no_witness x
+  rejects_fast_path x per-vote range coverage x quorum geometry (1-2 shards,
+  shrunk fast-path electorates) x delivery order, plus RecoverNack and
+  network-failure events.  Cases are allowed OFF the reachable protocol
+  manifold on purpose — the implementation and the spec must agree on every
+  input, not just the ones today's proposer can produce.
+
+- ``run_real`` drives the REAL ``Recover`` object (no production code is
+  forked): a harness node records every outbound request, the
+  ``Adapters.recovery`` strategy seam and the ``persist``/``collect_deps``
+  continuations are swapped for recorders for the duration, and the
+  generated votes are delivered through the real ``on_success``/
+  ``on_failure`` path — so the RecoveryTracker quorum/electorate tallies,
+  the ranking, and the LatestDeps merges all execute for real.
+
+- ``model_decide`` is an INDEPENDENT decision model written straight from
+  the reference's semantics (Recover.java:239-345, Status.java Status.max,
+  RecoveryTracker.java rejectsFastPath, LatestDeps.java merge rules),
+  evaluated pointwise per token with plain sets — no production imports
+  beyond value types (TxnId/Ballot/Status enums).
+
+A decision is a plain tuple; ``check_case`` asserts real == model.
+"""
+
+from __future__ import annotations
+
+import itertools
+from contextlib import contextmanager
+from dataclasses import dataclass, replace
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from accord_tpu.coordinate import adapter as adapter_mod
+from accord_tpu.coordinate import collect_deps as collect_deps_mod
+from accord_tpu.coordinate import persist as persist_mod
+from accord_tpu.coordinate.recover import Recover
+from accord_tpu.local.status import Status
+from accord_tpu.messages.accept import AcceptInvalidate
+from accord_tpu.messages.begin_recovery import (RecoverNack, RecoverOk,
+                                                WaitOnCommit)
+from accord_tpu.messages.commit import CommitInvalidate
+from accord_tpu.primitives.deps import Deps, DepsBuilder
+from accord_tpu.primitives.keys import (IntKey, Keys, Range, Ranges, Route,
+                                        RoutingKeys)
+from accord_tpu.primitives.latest_deps import (DECIDED, LOCAL, PROPOSED,
+                                               LatestDeps)
+from accord_tpu.primitives.timestamp import (Ballot, Domain, Timestamp,
+                                             TxnId, TxnKind)
+from accord_tpu.topology.shard import Shard
+from accord_tpu.topology.topology import Topologies, Topology
+from accord_tpu.utils import async_chain
+from accord_tpu.utils.random_source import RandomSource
+
+EPOCH = 1
+TXN_HLC = 500_000
+
+# RecoverOk statuses a replica vote can carry (NotDefined is the fenced
+# non-witness vote BeginRecovery emits for rejectBefore'd txns)
+VOTE_STATUSES = (
+    Status.NotDefined, Status.PreAccepted, Status.Accepted,
+    Status.AcceptedInvalidate, Status.PreCommitted, Status.Committed,
+    Status.Stable, Status.PreApplied, Status.Applied, Status.Invalidated,
+    Status.Truncated,
+)
+_STATUS_BY_NAME = {s.name: s for s in VOTE_STATUSES}
+
+
+# ---------------------------------------------------------------------------
+# case shape (plain data: rebuilt into protocol objects per check, so the
+# shrink loop can copy/mutate freely)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class VoteSpec:
+    node: int
+    kind: str = "ok"                 # ok | nack | fail
+    status: str = "PreAccepted"
+    ballot: int = 0                  # accepted ballot (0 => Ballot.ZERO)
+    exec_kind: str = "fast"          # none | fast | later | earlier
+    exec_delta: int = 1
+    coverage: Tuple[int, ...] = ()   # tokens this vote's LatestDeps covers
+    grade: Optional[int] = None      # LOCAL | PROPOSED | DECIDED | None
+    coord: Tuple[Tuple[int, int], ...] = ()   # (token, dep index)
+    local: Tuple[Tuple[int, int], ...] = ()
+    ecw: Tuple[Tuple[int, int], ...] = ()     # earlier committed witness
+    eanw: Tuple[Tuple[int, int], ...] = ()    # earlier accepted no witness
+    rejects: bool = False
+    nack_ballot: Optional[int] = None         # nack: None => Truncated
+
+
+@dataclass(frozen=True)
+class VoteCase:
+    # shard geometry: (start, end, nodes, fast_path_electorate)
+    shards: Tuple[Tuple[int, int, Tuple[int, ...], Tuple[int, ...]], ...]
+    tokens: Tuple[int, ...]
+    txn_node: int
+    dep_hlcs: Tuple[int, ...]        # dep pool (index-addressed from votes)
+    events: Tuple[VoteSpec, ...]
+
+    def describe(self) -> str:
+        lines = [f"txn: Write@hlc={TXN_HLC} node={self.txn_node} "
+                 f"tokens={list(self.tokens)}"]
+        for s, e, nodes, elec in self.shards:
+            lines.append(f"shard [{s},{e}) nodes={list(nodes)} "
+                         f"electorate={sorted(elec)}")
+        lines.append("dep pool: " + ", ".join(
+            f"d{i}=hlc{h}" for i, h in enumerate(self.dep_hlcs)))
+        for ev in self.events:
+            if ev.kind == "fail":
+                lines.append(f"  n{ev.node}: FAIL")
+            elif ev.kind == "nack":
+                lines.append(f"  n{ev.node}: NACK("
+                             f"{'preempted b' + str(ev.nack_ballot) if ev.nack_ballot is not None else 'truncated'})")
+            else:
+                lines.append(
+                    f"  n{ev.node}: {ev.status} b={ev.ballot} "
+                    f"exec={ev.exec_kind}+{ev.exec_delta} "
+                    f"cov={list(ev.coverage)} grade={ev.grade} "
+                    f"coord={list(ev.coord)} local={list(ev.local)} "
+                    f"ecw={list(ev.ecw)} eanw={list(ev.eanw)} "
+                    f"rejects={ev.rejects}")
+        return "\n".join(lines)
+
+
+def txn_id_of(case: VoteCase) -> TxnId:
+    return TxnId.create(EPOCH, TXN_HLC, TxnKind.Write, Domain.Key,
+                        case.txn_node)
+
+
+def dep_pool_of(case: VoteCase) -> List[TxnId]:
+    return [TxnId.create(EPOCH, h, TxnKind.Write, Domain.Key, 1 + (i % 3))
+            for i, h in enumerate(case.dep_hlcs)]
+
+
+def route_of(case: VoteCase) -> Route:
+    return Route.full(case.tokens[0], RoutingKeys.of(*case.tokens))
+
+
+def topology_of(case: VoteCase) -> Topology:
+    shards = [Shard(Range(s, e), list(nodes), frozenset(elec))
+              for s, e, nodes, elec in case.shards]
+    return Topology(EPOCH, shards)
+
+
+def exec_at_of(case: VoteCase, spec: VoteSpec):
+    txn_id = txn_id_of(case)
+    if spec.exec_kind == "none":
+        return None
+    if spec.exec_kind == "fast":
+        return txn_id
+    if spec.exec_kind == "later":
+        return Timestamp.from_values(EPOCH, TXN_HLC + spec.exec_delta,
+                                     spec.node)
+    return Timestamp.from_values(EPOCH, max(1, TXN_HLC - spec.exec_delta),
+                                 spec.node)
+
+
+def _deps_of(pairs, pool) -> Deps:
+    b = DepsBuilder()
+    for token, dep_i in pairs:
+        b.add_key(token, pool[dep_i % len(pool)])
+    return b.build()
+
+
+def _ballot_of(n: int, node: int) -> Ballot:
+    return Ballot.ZERO if n == 0 else Ballot(0, n, node)
+
+
+def recover_ok_of(case: VoteCase, spec: VoteSpec) -> RecoverOk:
+    txn_id = txn_id_of(case)
+    pool = dep_pool_of(case)
+    status = _STATUS_BY_NAME[spec.status]
+    accepted = _ballot_of(spec.ballot, spec.node)
+    exec_at = exec_at_of(case, spec)
+    if spec.grade is None or not spec.coverage:
+        latest = LatestDeps.none()
+    else:
+        ranges = Ranges.of(*[Range(t, t + 1) for t in spec.coverage])
+        coord = _deps_of(spec.coord, pool)
+        local = _deps_of(spec.local, pool)
+        if spec.grade == DECIDED:
+            latest = LatestDeps.create(ranges, DECIDED, Ballot.ZERO, coord,
+                                       None)
+        elif spec.grade == PROPOSED:
+            latest = LatestDeps.create(ranges, PROPOSED, accepted, coord,
+                                       local)
+        else:
+            latest = LatestDeps.create(ranges, LOCAL, Ballot.ZERO, None,
+                                       local)
+    writes = f"w{spec.node}" if status in (Status.PreApplied,
+                                           Status.Applied) else None
+    result = f"r{spec.node}" if writes is not None else None
+    return RecoverOk(txn_id, status, accepted, exec_at, latest,
+                     _deps_of(spec.ecw, pool), _deps_of(spec.eanw, pool),
+                     spec.rejects, writes, result)
+
+
+# ---------------------------------------------------------------------------
+# generator
+# ---------------------------------------------------------------------------
+
+_STATUS_WEIGHTS = (
+    ("NotDefined", 5), ("PreAccepted", 34), ("Accepted", 14),
+    ("AcceptedInvalidate", 8), ("PreCommitted", 7), ("Committed", 11),
+    ("Stable", 8), ("PreApplied", 5), ("Applied", 4), ("Invalidated", 2),
+    ("Truncated", 2),
+)
+
+
+def _gen_pairs(rng: RandomSource, tokens, n_deps: int, max_n: int,
+               lo_only: bool = False, dep_lo: int = 0):
+    out = []
+    for _ in range(rng.next_int(max_n + 1)):
+        dep_i = dep_lo + rng.next_int(max(1, n_deps - dep_lo)) \
+            if lo_only else rng.next_int(n_deps)
+        out.append((tokens[rng.next_int(len(tokens))], dep_i))
+    return tuple(out)
+
+
+def make_case(rng: RandomSource) -> VoteCase:
+    n_nodes = 3 if rng.decide(0.6) else 5
+    all_nodes = tuple(range(1, n_nodes + 1))
+    n_tokens = 1 + rng.next_int(3)
+    tokens = tuple(sorted(rng.sample(range(0, 100, 10), n_tokens)))
+    # geometry: one shard over everything, or a 2-shard split of the tokens
+    two_shards = len(tokens) >= 2 and rng.decide(0.35)
+    def electorate(nodes):
+        rf = len(nodes)
+        f = (rf - 1) // 2
+        if rng.decide(0.3) and rf - f < rf:
+            # legal shrunk electorate (>= rf - f members)
+            k = (rf - f) + rng.next_int(f + 1)
+            return tuple(sorted(rng.sample(nodes, k)))
+        return tuple(nodes)
+    def shard_nodes():
+        if n_nodes == 5 and rng.decide(0.4):
+            return tuple(sorted(rng.sample(all_nodes, 3)))
+        return all_nodes
+    if two_shards:
+        cut = 1 + rng.next_int(len(tokens) - 1)
+        lo_hi = tokens[cut - 1] + 1
+        n1, n2 = shard_nodes(), shard_nodes()
+        shards = ((0, lo_hi, n1, electorate(n1)),
+                  (lo_hi, 101, n2, electorate(n2)))
+    else:
+        n1 = shard_nodes()
+        shards = ((0, 101, n1, electorate(n1)),)
+
+    # dep pool: ids below AND above the recovering txn
+    n_deps = 3 + rng.next_int(4)
+    dep_hlcs = tuple(
+        TXN_HLC - 1000 + rng.next_int(900) if rng.decide(0.75)
+        else TXN_HLC + 100 + rng.next_int(900)
+        for _ in range(n_deps))
+    n_lower = sum(1 for h in dep_hlcs if h < TXN_HLC)
+
+    participants = sorted({n for _s, _e, nodes, _el in shards
+                           for n in nodes})
+    events: List[VoteSpec] = []
+    order = rng.shuffle(list(participants))
+    for node in order:
+        roll = rng.next_float()
+        if roll < 0.04:
+            events.append(VoteSpec(node=node, kind="fail"))
+            continue
+        if roll < 0.07:
+            events.append(VoteSpec(
+                node=node, kind="nack",
+                nack_ballot=None if rng.decide(0.4)
+                else 1 + rng.next_int(5)))
+            continue
+        if roll < 0.12:
+            continue   # silent node (never answers)
+        status = rng.pick_weighted([s for s, _ in _STATUS_WEIGHTS],
+                                   [w for _, w in _STATUS_WEIGHTS])
+        st = _STATUS_BY_NAME[status]
+        # executeAt: decided statuses always carry one; the fenced
+        # NotDefined vote never does; AcceptedInvalidate may not
+        if st is Status.NotDefined:
+            exec_kind = "none"
+        elif st is Status.AcceptedInvalidate:
+            exec_kind = rng.pick(["none", "fast", "later"])
+        elif st is Status.PreAccepted:
+            exec_kind = rng.pick(["fast", "fast", "later", "earlier"])
+        else:
+            exec_kind = rng.pick(["fast", "later", "later", "earlier"])
+        ballot = 0
+        if st in (Status.Accepted, Status.AcceptedInvalidate,
+                  Status.PreCommitted) or \
+                (st >= Status.Committed and rng.decide(0.4)):
+            ballot = rng.next_int(5)
+        # LatestDeps grade per status (off-manifold combinations allowed
+        # with small probability)
+        if st is Status.NotDefined:
+            grade = None
+        elif st is Status.Accepted:
+            grade = PROPOSED if rng.decide(0.85) else LOCAL
+        elif st.is_committed() or st is Status.PreCommitted:
+            grade = DECIDED if rng.decide(0.8) else \
+                (PROPOSED if rng.decide(0.5) else LOCAL)
+        else:
+            grade = LOCAL if rng.decide(0.9) else PROPOSED
+        coverage = tuple(sorted(rng.sample(
+            tokens, 1 + rng.next_int(len(tokens))))) \
+            if rng.decide(0.9) else ()
+        # scans only run below PreCommitted; generate scan facts there
+        # (tiny off-manifold probability elsewhere to pin that the
+        # decision path ignores them)
+        scans = st in (Status.PreAccepted, Status.Accepted,
+                       Status.AcceptedInvalidate) or rng.decide(0.05)
+        ecw = _gen_pairs(rng, tokens, n_deps, 2, lo_only=True) \
+            if scans and n_lower else ()
+        eanw = _gen_pairs(rng, tokens, n_deps, 2, lo_only=True) \
+            if scans and n_lower else ()
+        rejects = scans and rng.decide(0.22)
+        events.append(VoteSpec(
+            node=node, status=status, ballot=ballot, exec_kind=exec_kind,
+            exec_delta=1 + rng.next_int(200), coverage=coverage,
+            grade=grade,
+            coord=_gen_pairs(rng, tokens, n_deps, 3),
+            local=_gen_pairs(rng, tokens, n_deps, 3),
+            ecw=ecw, eanw=eanw, rejects=rejects))
+    return VoteCase(shards=shards, tokens=tokens,
+                    txn_node=1 + rng.next_int(n_nodes),
+                    dep_hlcs=dep_hlcs, events=tuple(events))
+
+
+def shrink_candidates(case: VoteCase):
+    """Strictly-simpler variants, in preference order: drop whole events,
+    then simplify each vote field toward the trivial PreAccepted@fast
+    no-deps vote."""
+    for i in range(len(case.events)):
+        yield replace(case, events=case.events[:i] + case.events[i + 1:])
+    for i, ev in enumerate(case.events):
+        def with_ev(e):
+            return replace(case,
+                           events=case.events[:i] + (e,) + case.events[i + 1:])
+        if ev.kind != "ok":
+            yield with_ev(VoteSpec(node=ev.node))
+            continue
+        if ev.status != "PreAccepted":
+            yield with_ev(replace(ev, status="PreAccepted", ballot=0))
+        if ev.ballot:
+            yield with_ev(replace(ev, ballot=0))
+        if ev.exec_kind != "fast" and ev.status != "NotDefined":
+            yield with_ev(replace(ev, exec_kind="fast"))
+        if ev.coord or ev.local:
+            yield with_ev(replace(ev, coord=(), local=()))
+        if ev.ecw or ev.eanw:
+            yield with_ev(replace(ev, ecw=(), eanw=()))
+        if ev.rejects:
+            yield with_ev(replace(ev, rejects=False))
+        if ev.grade is not None:
+            yield with_ev(replace(ev, grade=None, coverage=()))
+
+
+# ---------------------------------------------------------------------------
+# the real path: a harness node + decision capture around the REAL Recover
+# ---------------------------------------------------------------------------
+
+class _Chain:
+    def begin(self, cb) -> None:
+        pass
+
+
+class _Recorder:
+    def __init__(self):
+        self.sends: List[Tuple[int, object]] = []
+        self.proposed = None
+        self.executed = None
+        self.persisted = None
+        self.collected = None
+
+
+class _RecordingAdapter:
+    """Stands in for Adapters.recovery: the decision IS the call."""
+
+    def __init__(self, rec: _Recorder):
+        self._rec = rec
+
+    def propose(self, node, ballot, txn_id, txn, route, execute_at, deps):
+        self._rec.proposed = (ballot, execute_at, deps)
+        return _Chain()
+
+    def execute(self, node, txn_id, txn, route, execute_at, deps,
+                ballot=None):
+        self._rec.executed = (execute_at, deps, ballot)
+        return _Chain()
+
+
+class _Events:
+    def on_invalidated(self, txn_id) -> None:
+        pass
+
+
+class _Agent:
+    def events_listener(self):
+        return _Events()
+
+
+class HarnessNode:
+    """The minimal node surface Recover touches: send, with_epoch,
+    unique_now (ballot bits), topology().for_epoch, agent.  Every outbound
+    request lands in the recorder."""
+
+    def __init__(self, topology: Topology, rec: _Recorder):
+        self.node_id = 99
+        self.agent = _Agent()
+        self.obs = None          # spans_of(node) -> None
+        self._topology = topology
+        self._rec = rec
+        self._hlc = itertools.count(1_000_000)
+
+    def send(self, to: int, request, callback=None) -> None:
+        self._rec.sends.append((to, request))
+
+    def with_epoch(self, epoch: int, fn) -> None:
+        fn()
+
+    def unique_now(self) -> Timestamp:
+        return Timestamp.from_values(EPOCH, next(self._hlc), self.node_id)
+
+    # topology-manager shim: for_epoch slices the single topology like
+    # TopologyManager._trim (shards intersecting the selection)
+    def topology(self) -> "HarnessNode":
+        return self
+
+    def for_epoch(self, select, epoch: int) -> Topologies:
+        return Topologies([Topology(self._topology.epoch,
+                                    self._topology.for_selection(select))])
+
+
+class _TxnStub:
+    """Recover only touches txn.keys (to slice for CollectDeps)."""
+
+    def __init__(self, tokens):
+        self.keys = Keys([IntKey(t) for t in tokens])
+
+    def __repr__(self):
+        return f"TxnStub({list(self.keys.tokens())})"
+
+
+@contextmanager
+def _patched(rec: _Recorder):
+    prior_adapter = adapter_mod.Adapters.recovery
+    prior_persist = persist_mod.persist
+    prior_collect = collect_deps_mod.collect_deps
+
+    def persist_stub(node, txn_id, txn, route, execute_at, deps, writes,
+                     result):
+        rec.persisted = (execute_at, deps, writes)
+
+    def collect_stub(node, txn_id, route, keys, execute_at):
+        rec.collected = route
+
+        class _Collected:
+            def begin(self, cb):
+                cb(None, None)    # nothing extra: decision already captured
+        return _Collected()
+
+    adapter_mod.Adapters.recovery = _RecordingAdapter(rec)
+    persist_mod.persist = persist_stub
+    collect_deps_mod.collect_deps = collect_stub
+    try:
+        yield
+    finally:
+        adapter_mod.Adapters.recovery = prior_adapter
+        persist_mod.persist = prior_persist
+        collect_deps_mod.collect_deps = prior_collect
+
+
+def _deps_by_token(deps: Deps, tokens) -> Dict[int, FrozenSet[TxnId]]:
+    out = {}
+    for t in tokens:
+        ids = frozenset(deps.key_deps.txn_ids_for(t))
+        if ids:
+            out[t] = ids
+    return out
+
+
+def run_real(case: VoteCase):
+    """Deliver the generated vote events through the real Recover and
+    normalize what it DID into a decision tuple."""
+    rec = _Recorder()
+    topology = topology_of(case)
+    node = HarnessNode(topology, rec)
+    txn_id = txn_id_of(case)
+    route = route_of(case)
+    result = async_chain.AsyncResult()
+    settled: List[Tuple[object, Optional[BaseException]]] = []
+    result.begin(lambda v, f: settled.append((v, f)))
+    with _patched(rec):
+        r = Recover(node, txn_id, _TxnStub(case.tokens), route, result)
+        r._start()
+        for ev in case.events:
+            if ev.kind == "fail":
+                r.on_failure(ev.node, TimeoutError("torture"))
+            elif ev.kind == "nack":
+                r.on_success(ev.node, RecoverNack(
+                    None if ev.nack_ballot is None
+                    else _ballot_of(ev.nack_ballot, ev.node)))
+            else:
+                r.on_success(ev.node, recover_ok_of(case, ev))
+
+    tokens = case.tokens
+    missing = frozenset(rec.collected.participants) \
+        if rec.collected is not None else frozenset()
+    if rec.persisted is not None:
+        exec_at, deps, _writes = rec.persisted
+        return ("repersist", exec_at, _deps_by_token(deps, tokens), missing)
+    if rec.executed is not None:
+        exec_at, deps, _ballot = rec.executed
+        return ("execute", exec_at, _deps_by_token(deps, tokens), missing)
+    if rec.proposed is not None:
+        _ballot, exec_at, deps = rec.proposed
+        return ("propose", exec_at, _deps_by_token(deps, tokens))
+    waits = frozenset(req.txn_id for _to, req in rec.sends
+                      if isinstance(req, WaitOnCommit))
+    if waits:
+        return ("await", waits)
+    if any(isinstance(req, AcceptInvalidate) for _to, req in rec.sends):
+        return ("invalidate",)
+    if any(isinstance(req, CommitInvalidate) for _to, req in rec.sends):
+        return ("commit_invalidate",)
+    if settled and settled[0][1] is not None:
+        return ("failed", type(settled[0][1]).__name__)
+    return ("pending",)
+
+
+# ---------------------------------------------------------------------------
+# the independent model (spec-derived; plain sets, pointwise per token)
+# ---------------------------------------------------------------------------
+
+# Status -> consensus phase, straight from the reference's Status.java
+# phase table (NONE=0 PreAccept=1 Accept=2 Commit=3 Execute=4 Persist=5
+# Cleanup=6); Accept and Commit phases tie-break on the accepted ballot
+_SPEC_PHASE = {
+    "NotDefined": 0, "PreAccepted": 1, "AcceptedInvalidate": 2,
+    "Accepted": 2, "PreCommitted": 2, "Committed": 3, "Stable": 4,
+    "PreApplied": 5, "Applied": 5, "Invalidated": 5, "Truncated": 6,
+}
+_SPEC_BALLOT_PHASES = (2, 3)
+# within a phase, the status ordinal breaks remaining ties (Status ladder)
+_SPEC_ORDINAL = {
+    "NotDefined": 0, "PreAccepted": 1, "AcceptedInvalidate": 2,
+    "Accepted": 3, "PreCommitted": 4, "Committed": 5, "Stable": 6,
+    "PreApplied": 7, "Applied": 8, "Truncated": 9, "Invalidated": 10,
+}
+
+
+def _spec_rank(spec: VoteSpec, node: int):
+    phase = _SPEC_PHASE[spec.status]
+    ballot = _ballot_of(spec.ballot, node) \
+        if phase in _SPEC_BALLOT_PHASES else Ballot.ZERO
+    return (phase, ballot, _SPEC_ORDINAL[spec.status])
+
+
+def model_decide(case: VoteCase):
+    txn_id = txn_id_of(case)
+    pool = dep_pool_of(case)
+
+    # -- 1. the quorum prefix (RecoveryTracker semantics from the spec:
+    #    majority per shard; electorate members whose vote does not accept
+    #    the fast path tally as rejects, INCLUDING on already-done shards) --
+    class _Sh:
+        def __init__(self, s, e, nodes, elec):
+            self.nodes = set(nodes)
+            self.elec = set(elec)
+            rf = len(nodes)
+            self.f = (rf - 1) // 2
+            self.quorum = rf - self.f
+            self.fpq = (self.f + len(elec)) // 2 + 1
+            self.succ = set()
+            self.fail = set()
+            self.rej = set()
+            self.done = False
+
+    shards = [_Sh(*spec) for spec in case.shards]
+
+    def all_done():
+        return all(sh.done for sh in shards)
+
+    prefix: List[VoteSpec] = []
+    for ev in case.events:
+        if all_done():
+            break
+        if ev.kind == "nack":
+            return ("failed",
+                    "Preempted" if ev.nack_ballot is not None
+                    else "Truncated")
+        if ev.kind == "fail":
+            for sh in shards:
+                if ev.node in sh.nodes and not sh.done:
+                    sh.fail.add(ev.node)
+                    if len(sh.fail) > sh.f:
+                        return ("failed", "Timeout")
+            continue
+        prefix.append(ev)
+        exec_at = exec_at_of(case, ev)
+        accepts_fast = exec_at == txn_id
+        for sh in shards:
+            if ev.node in sh.nodes:
+                sh.succ.add(ev.node)
+                if not accepts_fast and ev.node in sh.elec:
+                    sh.rej.add(ev.node)
+                if len(sh.succ) >= sh.quorum:
+                    sh.done = True
+    if not all_done():
+        return ("pending",)
+
+    # -- per-token LatestDeps merge model (first covering vote of maximal
+    #    (grade, ballot-if-proposed) wins a token; locals union while the
+    #    winner is below DECIDED) --
+    def covering(token):
+        return [ev for ev in prefix
+                if ev.grade is not None and token in ev.coverage]
+
+    def winner(token):
+        cov = covering(token)
+        if not cov:
+            return None
+        def grade_rank(ev):
+            return (ev.grade,
+                    _ballot_of(ev.ballot, ev.node) if ev.grade == PROPOSED
+                    else Ballot.ZERO)
+        best = cov[0]
+        for ev in cov[1:]:
+            if grade_rank(ev) > grade_rank(best):
+                best = ev
+        return best
+
+    def ids_at(pairs, token):
+        return frozenset(pool[i % len(pool)]
+                         for tok, i in pairs if tok == token)
+
+    def coord_at(ev, token):
+        # LatestDeps.create slices deps to the vote's coverage
+        return ids_at(ev.coord, token)
+
+    def locals_at(token):
+        out = set()
+        for ev in covering(token):
+            if ev.grade in (LOCAL, PROPOSED):
+                out |= ids_at(ev.local, token)
+        return frozenset(out)
+
+    def proposal_deps():
+        out = {}
+        for t in case.tokens:
+            win = winner(t)
+            if win is None:
+                continue
+            ids = coord_at(win, t) if win.grade >= PROPOSED else locals_at(t)
+            if ids:
+                out[t] = frozenset(ids)
+        return out
+
+    def commit_deps(accept_local: bool):
+        deps, missing = {}, set()
+        for t in case.tokens:
+            win = winner(t)
+            if win is None:
+                missing.add(t)
+                continue
+            if win.grade == DECIDED:
+                ids = coord_at(win, t)
+            elif accept_local:
+                ids = (coord_at(win, t) if win.grade == PROPOSED
+                       else frozenset()) | locals_at(t)
+            else:
+                missing.add(t)
+                continue
+            if ids:
+                deps[t] = frozenset(ids)
+        return deps, frozenset(missing)
+
+    # -- 2. the decision (Recover.java:239-345) --
+    cands = [ev for ev in prefix if _SPEC_PHASE[ev.status] >= 2]
+    max_ev = None
+    for ev in cands:
+        if max_ev is None or _spec_rank(ev, ev.node) > \
+                _spec_rank(max_ev, max_ev.node):
+            max_ev = ev
+    if max_ev is not None:
+        st = max_ev.status
+        exec_at = exec_at_of(case, max_ev)
+        if st == "Truncated":
+            return ("failed", "Truncated")
+        if st == "Invalidated":
+            return ("commit_invalidate",)
+        if st in ("Applied", "PreApplied"):
+            deps, missing = commit_deps(exec_at == txn_id)
+            return ("repersist", exec_at, deps, missing)
+        if st in ("Stable", "Committed", "PreCommitted"):
+            deps, missing = commit_deps(exec_at == txn_id)
+            return ("execute", exec_at, deps, missing)
+        if st == "Accepted":
+            return ("propose", exec_at, proposal_deps())
+        return ("invalidate",)     # AcceptedInvalidate
+
+    # all PreAccepted / unwitnessed: fast-path reconstruction
+    superseding = any(len(sh.rej) > len(sh.elec) - sh.fpq for sh in shards)
+    if superseding or any(ev.rejects for ev in prefix):
+        return ("invalidate",)
+    ecw_ids = {pool[i % len(pool)] for ev in prefix for _t, i in ev.ecw}
+    eanw_ids = {pool[i % len(pool)] for ev in prefix
+                for _t, i in ev.eanw} - ecw_ids
+    if eanw_ids:
+        return ("await", frozenset(eanw_ids))
+    return ("propose", txn_id, proposal_deps())
+
+
+# ---------------------------------------------------------------------------
+# the property
+# ---------------------------------------------------------------------------
+
+def check_case(case: VoteCase, perturb=None) -> None:
+    """real decision == model decision.  ``perturb`` (tests only) mutates
+    the MODEL's decision to force a divergence — the meta-test proving the
+    rig actually reports, shrinks and prints the replay seed."""
+    real = run_real(case)
+    model = model_decide(case)
+    if perturb is not None:
+        model = perturb(model)
+    assert real == model, (
+        f"decision divergence:\n  real : {real}\n  model: {model}")

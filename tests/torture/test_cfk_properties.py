@@ -1,0 +1,461 @@
+"""CFK lifecycle property sweep (ISSUE 10 tentpole, leg 2).
+
+>=500 seeded random interleavings of the CommandsForKey API surface its real
+callers exercise — register (PreAccept witness), deps freeze (accept /
+commit with witnessed_deps), advance (stable/applied), invalidate,
+transitive witness, sync-point deps, prune, truncation-time remove, and the
+late-stale-update races — each replayed against a brute-force ORACLE model
+written straight from the reference's design comment
+(CommandsForKey.java:73-131): full per-command witnessed sets, spec-rule
+missing[] maintenance with plain Python sets, and a recomputed-from-scratch
+committed-write pivot multiset.  After every interleaving the compressed
+index must agree with the oracle on:
+
+- membership, per-entry status and executeAt (incl. the decided-executeAt
+  regression guard against stale ACCEPTED updates);
+- the EXACT missing[] divergence arrays (and the witnesses_id API view);
+- the committed-write pivot list and the unwitnessable count (the device
+  attribution's elision fast-path inputs);
+- the full active scan (map_reduce_active) at multiple bounds and querying
+  kinds — computed independently from the elision spec, exact equality;
+- map_reduce_full visibility for recovery queries.
+
+Pinned races the generator drives on purpose: prune-vs-late-witness (a
+transitive witness below the prune watermark must never resurrect), freeze
+-vs-later-insert (ids arriving after a freeze are provably unwitnessed),
+decide-vs-missing-elision, invalidate-after-commit pivot retraction, and
+re-freeze under a higher ballot (last proposal wins).
+
+Tier-1 runs a reduced deterministic subset; ``-m slow`` runs the >=500-case
+sweep (crank with ``ACCORD_TPU_PROPTEST_CASES``).
+"""
+
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Set, Tuple
+
+import pytest
+
+from proptest import case_budget, run_property
+from accord_tpu.local.commands_for_key import CommandsForKey, InternalStatus
+from accord_tpu.primitives.timestamp import (Domain, Kinds, Timestamp,
+                                             TxnId, TxnKind)
+from accord_tpu.utils.random_source import RandomSource
+
+IS = InternalStatus
+BASE_SEED = 29
+REPLAY_HINT = ("python -m pytest "
+               "tests/torture/test_cfk_properties.py -k sweep")
+
+# fixed id pool: hlcs 100,110,... — ops address ids by pool index, so cases
+# stay plain data for the shrink loop
+POOL_HLCS = tuple(100 + 10 * i for i in range(14))
+
+
+def _pool() -> List[TxnId]:
+    out = []
+    for i, h in enumerate(POOL_HLCS):
+        kind = TxnKind.Write if i % 3 != 2 else TxnKind.Read
+        out.append(TxnId.create(1, h, kind, Domain.Key, 1 + (i % 3)))
+    return out
+
+
+FENCE = TxnId.create(1, 555, TxnKind.ExclusiveSyncPoint, Domain.Range, 1)
+
+
+def _ts(hlc: int, node: int = 1) -> Timestamp:
+    return Timestamp.from_values(1, hlc, node)
+
+
+@dataclass(frozen=True)
+class CFKCase:
+    ops: Tuple[Tuple, ...]
+
+    def describe(self) -> str:
+        return "\n".join(f"  {op}" for op in self.ops)
+
+
+# ---------------------------------------------------------------------------
+# generator
+# ---------------------------------------------------------------------------
+
+def make_case(rng: RandomSource) -> CFKCase:
+    n_ops = 30 + rng.next_int(70)
+    ops: List[Tuple] = []
+    for _ in range(n_ops):
+        roll = rng.next_float()
+        i = rng.next_int(len(POOL_HLCS))
+        if roll < 0.30:
+            ops.append(("new", i))
+        elif roll < 0.45:
+            # freeze at ACCEPTED: proposed executeAt + witnessed dep subset
+            ops.append(("accept", i, rng.next_int(40),
+                        rng.next_int(1 << len(POOL_HLCS)),
+                        rng.decide(0.12)))          # include the fence dep
+        elif roll < 0.62:
+            ops.append(("commit", i, rng.next_int(40),
+                        rng.next_int(1 << len(POOL_HLCS)),
+                        rng.decide(0.08)))
+        elif roll < 0.72:
+            ops.append(("advance", i,
+                        "APPLIED" if rng.decide(0.5) else "STABLE"))
+        elif roll < 0.79:
+            ops.append(("invalidate", i))
+        elif roll < 0.86:
+            ops.append(("transitive", i))
+        elif roll < 0.90:
+            # the stale late-ACCEPTED update race (regressed executeAt)
+            ops.append(("late_accept", i, rng.next_int(900)))
+        elif roll < 0.95:
+            ops.append(("prune", i))
+        else:
+            ops.append(("remove", i))
+    return CFKCase(ops=tuple(ops))
+
+
+def shrink_candidates(case: CFKCase):
+    for i in range(len(case.ops)):
+        yield replace(case, ops=case.ops[:i] + case.ops[i + 1:])
+
+
+# ---------------------------------------------------------------------------
+# the oracle: uncompressed ground truth, spec rules with plain sets
+# ---------------------------------------------------------------------------
+
+class Oracle:
+    def __init__(self):
+        self.status: Dict[TxnId, IS] = {}
+        self.exec_at: Dict[TxnId, Timestamp] = {}
+        self.missing: Dict[TxnId, Set[TxnId]] = {}   # only frozen entries
+        # decided-write executeAts (multiset: duplicates legal) of the
+        # entries PRESENT in the index — invalidation, removal and prune
+        # all retract the pivot with the entry
+        self.pivots: List[Timestamp] = []
+        self.prune_before: Optional[TxnId] = None
+
+    # -- spec rules ---------------------------------------------------------
+    def _notify_insert(self, tid: TxnId, status: IS) -> None:
+        """A new id entered the collection: every LATER frozen command is
+        guaranteed not to have witnessed it (deps were ensured present at
+        freeze time) — unless the id arrived already decided."""
+        if status >= IS.COMMITTED:
+            return
+        for t2, miss in self.missing.items():
+            if t2 > tid and t2.kind().witnesses().test(tid.kind()):
+                miss.add(tid)
+
+    def _elide(self, tid: TxnId) -> None:
+        for miss in self.missing.values():
+            miss.discard(tid)
+
+    def update(self, tid: TxnId, status: IS,
+               exec_at: Optional[Timestamp] = None,
+               deps: Optional[List[TxnId]] = None) -> None:
+        if not tid.kind().is_globally_visible():
+            return
+        if tid not in self.status:
+            self.status[tid] = status
+            self.exec_at[tid] = exec_at if exec_at is not None else tid
+            if IS.COMMITTED <= status <= IS.APPLIED and \
+                    tid.kind().is_write():
+                self.pivots.append(self.exec_at[tid])
+            self._notify_insert(tid, status)
+        else:
+            prev = self.status[tid]
+            new = max(prev, status)
+            self.status[tid] = new
+            # a decided executeAt never regresses to a stale proposal; when
+            # a decided-grade update legitimately moves an already-indexed
+            # write's executeAt, the pivot multiset follows it (the r14
+            # ghost-pivot find)
+            if exec_at is not None and IS.ACCEPTED <= status <= IS.APPLIED \
+                    and (status >= prev or prev < IS.COMMITTED) \
+                    and exec_at != self.exec_at[tid]:
+                if IS.COMMITTED <= prev <= IS.APPLIED \
+                        and tid.kind().is_write():
+                    self.pivots.remove(self.exec_at[tid])
+                    self.pivots.append(exec_at)
+                self.exec_at[tid] = exec_at
+            if new is IS.INVALIDATED and \
+                    IS.COMMITTED <= prev <= IS.APPLIED and \
+                    tid.kind().is_write():
+                if self.exec_at[tid] in self.pivots:
+                    self.pivots.remove(self.exec_at[tid])
+            if prev < IS.COMMITTED and new >= IS.COMMITTED:
+                self._elide(tid)
+                if new is not IS.INVALIDATED and tid.kind().is_write():
+                    self.pivots.append(self.exec_at[tid])
+        if deps is not None:
+            witnessed = set()
+            for d in deps:
+                if d == tid:
+                    continue
+                witnessed.add(d)
+                if not d.kind().is_sync_point():
+                    self.witness_transitive(d)
+            kinds = tid.kind().witnesses()
+            self.missing[tid] = {
+                d2 for d2, st in self.status.items()
+                if d2 < tid and d2 not in witnessed
+                and kinds.test(d2.kind()) and st < IS.COMMITTED}
+
+    def witness_transitive(self, tid: TxnId) -> None:
+        if self.prune_before is not None and tid < self.prune_before:
+            return
+        if tid.kind().is_globally_visible() and tid not in self.status:
+            self.status[tid] = IS.TRANSITIVELY_KNOWN
+            self.exec_at[tid] = tid
+            self._notify_insert(tid, IS.TRANSITIVELY_KNOWN)
+
+    def remove(self, tid: TxnId) -> None:
+        if tid in self.status:
+            if IS.COMMITTED <= self.status[tid] <= IS.APPLIED \
+                    and tid.kind().is_write():
+                self.pivots.remove(self.exec_at[tid])
+            del self.status[tid]
+            del self.exec_at[tid]
+            self.missing.pop(tid, None)
+
+    def set_prune_before(self, tid: TxnId) -> None:
+        if self.prune_before is None or tid > self.prune_before:
+            self.prune_before = tid
+
+    def prune(self) -> None:
+        if self.prune_before is None:
+            return
+        dropped = [t for t in self.status if t < self.prune_before]
+        for t in dropped:
+            del self.status[t]
+            del self.exec_at[t]
+            self.missing.pop(t, None)
+            self._elide(t)
+        self.pivots = [self.exec_at[t] for t, st in self.status.items()
+                       if IS.COMMITTED <= st <= IS.APPLIED
+                       and t.kind().is_write()]
+
+    # -- derived views -------------------------------------------------------
+    def n_unwitnessable(self) -> int:
+        return sum(1 for st in self.status.values()
+                   if st in (IS.TRANSITIVELY_KNOWN, IS.INVALIDATED))
+
+    def pivot_before(self, bound: Timestamp) -> Optional[Timestamp]:
+        below = [p for p in self.pivots if p < bound]
+        return max(below) if below else None
+
+    def active_scan(self, bound: Timestamp, witnesses: Kinds) -> List[TxnId]:
+        pivot = self.pivot_before(bound)
+        out = []
+        for t in sorted(self.status):
+            st = self.status[t]
+            if t >= bound:
+                continue
+            if self.prune_before is not None and t < self.prune_before:
+                continue
+            if st in (IS.TRANSITIVELY_KNOWN, IS.INVALIDATED):
+                continue
+            if not witnesses.test(t.kind()):
+                continue
+            if IS.COMMITTED <= st <= IS.APPLIED and pivot is not None \
+                    and self.exec_at[t] < pivot:
+                continue   # reached transitively through the pivot write
+            out.append(t)
+        return out
+
+    def full_scan(self, witnesses: Kinds) -> List[TxnId]:
+        return [t for t in sorted(self.status)
+                if witnesses.test(t.kind())]
+
+
+# ---------------------------------------------------------------------------
+# interleaving replay + reconciliation
+# ---------------------------------------------------------------------------
+
+def _deps_of(mask: int, with_fence: bool, pool) -> List[TxnId]:
+    deps = [pool[j] for j in range(len(pool)) if (mask >> j) & 1]
+    if with_fence:
+        deps.append(FENCE)
+    return deps
+
+
+def replay(case: CFKCase) -> Tuple[CommandsForKey, Oracle]:
+    pool = _pool()
+    cfk = CommandsForKey(7)
+    model = Oracle()
+
+    def both(fn_cfk, fn_model):
+        fn_cfk()
+        fn_model()
+
+    for op in case.ops:
+        kind, i = op[0], op[1]
+        tid = pool[i]
+        if kind == "new":
+            both(lambda: cfk.update(tid, IS.PREACCEPTED),
+                 lambda: model.update(tid, IS.PREACCEPTED))
+        elif kind in ("accept", "commit"):
+            _k, _i, delta, mask, fence = op
+            to = IS.ACCEPTED if kind == "accept" else IS.COMMITTED
+            ex = _ts(POOL_HLCS[i] + delta, tid.node)
+            deps = _deps_of(mask, fence, pool)
+            both(lambda: cfk.update(tid, to, ex, witnessed_deps=deps),
+                 lambda: model.update(tid, to, ex, deps=deps))
+        elif kind == "advance":
+            to = IS[op[2]]
+            both(lambda: cfk.update(tid, to),
+                 lambda: model.update(tid, to))
+        elif kind == "invalidate":
+            both(lambda: cfk.update(tid, IS.INVALIDATED),
+                 lambda: model.update(tid, IS.INVALIDATED))
+        elif kind == "transitive":
+            both(lambda: cfk.witness_transitive(tid),
+                 lambda: model.witness_transitive(tid))
+        elif kind == "late_accept":
+            ex = _ts(op[2] + 1, tid.node)
+            both(lambda: cfk.update(tid, IS.ACCEPTED, ex),
+                 lambda: model.update(tid, IS.ACCEPTED, ex))
+        elif kind == "prune":
+            both(lambda: (cfk.set_prune_before(tid), cfk.prune()),
+                 lambda: (model.set_prune_before(tid), model.prune()))
+        elif kind == "remove":
+            both(lambda: cfk.remove(tid), lambda: model.remove(tid))
+        else:
+            raise AssertionError(f"unknown op {op}")
+    return cfk, model
+
+
+def check_case(case: CFKCase) -> None:
+    cfk, model = replay(case)
+    pool = _pool()
+
+    # membership + per-entry state
+    assert cfk.txn_ids() == sorted(model.status), \
+        f"membership: {cfk.txn_ids()} != {sorted(model.status)}"
+    for t in model.status:
+        info = cfk.get(t)
+        assert info.status == model.status[t], \
+            f"{t}: status {info.status} != {model.status[t]}"
+        assert info.execute_at == model.exec_at[t], \
+            f"{t}: executeAt {info.execute_at} != {model.exec_at[t]}"
+
+    # the missing[] divergence arrays, exactly
+    for t in model.status:
+        info = cfk.get(t)
+        frozen = t in model.missing
+        assert (info.missing is not None) == frozen, \
+            f"{t}: frozen mismatch (impl {info.missing}, model {frozen})"
+        if frozen:
+            assert sorted(info.missing) == sorted(model.missing[t]), (
+                f"{t}: missing[] {sorted(info.missing)} != "
+                f"{sorted(model.missing[t])}")
+            # ... and the API view over it
+            for d in pool:
+                got = info.witnesses_id(d)
+                if d > t:
+                    assert got is None
+                else:
+                    assert got == (d not in model.missing[t]), (t, d, got)
+
+    # elision inputs: pivot list + unwitnessable count
+    assert cfk._committed_write_execs == sorted(model.pivots), (
+        f"pivots: {cfk._committed_write_execs} != {sorted(model.pivots)}")
+    assert cfk._n_unwitnessable == model.n_unwitnessable()
+
+    # active scan: exact equality at several bounds x querying kinds
+    bounds = [_ts(95), _ts(100 + 10 * 7 + 5), _ts(10_000)]
+    for bound in bounds:
+        for witnesses in (TxnKind.Write.witnesses(),
+                          TxnKind.Read.witnesses(),
+                          TxnKind.SyncPoint.witnesses()):
+            got = cfk.map_reduce_active(bound, witnesses,
+                                        lambda t, acc: acc + [t], [])
+            want = model.active_scan(bound, witnesses)
+            assert got == want, (
+                f"active scan @ {bound} {witnesses}: {got} != {want}")
+        assert cfk.max_committed_write_before(bound) == \
+            model.pivot_before(bound)
+
+    # recovery's full scan visibility
+    for witnesses in (TxnKind.Write.witnessed_by(),
+                      TxnKind.Read.witnessed_by()):
+        got = cfk.map_reduce_full(None, witnesses,
+                                  lambda info, acc: acc + [info.txn_id], [])
+        assert got == model.full_scan(witnesses)
+
+    # the fence never entered the key index
+    assert cfk.get(FENCE) is None and FENCE not in model.status
+
+
+# ---------------------------------------------------------------------------
+# the sweeps
+# ---------------------------------------------------------------------------
+
+def test_cfk_sweep():
+    """Tier-1 deterministic subset of the CFK lifecycle sweep."""
+    ran = run_property(case_budget(150), BASE_SEED, make_case, check_case,
+                       shrink_candidates, replay_hint=REPLAY_HINT)
+    assert ran >= 1
+
+
+@pytest.mark.slow
+def test_cfk_sweep_big():
+    """The full >=500-interleaving sweep (ISSUE acceptance bar)."""
+    ran = run_property(max(500, case_budget(500)), BASE_SEED + 1,
+                       make_case, check_case, shrink_candidates,
+                       replay_hint=REPLAY_HINT)
+    assert ran >= 500 or case_budget(500) < 500
+
+
+# ---------------------------------------------------------------------------
+# scripted pins for the nastiest interleaving shapes the sweep drives
+# ---------------------------------------------------------------------------
+
+def test_prune_vs_late_transitive_witness_race():
+    """A transitive witness arriving BELOW the prune watermark must not
+    resurrect the pruned id — and must not reappear in any frozen
+    missing[] (it is durable-applied everywhere by the watermark
+    contract)."""
+    case = CFKCase(ops=(
+        ("new", 0), ("new", 4),
+        ("commit", 4, 5, 0b00001, False),    # 4 froze witnessing d0
+        ("prune", 3),                        # watermark above d0
+        ("transitive", 0),                   # late witness below watermark
+        ("commit", 6, 2, 0b00000, False),
+    ))
+    check_case(case)
+    cfk, model = replay(case)
+    pool = _pool()
+    assert cfk.get(pool[0]) is None          # never resurrected
+
+
+def test_freeze_then_late_insert_is_provably_unwitnessed():
+    case = CFKCase(ops=(
+        ("commit", 6, 3, 0b0, False),        # 6 freezes with no deps
+        ("new", 1),                          # arrives after the freeze
+        ("new", 8),                          # later id: untouched
+    ))
+    check_case(case)
+    cfk, _ = replay(case)
+    pool = _pool()
+    assert cfk.get(pool[6]).witnesses_id(pool[1]) is False
+
+
+def test_invalidate_after_commit_retracts_elision_pivot():
+    case = CFKCase(ops=(
+        ("new", 0),
+        ("commit", 6, 3, 0b1, False),
+        ("invalidate", 6),                   # stale pivot must retract
+        ("new", 9),
+    ))
+    check_case(case)
+
+
+def test_refreeze_under_higher_ballot_last_proposal_wins():
+    case = CFKCase(ops=(
+        ("new", 0), ("new", 1),
+        ("accept", 6, 3, 0b01, False),       # witnesses d0 only
+        ("accept", 6, 9, 0b10, False),       # re-proposal witnesses d1 only
+    ))
+    check_case(case)
+    cfk, _ = replay(case)
+    pool = _pool()
+    assert cfk.get(pool[6]).witnesses_id(pool[0]) is False
+    assert cfk.get(pool[6]).witnesses_id(pool[1]) is True

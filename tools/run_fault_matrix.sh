@@ -22,6 +22,13 @@
 # determinism, plus a seeded crash-point truncation sweep asserting
 # recovery == replay of the surviving prefix.  ACCORD_TPU_FAULT_MATRIX=disk
 # runs it alone.
+# r14 adds the recovery-under-chaos leg: the burn's recovery nemesis
+# (coordinator kill mid-recovery / partition-heal around the recovery
+# quorum / concurrent-recoverer ballot races) x 3 seeds, each double-run —
+# every leg must converge with zero unresolved ops and replay
+# byte-identically (stats + span + flight exports), and the composed
+# nemesis+device-fault run must keep the degradation ladder
+# protocol-invisible.  ACCORD_TPU_FAULT_MATRIX=recovery runs it alone.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -36,6 +43,84 @@ run_disk_leg() {
 
 if [ "$HALF" = "disk" ]; then
     run_disk_leg
+    exit $?
+fi
+
+run_recovery_leg() {
+    echo ""
+    echo "== recovery-under-chaos nemesis legs (burn, 3 seeds, double-run) =="
+    env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        python - <<'PY'
+import json
+import os
+import sys
+
+from accord_tpu.sim.burn import run_burn
+
+SEEDS = (0, 5, 11)
+N_OPS = 60
+OUT_DIR = os.environ.get("FAULT_MATRIX_OUT", "/tmp")
+
+
+def dump_postmortem(seed, problems, runs):
+    bundle = {"seed": seed, "leg": "recovery_nemesis", "problems": problems,
+              "runs": {}}
+    for tag, r in runs.items():
+        bundle["runs"][tag] = {
+            "stats": dict(r.stats),
+            "recoveries": dict(r.recoveries),
+            "nemesis": dict(r.nemesis),
+            "metrics_snapshot": r.metrics_snapshot,
+            "flight": json.loads(r.flight_export)
+            if r.flight_export else None,
+            "spans": json.loads(r.span_export) if r.span_export else None,
+        }
+    os.makedirs(OUT_DIR, exist_ok=True)
+    path = os.path.join(OUT_DIR, f"fault_matrix_{seed}_recovery.json")
+    with open(path, "w") as f:
+        json.dump(bundle, f, sort_keys=True, indent=1)
+    return path
+
+
+failures = []
+for seed in SEEDS:
+    a = run_burn(seed, n_ops=N_OPS, recovery_nemesis=True)
+    b = run_burn(seed, n_ops=N_OPS, recovery_nemesis=True)
+    line = (f"seed {seed} recovery: ok={a.ops_ok} "
+            f"unresolved={a.ops_unresolved} nemesis={dict(a.nemesis)} "
+            f"recoveries={dict(a.recoveries)}")
+    problems = []
+    if a.stats != b.stats:
+        diff = {k for k in set(a.stats) | set(b.stats)
+                if a.stats.get(k) != b.stats.get(k)}
+        problems.append(f"NONDETERMINISTIC: {sorted(diff)[:6]}")
+    if a.span_export != b.span_export:
+        problems.append("span export diverged across the double run")
+    if a.flight_export != b.flight_export:
+        problems.append("flight export diverged across the double run")
+    if a.ops_unresolved:
+        problems.append(f"{a.ops_unresolved} ops unresolved")
+    if sum(a.nemesis.values()) == 0:
+        problems.append("nemesis never fired")
+    if problems:
+        failures.append(f"seed {seed}: " + "; ".join(problems))
+        path = dump_postmortem(seed, problems, {"a": a, "b": b})
+        line += "  <-- " + "; ".join(problems) + f"  [post-mortem: {path}]"
+    print(line, flush=True)
+
+if failures:
+    print("\nRECOVERY NEMESIS LEG FAILED:")
+    for f in failures:
+        print("  " + f)
+    sys.exit(1)
+print("recovery nemesis legs clean: every seed converged, deterministic, "
+      "exports byte-identical")
+PY
+}
+
+if [ "$HALF" = "recovery" ]; then
+    run_recovery_leg
     exit $?
 fi
 
@@ -142,19 +227,21 @@ PY
 
 net_rc=0
 disk_rc=0
+recovery_rc=0
 if [ "$HALF" != "device" ]; then
     run_net_leg || net_rc=$?
     run_disk_leg || disk_rc=$?
+    run_recovery_leg || recovery_rc=$?
 fi
 
-if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ] || [ "$disk_rc" -ne 0 ]; then
+if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ] || [ "$disk_rc" -ne 0 ] || [ "$recovery_rc" -ne 0 ]; then
     echo ""
-    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc, disk rc=$disk_rc)"
+    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc, disk rc=$disk_rc, recovery rc=$recovery_rc)"
     exit 1
 fi
 echo ""
 if [ "$HALF" = "device" ]; then
-    echo "device fault matrix clean (network/disk halves skipped: ACCORD_TPU_FAULT_MATRIX=device)"
+    echo "device fault matrix clean (network/disk/recovery legs skipped: ACCORD_TPU_FAULT_MATRIX=device)"
 else
-    echo "full fault matrix clean (device + network + storage boundary)"
+    echo "full fault matrix clean (device + network + storage + recovery)"
 fi
