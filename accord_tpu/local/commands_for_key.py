@@ -99,7 +99,8 @@ class CommandsForKey:
     TxnId, with a parallel executeAt-ordered view of committed txns."""
 
     __slots__ = ("token", "_ids", "_infos", "prune_before",
-                 "_committed_write_execs", "_n_unwitnessable")
+                 "_committed_write_execs", "_n_unwitnessable",
+                 "_elide_version", "_packed_cw")
 
     def __init__(self, token: int):
         self.token = token
@@ -117,6 +118,41 @@ class CommandsForKey:
         # can elide — the batched device attribution skips per-dep elision
         # lookups wholesale (see can_elide)
         self._n_unwitnessable = 0
+        # monotone counter of _committed_write_execs CONTENT mutations —
+        # keys the packed-pivot-array cache the device/host batch elision
+        # consumes.  A length-based key is NOT sound: a decided write's
+        # executeAt moving (r14 find) keeps the length while changing the
+        # pivot content
+        self._elide_version = 0
+        self._packed_cw = None   # (_elide_version, (msb, lsb, node) i64/i32)
+
+    def _cw_mutated(self) -> None:
+        self._elide_version += 1
+        self._packed_cw = None
+
+    def packed_committed_execs(self):
+        """The elision pivot list as three numpy columns (msb, lsb int64;
+        node int32), ascending in the SAME order the Timestamp objects
+        sort (unsigned on the packed words) — the per-key building block
+        of the batched elision index (device_index._attr_elide_index).
+        Cached per _elide_version; rebuild is O(n) over a per-key list."""
+        import numpy as np
+
+        from ..ops.packing import to_i64
+        hit = self._packed_cw
+        if hit is not None and hit[0] == self._elide_version:
+            return hit[1]
+        n = len(self._committed_write_execs)
+        m = np.empty(n, np.int64)
+        l = np.empty(n, np.int64)
+        nd = np.empty(n, np.int32)
+        for i, ts in enumerate(self._committed_write_execs):
+            m[i] = to_i64(ts.msb)
+            l[i] = to_i64(ts.lsb)
+            nd[i] = ts.node
+        packed = (m, l, nd)
+        self._packed_cw = (self._elide_version, packed)
+        return packed
 
     # -- update path --------------------------------------------------------
     def update(self, txn_id: TxnId, status: InternalStatus,
@@ -140,6 +176,7 @@ class CommandsForKey:
             if InternalStatus.COMMITTED <= status <= InternalStatus.APPLIED \
                     and txn_id.kind().is_write():
                 bisect.insort(self._committed_write_execs, info.execute_at)
+                self._cw_mutated()
         else:
             prev = info.status
             info.status = max(info.status, status)   # never regress
@@ -170,6 +207,7 @@ class CommandsForKey:
                             and self._committed_write_execs[i] == info.execute_at:
                         del self._committed_write_execs[i]
                     bisect.insort(self._committed_write_execs, execute_at)
+                    self._cw_mutated()
                 info.execute_at = execute_at
             if info.status is InternalStatus.INVALIDATED \
                     and InternalStatus.COMMITTED <= prev <= InternalStatus.APPLIED \
@@ -182,6 +220,7 @@ class CommandsForKey:
                 if i < len(self._committed_write_execs) \
                         and self._committed_write_execs[i] == info.execute_at:
                     del self._committed_write_execs[i]
+                    self._cw_mutated()
             if prev < InternalStatus.COMMITTED and (
                     info.status >= InternalStatus.COMMITTED):
                 # decided: elide from every missing array — recovery of a
@@ -191,6 +230,7 @@ class CommandsForKey:
                 if info.status is not InternalStatus.INVALIDATED \
                         and txn_id.kind().is_write():
                     bisect.insort(self._committed_write_execs, info.execute_at)
+                    self._cw_mutated()
         if witnessed_deps is not None:
             # (re)freeze: a higher-ballot accept or the commit may carry a
             # different proposal — last-wins, recomputed vs the collection
@@ -282,6 +322,7 @@ class CommandsForKey:
                 if i < len(self._committed_write_execs) \
                         and self._committed_write_execs[i] == info.execute_at:
                     del self._committed_write_execs[i]
+                    self._cw_mutated()
             del self._infos[txn_id]
             i = bisect.bisect_left(self._ids, txn_id)
             if i < len(self._ids) and self._ids[i] == txn_id:
@@ -314,6 +355,7 @@ class CommandsForKey:
             info.execute_at for info in self._infos.values()
             if InternalStatus.COMMITTED <= info.status <= InternalStatus.APPLIED
             and info.txn_id.kind().is_write())
+        self._cw_mutated()
         self._n_unwitnessable = sum(
             1 for info in self._infos.values()
             if info.status in (InternalStatus.TRANSITIVELY_KNOWN,

@@ -140,6 +140,23 @@ def _scatter_rows(table: dk.DepsTable, idx, msb, lsb, node, kind, status,
         table.hi.at[idx].set(hi))
 
 
+@jax.jit
+def _scatter_attr_rows(attr, idx, dom, status, dmsb, dlsb, dnode, emsb,
+                       elsb, enode, eknown):
+    """One fused dirty-row update for the attribution columns (the
+    AttrCols sibling of _scatter_rows)."""
+    return dk.AttrCols(
+        attr.dom.at[idx].set(dom),
+        attr.status.at[idx].set(status),
+        attr.dmsb.at[idx].set(dmsb),
+        attr.dlsb.at[idx].set(dlsb),
+        attr.dnode.at[idx].set(dnode),
+        attr.emsb.at[idx].set(emsb),
+        attr.elsb.at[idx].set(elsb),
+        attr.enode.at[idx].set(enode),
+        attr.eknown.at[idx].set(eknown))
+
+
 _PZ = None
 
 
@@ -158,6 +175,22 @@ def _grow(arr: np.ndarray, new_len: int, fill) -> np.ndarray:
     out = np.full((new_len,) + arr.shape[1:], fill, dtype=arr.dtype)
     out[: arr.shape[0]] = arr
     return out
+
+
+_FETCH_POOL = None
+
+
+def _fetch_pool():
+    """Shared two-worker pool for the two-stage download prefetch: the
+    pipelined path keeps at most two flushes in flight, and spawning a
+    fresh thread per flush measured ~2ms/batch of pure start_new_thread
+    on the 2-core box — a fifth of the whole headline batch budget."""
+    global _FETCH_POOL
+    if _FETCH_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _FETCH_POOL = ThreadPoolExecutor(max_workers=2,
+                                         thread_name_prefix="accord-fetch")
+    return _FETCH_POOL
 
 
 def _prefix_len(maxtot: int, s: int) -> int:
@@ -181,15 +214,17 @@ def _fetch_entry_prefix(ent_dev, d: int, s: int, maxtot: int) -> np.ndarray:
 
 
 def _decode_triples(hdr: np.ndarray, ent: np.ndarray, nq: int,
-                    shard_n: int, global_ids: bool, mq: int, q_m: int):
+                    shard_n: int, global_ids: bool, mq: int, q_m: int,
+                    hoff: int = 2):
     """Vectorized parse of a (possibly multi-shard) exact CSR download:
     one concatenate/gather over the stacked shard headers replaces the
     per-shard Python parse loop.  Returns per-TRIPLE arrays
     (b, slot, dep_col, q_col); slot indices are shard-local for the
     slot-sharded kernels (offset by the shard's slice here) and GLOBAL
-    for the bucket-indexed kernels (codes embed global slot ids)."""
+    for the bucket-indexed kernels (codes embed global slot ids).
+    ``hoff`` is the header's scalar prefix length (2 raw, 5 attributed)."""
     d = hdr.shape[0]
-    counts = np.diff(hdr[:, 2:].astype(np.int64), prepend=0, axis=1)
+    counts = np.diff(hdr[:, hoff:].astype(np.int64), prepend=0, axis=1)
     totals = hdr[:, 0].astype(np.int64)
     b = np.repeat(np.tile(np.arange(nq, dtype=np.int64), d),
                   counts.reshape(-1))
@@ -352,6 +387,20 @@ class _DepsMirror:
         self._fstats = None                       # cached floor stats
         self._hidx = None                         # cached host-route index
         self._hidx_key = None
+        # -- device attribution columns (r15): domain / fresh status /
+        # decided executeAt, scatter-updated alongside the slot table so
+        # the ATTRIBUTED kernels can apply elision in-kernel.  They get
+        # their own dirty set and version: unlike the dep mask, the
+        # attribution pass DOES observe live->live status moves and
+        # executeAt writes, so the sharded (full-reupload) caches key on
+        # ``attr_version``, not ``version``
+        self.attr_version = 0
+        self._attr_dirty: Set[int] = set()
+        self._attr_dev = None                     # dk.AttrCols (1 device)
+        self._attr_repl = None                    # replicated under a mesh
+        self._attr_repl_key = None
+        self._attr_sh = None                      # slot-sharded under a mesh
+        self._attr_sh_key = None
 
     # -- bucket index maintenance -------------------------------------------
     def bucket_keff(self) -> int:
@@ -584,6 +633,7 @@ class _DepsMirror:
         self.lo[slot] = dk.PAD_LO
         self.hi[slot] = dk.PAD_HI
         self._dirty.add(slot)
+        self._mark_attr(slot)
         self.version += 1
         self.mut_version += 1
         self.n_live += 1
@@ -604,6 +654,7 @@ class _DepsMirror:
         self.hi[slot] = dk.PAD_HI
         self.free_slots.append(slot)
         self._dirty.add(slot)
+        self._mark_attr(slot)
         self.version += 1
         self.mut_version += 1
 
@@ -633,6 +684,10 @@ class _DepsMirror:
         self._snap = None
         self._device = None  # shape changed: full re-upload
         self._device_sh = None
+        self._attr_dev = None
+        self._attr_repl = None
+        self._attr_sh = None
+        self.attr_version += 1
 
     def _grow_intervals(self) -> None:
         new_m = self.max_intervals * 2
@@ -690,7 +745,88 @@ class _DepsMirror:
                 self.version += 1
             self.status[slot] = status
             self._dirty.add(slot)
+            self._mark_attr(slot)
             self.mut_version += 1
+
+    # -- device attribution columns (r15) -----------------------------------
+    def _mark_attr(self, slot: int) -> None:
+        self._attr_dirty.add(slot)
+        self.attr_version += 1
+
+    def mark_exec(self, slot: int) -> None:
+        """An executeAt landed on ``slot`` (emsb/elsb/enode/eknown written
+        by DeviceState._advance_status): the device attribution columns
+        must see it before the next attributed launch."""
+        self._attr_dirty.add(slot)
+        self.attr_version += 1
+        self.mut_version += 1   # snapshot columns changed too
+
+    def _attr_host_cols(self):
+        return (self.domain.astype(np.int32), self.status,
+                self.msb, self.lsb, self.node,
+                self.emsb, self.elsb, self.enode, self.eknown)
+
+    def device_attr_cols(self) -> "dk.AttrCols":
+        """Single-device attribution columns, dirty-row scatter-updated in
+        lockstep with device_table()."""
+        if self._attr_dev is None or self._attr_dirty:
+            faults.check("transfer", "attr column upload")
+        if self._attr_dev is None:
+            self._attr_dev = dk.AttrCols(
+                *(jnp.asarray(a) for a in self._attr_host_cols()))
+            self._attr_dirty.clear()
+        elif self._attr_dirty:
+            rows = np.array(sorted(self._attr_dirty), np.int32)
+            if len(rows) * 2 >= self.capacity:
+                self._attr_dev = None
+                return self.device_attr_cols()
+            padded = _pow2_at_least(len(rows), 8)
+            rows = np.concatenate([rows, np.full(padded - len(rows),
+                                                 rows[-1], np.int32)])
+            idx = jnp.asarray(rows)
+            host = self._attr_host_cols()
+            self._attr_dev = _scatter_attr_rows(
+                self._attr_dev, idx, *(a[rows] for a in host))
+            self._attr_dirty.clear()
+        return self._attr_dev
+
+    def device_attr_cols_replicated(self, mesh) -> "dk.AttrCols":
+        """Fully-replicated attribution columns for the mesh-sharded
+        BUCKETED kernel (entries carry global slot ids, so every shard
+        grades every slot).  Keyed on attr_version: any status/executeAt
+        write re-replicates — these columns are O(N) scalars, small next
+        to the interval table the mesh exists to split."""
+        key = (self.attr_version, self.capacity,
+               tuple(dev.id for dev in mesh.devices.flat))
+        if self._attr_repl is not None and self._attr_repl_key == key:
+            return self._attr_repl
+        faults.check("transfer", "attr replicated upload")
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        sr = NamedSharding(mesh, P())
+        self._attr_repl = dk.AttrCols(
+            *(jax.device_put(a, sr) for a in self._attr_host_cols()))
+        self._attr_repl_key = key
+        return self._attr_repl
+
+    def device_attr_cols_sharded(self, mesh) -> "dk.AttrCols":
+        """Slot-sharded attribution columns for the mesh-sharded DENSE
+        kernel (each shard grades only its own slice), keyed on
+        attr_version (NOT ``version``: elision observes live->live status
+        moves and executeAt writes the dep mask never reads)."""
+        key = (self.attr_version, self.capacity,
+               tuple(dev.id for dev in mesh.devices.flat))
+        if self._attr_sh is not None and self._attr_sh_key == key:
+            return self._attr_sh
+        faults.check("transfer", "attr sharded upload")
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.sharded import STORE_AXIS
+        s1 = NamedSharding(mesh, P(STORE_AXIS))
+        self._attr_sh = dk.AttrCols(
+            *(jax.device_put(a, s1) for a in self._attr_host_cols()))
+        self._attr_sh_key = key
+        return self._attr_sh
 
     # -- host route (the third dispatch target; see module docstring) -------
     def _above_floor_mask(self, floor_id) -> np.ndarray:
@@ -760,7 +896,7 @@ class _DepsMirror:
         return self._hidx
 
     def host_pairs(self, qnp: np.ndarray, q_m: int, floor_id,
-                   snapshot=None):
+                   snapshot=None, entries: bool = False):
         """The host route's candidate generation: (b_idx, j_idx) pairs
         satisfying the EXACT kernel predicate (liveness + floor structurally
         via the index; witness / earlier / not-self as vectorized compares
@@ -792,6 +928,16 @@ class _DepsMirror:
         lo = qnp[:, 7:7 + q_m]
         hi = qnp[:, 7 + q_m:7 + 2 * q_m]
         used = lo <= hi
+        # duplicate query intervals (same (lo, hi) as an earlier column of
+        # the same row) probe identical slices and emit identical entries
+        # the finalize would dedupe anyway — drop them at the probe (the
+        # kernels' first-q dedupe is the device analogue)
+        for m_i_ in range(1, q_m):
+            dup = np.zeros(qnp.shape[0], bool)
+            for m_j_ in range(m_i_):
+                dup |= ((lo[:, m_i_] == lo[:, m_j_])
+                        & (hi[:, m_i_] == hi[:, m_j_]) & used[:, m_j_])
+            used[:, m_i_] &= ~dup
         qi, mi = np.nonzero(used)
         flo = lo[qi, mi]
         fhi = hi[qi, mi]
@@ -808,9 +954,11 @@ class _DepsMirror:
             tot = int(cnt.sum())
             if tot:
                 owner = np.repeat(np.arange(len(qi)), cnt)
-                starts = np.repeat(l, cnt)
-                offs = np.arange(tot) - np.repeat(np.cumsum(cnt) - cnt, cnt)
-                pos = starts + offs
+                # pos = per-probe slice start + within-slice offset, with
+                # ONE repeat: arange(tot) already walks each slice 0..cnt
+                # after subtracting the repeated running base
+                pos = np.arange(tot) + np.repeat(l - (np.cumsum(cnt) - cnt),
+                                                 cnt)
                 parts_b.append(qi[owner])
                 parts_j.append(pslot[pos])
                 parts_m.append(pcol[pos])
@@ -824,6 +972,8 @@ class _DepsMirror:
             parts_q.append(mi[ii])
         empty = (np.zeros(0, np.int64), np.zeros(0, np.int64))
         if not parts_b:
+            if entries:
+                return (np.zeros(0, np.int64),) * 4
             return empty + ((np.zeros(0, np.int64),) * 3,)
         cb = np.concatenate(parts_b).astype(np.int64)
         cj = np.concatenate(parts_j).astype(np.int64)
@@ -831,14 +981,20 @@ class _DepsMirror:
         cq = np.concatenate(parts_q).astype(np.int64)
         em, el, en = s_msb[cj], s_lsb[cj], s_node[cj]
         keep = (qnp[cb, 3] >> s_kind[cj]) & 1 > 0
-        uem, ubm = em.astype(np.uint64), qnp[cb, 0].astype(np.uint64)
-        uel, ubl = el.astype(np.uint64), qnp[cb, 1].astype(np.uint64)
+        uem, ubm = em.view(np.uint64), qnp[cb, 0].view(np.uint64)
+        uel, ubl = el.view(np.uint64), qnp[cb, 1].view(np.uint64)
         bn = qnp[cb, 2]
         keep &= ((uem < ubm) | ((uem == ubm)
                                & ((uel < ubl) | ((uel == ubl) & (en < bn)))))
         keep &= ~((em == qnp[cb, 4]) & (el == qnp[cb, 5])
                   & (en == qnp[cb, 6]))
-        cb, cj, cm, cq = cb[keep], cj[keep], cm[keep], cq[keep]
+        if not keep.all():
+            cb, cj, cm, cq = cb[keep], cj[keep], cm[keep], cq[keep]
+        if entries:
+            # the attributed paths consume per-ENTRY arrays directly —
+            # skip the (query, slot) pair compression (one 1-D sort of
+            # the whole emit set) the legacy pair API pays
+            return cb, cj, cm, cq
         pair, p_i = np.unique(cb * np.int64(cap) + cj,
                               return_inverse=True)
         return pair // cap, pair % cap, (p_i, cm, cq)
@@ -1213,7 +1369,8 @@ def _finalize_key_batch(builders, bb, tt, trank, ntok, dkey, ndep,
         k1 = key1[o]
         first = np.ones(len(o), bool)
         first[1:] = k1[1:] != k1[:-1]
-    o = o[first]
+    if not first.all():
+        o = o[first]
     bb, tt, dkey, objs = bb[o], tt[o], dkey[o], objs[o]
     n = len(bb)
     # per-builder unique deps, ordered by packed id (== TxnId order; dkey
@@ -1319,6 +1476,204 @@ def _changed(cols, order) -> np.ndarray:
     return out
 
 
+# -- device-resident attribution index (r15) ----------------------------------
+
+def _ts_byte_keys(msb, lsb, node) -> np.ndarray:
+    """Pack (msb int64, lsb int64, node int32) columns into V20 byte keys
+    whose memcmp order IS the unsigned timestamp order (ts_lt): sign bits
+    flipped, big-endian.  One np.searchsorted over these keys replaces a
+    three-level lexicographic refinement — the host half of the in-kernel
+    rank trick (the device compares precomputed integer RANKS instead)."""
+    n = len(msb)
+    out = np.empty((n, 20), np.uint8)
+    out[:, 0:8] = (np.asarray(msb, np.int64).astype(np.uint64)
+                   ^ np.uint64(1 << 63)).astype(">u8")[:, None] \
+        .view(np.uint8).reshape(n, 8)
+    out[:, 8:16] = (np.asarray(lsb, np.int64).astype(np.uint64)
+                    ^ np.uint64(1 << 63)).astype(">u8")[:, None] \
+        .view(np.uint8).reshape(n, 8)
+    out[:, 16:20] = (np.asarray(node, np.int64).astype(np.int64)
+                     .astype(np.uint32, casting="unsafe")
+                     ^ np.uint32(1 << 31)).astype(">u4")[:, None] \
+        .view(np.uint8).reshape(n, 4)
+    return np.ascontiguousarray(out).view("V20").ravel()
+
+
+_I64_INF = np.int64(np.iinfo(np.int64).max)
+
+
+def _exact_ranks(sorted_unique: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    """Ranks of ``keys`` within ``sorted_unique`` when every key IS a
+    member: a scatter-map + gather (O(span) memory, one pass) replaces the
+    n-log-n searchsorted whenever the value span is modest — the hot-key
+    regime's tokens and the snapshot's slot ids are both dense."""
+    n = len(sorted_unique)
+    if n == 0:
+        return np.zeros(len(keys), np.int64)
+    lo = int(sorted_unique[0])
+    span = int(sorted_unique[-1]) - lo + 1
+    if span > max(4 * n, 1 << 16):
+        return np.searchsorted(sorted_unique, keys)
+    rmap = np.zeros(span, np.int64)
+    rmap[sorted_unique - lo] = np.arange(n, dtype=np.int64)
+    return rmap[keys - lo]
+
+
+class _AttrIndexHost:
+    """One store's floor + elision index, host side: the numpy arrays the
+    host route's vectorized attribution reads directly, plus pow2-padded
+    copies that upload as ops.deps_kernel.AttrIndex (padding bounds the
+    jit shape count).  Built by DeviceState._attr_index from the
+    RedundantBefore segment map and the CFK committed-write pivot lists of
+    every registry token; cached until either source's version moves."""
+
+    __slots__ = ("fbnd", "fmsb", "flsb", "fnode", "etok", "eptr",
+                 "erank", "exm", "exl", "exn", "uqkeys", "u",
+                 "pad", "_dev", "_repl", "_repl_key", "seq")
+
+    _SEQ = [0]
+
+    def __init__(self, floors, etok, eptr, exm, exl, exn):
+        # monotone build id: cache keys over index IDENTITY must never
+        # use id() (a rebuilt index can reuse a freed predecessor's
+        # address and alias a stale cache entry)
+        _AttrIndexHost._SEQ[0] += 1
+        self.seq = _AttrIndexHost._SEQ[0]
+        self.fbnd, self.fmsb, self.flsb, self.fnode = floors
+        self.etok = etok
+        self.eptr = eptr
+        self.exm, self.exl, self.exn = exm, exl, exn
+        # dense ranks over the UNIQUE exec triples: exec < bound compares
+        # become integer rank compares on device
+        keys = _ts_byte_keys(exm, exl, exn)
+        self.uqkeys = np.unique(keys)
+        self.u = len(self.uqkeys)
+        rank = np.searchsorted(self.uqkeys, keys).astype(np.int64)
+        seg = np.repeat(np.arange(len(etok), dtype=np.int64),
+                        np.diff(eptr))
+        self.erank = seg * np.int64(self.u + 1) + rank
+        # pow2-padded device images (floors pad +INF / zero rows; elidable
+        # tokens pad +INF; padded eptr segments are empty)
+        fp = _pow2_at_least(max(len(self.fbnd), 1), 1)
+        tp = _pow2_at_least(max(len(etok), 1), 1)
+        lp = _pow2_at_least(max(len(self.erank), 1), 1)
+        l_real = len(self.erank)
+
+        def tail(a, n, fill, dtype):
+            out = np.full(n, fill, dtype)
+            out[: len(a)] = a
+            return out
+
+        self.pad = (
+            tail(self.fbnd, fp, _I64_INF, np.int64),
+            tail(self.fmsb, fp + 1, 0, np.int64),
+            tail(self.flsb, fp + 1, 0, np.int64),
+            tail(self.fnode, fp + 1, 0, np.int32),
+            tail(etok, tp, _I64_INF, np.int64),
+            tail(eptr, tp + 1, l_real, np.int32),
+            tail(self.erank, lp, _I64_INF, np.int64),
+            tail(exm, lp, 0, np.int64),
+            tail(exl, lp, 0, np.int64),
+            tail(exn, lp, 0, np.int32),
+            np.int64(self.u + 1))
+        self._dev = None
+        self._repl = None
+        self._repl_key = None
+
+    def rank_bounds(self, qnp: np.ndarray) -> np.ndarray:
+        """Per-query rank of the started-before bound among the index's
+        unique committed-write executeAts — the ``rankb`` column the
+        kernels (and the host route) compare in place of 128-bit
+        timestamps."""
+        if self.u == 0:
+            return np.zeros(qnp.shape[0], np.int64)
+        keys = _ts_byte_keys(qnp[:, 0], qnp[:, 1], qnp[:, 2])
+        return np.searchsorted(self.uqkeys, keys).astype(np.int64)
+
+    def device(self) -> "dk.AttrIndex":
+        if self._dev is None:
+            faults.check("transfer", "attr index upload")
+            self._dev = dk.AttrIndex(*(jnp.asarray(a) for a in self.pad))
+        return self._dev
+
+    def device_replicated(self, mesh) -> "dk.AttrIndex":
+        key = tuple(dev.id for dev in mesh.devices.flat)
+        if self._repl is None or self._repl_key != key:
+            faults.check("transfer", "attr index upload")
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            sr = NamedSharding(mesh, P())
+            self._repl = dk.AttrIndex(
+                *(jax.device_put(a, sr) for a in self.pad))
+            self._repl_key = key
+        return self._repl
+
+    # -- host-route mirror of the in-kernel attribution predicate ---------
+    def keep_floor(self, tok, dmsb, dlsb, dnode) -> np.ndarray:
+        """Per-entry exact-floor keep mask: dep >= deps_floor(token), the
+        numpy twin of the kernel's floor leg."""
+        fi = np.searchsorted(self.fbnd, tok, side="right")
+        fm, fl, fn = self.fmsb[fi], self.flsb[fi], self.fnode[fi]
+        um, ufm = dmsb.view(np.uint64), fm.view(np.uint64)
+        ul, ufl = dlsb.view(np.uint64), fl.view(np.uint64)
+        return ((um > ufm) | ((um == ufm)
+                             & ((ul > ufl)
+                                | ((ul == ufl) & (dnode >= fn)))))
+
+    def floors_match(self, qnp: np.ndarray, q_m: int, floor_id) -> bool:
+        """True when every floor segment the batch window touches equals
+        the batch-global floor the host index already applied
+        STRUCTURALLY — the per-entry floor leg is then a no-op the host
+        route skips wholesale (the hot-key regime: one watermark over the
+        hot range)."""
+        from ..ops.packing import to_i64 as _ti
+        lo = qnp[:, 7:7 + q_m]
+        hi = qnp[:, 7 + q_m:7 + 2 * q_m]
+        used = lo <= hi
+        if not used.any():
+            return True
+        i0 = int(np.searchsorted(self.fbnd, int(lo[used].min()),
+                                 side="right"))
+        i1 = int(np.searchsorted(self.fbnd, int(hi[used].max()),
+                                 side="right"))
+        fm = self.fmsb[i0:i1 + 1]
+        fl = self.flsb[i0:i1 + 1]
+        fn = self.fnode[i0:i1 + 1]
+        if floor_id is not None and floor_id > TxnId.NONE:
+            t = (_ti(floor_id.msb), _ti(floor_id.lsb), floor_id.node)
+        else:
+            t = (0, 0, 0)
+        return bool((fm == t[0]).all() and (fl == t[1]).all()
+                    and (fn == t[2]).all())
+
+    def elide_decided(self, tok, emsb, elsb, enode, rankb_b) -> np.ndarray:
+        """Per-entry decided-elision mask for candidates ALREADY known to
+        be decided (Committed..Applied with executeAt): does a committed
+        write on the token execute strictly between the dep and the
+        bound?  The pivot search collapses to the UNIQUE (segment, bound
+        rank) composites — the hot regime has a handful of hot tokens and
+        bounds against tens of thousands of entries."""
+        t = len(self.etok)
+        seg = np.searchsorted(self.etok, tok)
+        seg_c = np.minimum(seg, t - 1)
+        seg_ok = self.etok[seg_c] == tok
+        c = seg_c.astype(np.int64) * np.int64(self.u + 1) + rankb_b
+        uc, inv = np.unique(c, return_inverse=True)
+        base_u = self.eptr[np.minimum(uc // np.int64(self.u + 1),
+                                      t - 1)].astype(np.int64)
+        cnt_u = np.searchsorted(self.erank, uc) - base_u
+        pidx_u = np.clip(base_u + cnt_u - 1, 0, max(len(self.exm) - 1, 0))
+        pm = self.exm[pidx_u][inv]
+        pl = self.exl[pidx_u][inv]
+        pn = self.exn[pidx_u][inv]
+        uem, upm = emsb.view(np.uint64), pm.view(np.uint64)
+        uel, upl = elsb.view(np.uint64), pl.view(np.uint64)
+        below = ((uem < upm) | ((uem == upm)
+                               & ((uel < upl)
+                                  | ((uel == upl) & (enode < pn)))))
+        return seg_ok & (cnt_u[inv] > 0) & below
+
+
 class DeviceState:
     """Per-CommandStore device wiring: the deps index + drain graph, kept in
     sync by the Commands transition functions."""
@@ -1381,6 +1736,20 @@ class DeviceState:
         self._floor_memo: Optional[tuple] = None
         # token -> (cfk version, may_elide_any) memo for attribution
         self._elidable_cache: Dict[int, tuple] = {}
+        # -- device-resident attribution (r15) --
+        # elision registry: tokens that ever carried a decided key-domain
+        # write (maintained by _advance_status); the batched elision index
+        # is built over exactly these tokens from the CFK truth
+        self._elide_pending: Set[int] = set()
+        self._elide_tokens = np.zeros(0, np.int64)
+        # cached elision/floor index: (signature, _AttrIndexHost)
+        self._aidx_cache = None
+        self._aidx_dev = None       # (np id of host index, dk.AttrIndex)
+        self._aidx_repl = None      # replicated under a mesh
+        # attributed-path counters (bench ``# index:`` line)
+        self.n_elided_transitive = 0
+        self.n_elided_decided = 0
+        self.attr_download_bytes = 0
         # per-kernel wall timing (SURVEY §5: structured per-kernel timing):
         # kind -> [calls, seconds]; dispatch_* covers host pack + upload +
         # enqueue, wait_* the download join, host_* the host-side passes
@@ -1463,7 +1832,20 @@ class DeviceState:
             self.deps.elsb[slot] = to_i64(execute_at.lsb)
             self.deps.enode[slot] = execute_at.node
             self.deps.eknown[slot] = True
-            self.deps.mut_version += 1   # snapshot columns changed
+            self.deps.mark_exec(slot)    # device attr columns + snapshot
+        # elision registry (r15): a decided (executeAt-known) key-domain
+        # WRITE is a potential elision pivot on each of its footprint
+        # points — record the tokens so the batched elision index knows
+        # which CommandsForKey pivot lists to include.  Superset semantics:
+        # the index build reads the CFK truth per token; a token registered
+        # here whose CFK has no committed writes simply contributes nothing
+        if dk.SLOT_COMMITTED <= new <= dk.SLOT_APPLIED \
+                and self.deps.eknown[slot] and txn_id.kind().is_write() \
+                and txn_id.domain() == Domain.Key:
+            row_lo, row_hi = self.deps.lo[slot], self.deps.hi[slot]
+            pts = row_lo[(row_lo <= row_hi) & (row_lo == row_hi)]
+            if len(pts):
+                self._elide_pending.update(int(t) for t in pts)
         if new == dk.SLOT_INVALIDATED and cur != dk.SLOT_INVALIDATED:
             # de-index: the bucket path excludes invalidated entries
             # structurally (the dense path excludes them by status)
@@ -1659,7 +2041,8 @@ class DeviceState:
         if query is None:
             return
         handle = self.deps_query_batch_begin([query], immediate=True,
-                                             prune_floors=True)
+                                             prune_floors=True,
+                                             attributed=True)
         self.deps_query_batch_end_attributed(safe, handle, [builder])
 
     def build_query(self, safe, txn_id: TxnId, keys,
@@ -1905,7 +2288,7 @@ class DeviceState:
         try:
             handle = self.deps_query_batch_begin(
                 [q for q, _b, _d in batch], immediate=True,
-                prune_floors=True)
+                prune_floors=True, attributed=True)
             self.deps_query_batch_end_attributed(
                 safe, handle, [b for _q, b, _d in batch])
         except BaseException as e:  # noqa: BLE001
@@ -1936,7 +2319,8 @@ class DeviceState:
         the exact code deps_query runs (B=1) — and what the bench times."""
         if not queries:
             return
-        handle = self.deps_query_batch_begin(queries, prune_floors=True)
+        handle = self.deps_query_batch_begin(queries, prune_floors=True,
+                                             attributed=True)
         self.deps_query_batch_end_attributed(safe, handle, builders)
 
     # below this many stragglers the bucketed path is used for narrow
@@ -1959,10 +2343,11 @@ class DeviceState:
     def set_route_calibration(cls, rtt: float, c_host: float,
                               c_dev: float,
                               rtt_mesh: Optional[float] = None,
-                              c_xfer: float = 0.0) -> None:
+                              c_xfer: float = 0.0,
+                              c_attr: float = 0.0) -> None:
         cls._CALIB = {"rtt": rtt, "c_host": c_host, "c_dev": c_dev,
                       "rtt_mesh": rtt_mesh if rtt_mesh is not None else rtt,
-                      "c_xfer": c_xfer}
+                      "c_xfer": c_xfer, "c_attr": c_attr}
 
     @staticmethod
     def _measure_route_calibration():
@@ -2035,8 +2420,47 @@ class DeviceState:
             np.asarray(buf)
             xfers.append(_time.perf_counter() - t0)
         c_xfer = max((_st.median(xfers) - rtt) / float(8 << 16), 1e-13)
+        # r15: the attributed kernels run the post-compaction attribution
+        # stage over the [s]-long entry buffer — price its per-entry-slot
+        # cost from a direct A/B of the attributed vs raw dense kernel at
+        # a wide s (the stage is O(s), so the slope IS the coefficient)
+        s_probe = 4096
+        zeros3 = (jnp.asarray(np.int64(0)), jnp.asarray(np.int64(0)),
+                  jnp.asarray(np.int32(0)))
+        attr = dk.AttrCols(jnp.zeros(cap, jnp.int32),
+                           jnp.full(cap, dk.SLOT_FREE, jnp.int32),
+                           jnp.zeros(cap, jnp.int64),
+                           jnp.zeros(cap, jnp.int64),
+                           jnp.zeros(cap, jnp.int32),
+                           jnp.zeros(cap, jnp.int64),
+                           jnp.zeros(cap, jnp.int64),
+                           jnp.zeros(cap, jnp.int32),
+                           jnp.zeros(cap, bool))
+        inf64 = np.int64(np.iinfo(np.int64).max)
+        aidx = dk.AttrIndex(jnp.full(1, inf64), jnp.zeros(2, jnp.int64),
+                            jnp.zeros(2, jnp.int64), jnp.zeros(2, jnp.int32),
+                            jnp.full(1, inf64), jnp.zeros(2, jnp.int32),
+                            jnp.full(1, inf64), jnp.zeros(1, jnp.int64),
+                            jnp.zeros(1, jnp.int64), jnp.zeros(1, jnp.int32),
+                            jnp.asarray(np.int64(1)))
+        rb0 = jnp.zeros(b, jnp.int64)
+        jax.block_until_ready(dk.calculate_deps_flat(table, qmat, m,
+                                                     s_probe, 64))
+        jax.block_until_ready(dk.calculate_deps_flat_attr(
+            table, attr, aidx, qmat, rb0, *zeros3, m, s_probe, 64))
+        t0 = _time.perf_counter()
+        for _ in range(3):
+            jax.block_until_ready(dk.calculate_deps_flat(table, qmat, m,
+                                                         s_probe, 64))
+        t_raw = (_time.perf_counter() - t0) / 3
+        t0 = _time.perf_counter()
+        for _ in range(3):
+            jax.block_until_ready(dk.calculate_deps_flat_attr(
+                table, attr, aidx, qmat, rb0, *zeros3, m, s_probe, 64))
+        t_attr = (_time.perf_counter() - t0) / 3
+        c_attr = max(t_attr - t_raw, 0.0) / s_probe
         return {"rtt": rtt, "c_dev": c_dev, "c_host": c_host,
-                "c_copy": c_copy, "c_xfer": c_xfer}
+                "c_copy": c_copy, "c_xfer": c_xfer, "c_attr": c_attr}
 
     @staticmethod
     def _measure_mesh_rtt(mesh) -> float:
@@ -2095,9 +2519,10 @@ class DeviceState:
                      - np.maximum(lo, st["tok_lo"]) + 1, 0)
         est_pt = float(np.clip(w, 0, None).sum()) * density + n_iv * 8.0
         est_host = est_pt + float(n_iv) * st["n_rng"]
-        # ~6 vectorized passes per candidate (predicate + dedupe sort),
-        # plus a fixed per-flush overhead (probe setup, unique, snapshots)
-        host_cost = calib["c_host"] * (6.0 * est_host + 50_000.0)
+        # ~5 vectorized passes per candidate (probe + attr filter + the
+        # thin finalize — r15 replaced the attribute re-sort), plus a
+        # fixed per-flush overhead (probe setup, index builds, snapshots)
+        host_cost = calib["c_host"] * (5.0 * est_host + 40_000.0)
         if self.deps._hidx_key != ((floor_id if floor_id is not None
                                     and floor_id > TxnId.NONE else None),
                                    self.deps.version):
@@ -2127,7 +2552,13 @@ class DeviceState:
             dev_elems = min(dense_elems, buck_elems)
         else:
             dev_elems = dense_elems
-        dev_cost = 2.0 * rtt + calib["c_dev"] * dev_elems
+        # the attributed launch additionally runs the post-compaction
+        # attribution stage over the learned [s] entry buffer — collect
+        # got cheaper (pre-attributed prefix), launch slightly heavier;
+        # both priced, never thresholded
+        s_attr = min(self._batch_flat, dev_elems)
+        dev_cost = 2.0 * rtt + calib["c_dev"] * dev_elems \
+            + calib.get("c_attr", 0.0) * s_attr
         return "host" if host_cost < dev_cost else "device"
 
     def _batch_floor(self, qnp: np.ndarray, q_m: int):
@@ -2154,11 +2585,130 @@ class DeviceState:
             return f, (to_i64(f.msb), to_i64(f.lsb), np.int32(f.node))
         return None, None
 
+    # ------------------------------------------------------------------
+    # device-resident attribution (r15): the per-store floor + elision
+    # index every attributed route (kernels AND host) applies
+    # ------------------------------------------------------------------
+    def _attr_index(self) -> _AttrIndexHost:
+        """Build (or reuse) the store's attribution index: the packed
+        RedundantBefore segment floors plus, per elision-registry token,
+        the CFK committed-write pivot list.  The signature folds the
+        RedundantBefore version, the registry size and the SUM of the
+        touched CFKs' monotone _elide_versions — any pivot mutation moves
+        the sum, so staleness detection is one pass of dict hits, no
+        content hashing."""
+        d = self.deps
+        if self._elide_pending:
+            new = np.fromiter(self._elide_pending, np.int64,
+                              len(self._elide_pending))
+            self._elide_pending.clear()
+            self._elide_tokens = np.union1d(self._elide_tokens, new)
+        rb = getattr(self.store, "redundant_before", None)
+        cfk_map = getattr(self.store, "commands_for_key", None) or {}
+        toks = self._elide_tokens
+        cfks = [cfk_map.get(int(t)) for t in toks]
+        vsum = 0
+        for c in cfks:
+            if c is not None:
+                vsum += c._elide_version
+        sig = (rb.version if rb is not None else -1, len(toks), vsum)
+        if self._aidx_cache is not None and self._aidx_cache[0] == sig:
+            return self._aidx_cache[1]
+        if rb is not None:
+            floors = rb.packed_floor_index()
+        else:
+            floors = (np.zeros(0, np.int64), np.zeros(1, np.int64),
+                      np.zeros(1, np.int64), np.zeros(1, np.int32))
+        packs = []
+        keep_toks = []
+        for t, c in zip(toks.tolist(), cfks):
+            if c is None:
+                continue
+            p = c.packed_committed_execs()
+            if len(p[0]):
+                packs.append(p)
+                keep_toks.append(t)
+        if packs:
+            etok = np.asarray(keep_toks, np.int64)
+            lens = np.array([len(p[0]) for p in packs], np.int64)
+            eptr = np.zeros(len(packs) + 1, np.int32)
+            np.cumsum(lens, out=eptr[1:])
+            exm = np.concatenate([p[0] for p in packs])
+            exl = np.concatenate([p[1] for p in packs])
+            exn = np.concatenate([p[2] for p in packs])
+        else:
+            etok = np.zeros(0, np.int64)
+            eptr = np.zeros(1, np.int32)
+            exm = np.zeros(0, np.int64)
+            exl = np.zeros(0, np.int64)
+            exn = np.zeros(0, np.int32)
+        aidx = _AttrIndexHost(floors, etok, eptr, exm, exl, exn)
+        self._aidx_cache = (sig, aidx)
+        return aidx
+
+    def _attr_filter_entries(self, tb, tj, tm, tq, ids, ivs, aidx,
+                             rankb, floor_skip: bool = False) -> tuple:
+        """Apply the attributed kernels' in-kernel drops to a HOST-derived
+        entry set (host route, fault fallback, shadow verify): per-token
+        floors + elision on key-domain entries, over the flush's snapshot
+        columns.  Duplicate (row, token, dep) emits survive — the shared
+        finalize dedupes, so bytes match the kernel routes that dropped
+        them in-kernel.  ``floor_skip`` (precomputed per flush by
+        floors_match) elides the whole floor leg when the exact per-token
+        floors equal the structurally-applied batch floor; the decided-
+        elision pivot search runs only over the decided subset."""
+        if len(tj) == 0:
+            return tb, tj, tm, tq, 0, 0
+        (msb_a, lsb_a, node_a, _obj, status_a, xm_a, xl_a, xn_a,
+         xk_a) = ids
+        lo, _hi, dom = ivs
+        key_dep = dom[tj] == int(Domain.Key)
+        if not key_dep.any():
+            return tb, tj, tm, tq, 0, 0
+        status = status_a[tj]
+        el_trans = key_dep & (status == dk.SLOT_TRANSITIVE)
+        tok = None
+        keep_floor = None
+        if not floor_skip:
+            tok = lo[tj, tm]
+            keep_floor = aidx.keep_floor(tok, msb_a[tj], lsb_a[tj],
+                                         node_a[tj])
+        el_dec = np.zeros(len(tj), bool)
+        if aidx.u:
+            dec = (key_dep & (status >= dk.SLOT_COMMITTED)
+                   & (status <= dk.SLOT_APPLIED) & xk_a[tj])
+            di = np.nonzero(dec)[0]
+            if len(di):
+                tji = tj[di]
+                tok_d = tok[di] if tok is not None else lo[tji, tm[di]]
+                el_dec[di] = aidx.elide_decided(
+                    tok_d, xm_a[tji], xl_a[tji], xn_a[tji], rankb[tb[di]])
+        if keep_floor is None:
+            keep = ~(el_trans | el_dec)
+            n_trans = int(el_trans.sum())
+            n_dec = int(el_dec.sum())
+        else:
+            keep = ~key_dep | (keep_floor & ~el_trans & ~el_dec)
+            n_trans = int(np.sum(keep_floor & el_trans))
+            n_dec = int(np.sum(keep_floor & ~el_trans & el_dec))
+        if keep.all():
+            return tb, tj, tm, tq, n_trans, n_dec
+        return tb[keep], tj[keep], tm[keep], tq[keep], n_trans, n_dec
+
     def deps_query_batch_begin(self, queries, immediate: bool = False,
-                               prune_floors: bool = False):
+                               prune_floors: bool = False,
+                               attributed: bool = False):
         """Dispatch a batched deps scan WITHOUT waiting: one fused query
         upload per kernel part + enqueue; returns an opaque handle for
-        deps_query_batch_end.  Callers overlap the next batch's dispatch
+        deps_query_batch_end.
+
+        ``attributed=True`` (every protocol path) dispatches the r15
+        ATTRIBUTED kernels: per-token RedundantBefore floors, elision and
+        the key dedupe run in-kernel against the device-resident
+        attribution columns + the packed floor/elision index, and the CSR
+        that comes back holds exactly the entries the builders keep — the
+        host side is a pure decode + finalize.  Mesh routes additionally
+        merge their shard blocks ON DEVICE (one replicated download).  Callers overlap the next batch's dispatch
         with the previous batch's result download (double-buffering) — on a
         tunneled accelerator the round trips dominate the kernel, so the
         pipeline nearly doubles sustained throughput.
@@ -2190,23 +2740,49 @@ class DeviceState:
             if floor_id is not None:
                 prune = (jnp.asarray(prune_np[0]), jnp.asarray(prune_np[1]),
                          jnp.asarray(prune_np[2]))
+        aidx = rankb_np = None
+        floor_skip = False
+        if attributed:
+            aidx = self._attr_index()
+            rankb_np = aidx.rank_bounds(qnp)
+            # when the exact per-token floors equal the structurally
+            # applied batch floor everywhere the batch reaches, the
+            # per-entry floor leg is provably a no-op — on the host route
+            # AND in the kernels (the mask's batch-global prune is that
+            # same floor); an empty elision index likewise drops the
+            # whole pivot leg from the traced program (static flags)
+            floor_skip = aidx.floors_match(qnp, q_m, floor_id)
+            k_floors = not floor_skip
+            k_elide = aidx.u > 0
 
         def dispatch(kind, rows, qcols=None):
             """rows: np int64 array of query indices for this part, padded
-            to a pow2 batch by repeating the last row (pads map to -1)."""
+            to a pow2 batch by repeating the last row (pads map to -1).
+            Under ``attributed`` every device kind launches its r15
+            ATTRIBUTED kernel variant (suffix ``attr_`` in the devprof
+            slices); mesh kinds come back as ONE merged replicated block
+            (d=1, entry buffer d_mesh * s)."""
             import time as _time
             _t0 = _time.perf_counter()
+            kname = ("attr_" + kind) if attributed else kind
             if kind == "host":
                 # the host route computes its (query, slot) pairs AND the
                 # exact emit triples right here — no device box, no
-                # download thread; the pairs feed the same attribution as
-                # every kernel part
-                b_h, j_h, pmq = self.deps.host_pairs(qnp, q_m, floor_id)
+                # download thread; under ``attributed`` the floor/elision
+                # drops run at collect over the same snapshot the
+                # builders read
+                if attributed:
+                    ent4 = self.deps.host_pairs(qnp, q_m, floor_id,
+                                                entries=True)
+                    parts.append({"kind": "host", "ent": ent4})
+                else:
+                    b_h, j_h, pmq = self.deps.host_pairs(qnp, q_m,
+                                                         floor_id)
+                    parts.append({"kind": "host", "b": b_h, "j": j_h,
+                                  "pmq": pmq})
                 self.n_host_queries += len(rows)
                 self.n_dispatches += 1
                 self._ktime("dispatch_host", _t0)
-                parts.append({"kind": "host", "b": b_h, "j": j_h,
-                              "pmq": pmq})
                 return
             dk.launch_check(kind)
             b_pad = _pow2_at_least(len(rows), 1)
@@ -2215,34 +2791,57 @@ class DeviceState:
             gmap = np.concatenate(
                 [rows, np.full(b_pad - len(rows), -1, np.int64)])
             m_t = self.deps.max_intervals
-            part: Dict[str, object] = {"kind": kind, "gmap": gmap,
+            part: Dict[str, object] = {"kind": kname, "gmap": gmap,
                                        "nq": b_pad, "q_m": q_m,
-                                       "mq": m_t * q_m,
+                                       "mq": m_t * q_m, "hoff": 2,
+                                       "d_ent": 1,
                                        "immediate": immediate}
+            rankb = jnp.asarray(rankb_np[rows_p]) if attributed else None
             if kind == "sharded":
                 table = self.deps.device_table_sharded(self.mesh)
                 d = int(np.prod(list(self.mesh.shape.values())))
                 n = table.capacity
-                wide = dk.wide_codes(n // d, m_t, q_m)
                 s = min(self._batch_flat, b_pad * (n // d) * m_t * q_m)
                 k = min(self._batch_k, (n // d) * m_t * q_m)
                 qmat = jnp.asarray(qnp[rows_p])
-                from ..parallel.sharded import (
-                    sharded_calculate_deps_flat,
-                    sharded_calculate_deps_flat_pruned)
                 mesh = self.mesh
+                if attributed:
+                    # merged replicated block with GLOBAL slot codes: the
+                    # cross-shard Deps.merge happens on device
+                    wide = dk.wide_codes(n, m_t, q_m)
+                    from ..parallel.sharded import sharded_flat_attr
+                    acols = self.deps.device_attr_cols_sharded(mesh)
+                    ai = aidx.device_replicated(mesh)
+                    pz = prune if prune is not None else _prune_zeros()
 
-                def relaunch(s2, k2, _m=mesh, _t=table, _q=qmat, _p=prune):
-                    if _p is not None:
-                        return sharded_calculate_deps_flat_pruned(
-                            _m, q_m, s2, k2, wide)(_t, _q, *_p)
-                    return sharded_calculate_deps_flat(
-                        _m, q_m, s2, k2, wide)(_t, _q)
+                    def relaunch(s2, k2, _m=mesh, _t=table, _q=qmat,
+                                 _a=acols, _i=ai, _r=rankb, _p=pz):
+                        return sharded_flat_attr(
+                            _m, q_m, s2, k2, wide, k_floors,
+                            k_elide)(_t, _a, _i, _q, _r, *_p)
 
+                    part.update(d=1, d_ent=d, shard_n=n, s=s, k=k,
+                                wide=wide, hoff=5, global_ids=True,
+                                s_cap=b_pad * (n // d) * m_t * q_m,
+                                k_cap=(n // d) * m_t * q_m)
+                else:
+                    wide = dk.wide_codes(n // d, m_t, q_m)
+                    from ..parallel.sharded import (
+                        sharded_calculate_deps_flat,
+                        sharded_calculate_deps_flat_pruned)
+
+                    def relaunch(s2, k2, _m=mesh, _t=table, _q=qmat,
+                                 _p=prune):
+                        if _p is not None:
+                            return sharded_calculate_deps_flat_pruned(
+                                _m, q_m, s2, k2, wide)(_t, _q, *_p)
+                        return sharded_calculate_deps_flat(
+                            _m, q_m, s2, k2, wide)(_t, _q)
+
+                    part.update(d=d, shard_n=n // d, s=s, k=k, wide=wide,
+                                s_cap=b_pad * (n // d) * m_t * q_m,
+                                k_cap=(n // d) * m_t * q_m)
                 self.n_mesh_queries += len(rows)
-                part.update(d=d, shard_n=n // d, s=s, k=k, wide=wide,
-                            s_cap=b_pad * (n // d) * m_t * q_m,
-                            k_cap=(n // d) * m_t * q_m)
             elif kind == "sharded_bucketed":
                 btable = self.deps.bucket_device_sharded(self.mesh)
                 d = int(np.prod(list(self.mesh.shape.values())))
@@ -2259,18 +2858,38 @@ class DeviceState:
                 qb = qcols[rows_p].reshape(b_pad, q_m * span)
                 qmat = jnp.asarray(np.concatenate(
                     [qnp[rows_p], qb], axis=1))
-                from ..parallel.sharded import sharded_bucketed_flat
                 pz = prune if prune is not None else _prune_zeros()
                 mesh = self.mesh
+                if attributed:
+                    from ..parallel.sharded import sharded_bucketed_attr
+                    acols = self.deps.device_attr_cols_replicated(mesh)
+                    ai = aidx.device_replicated(mesh)
+                    tsh = self.deps.device_table_sharded(mesh)
 
-                def relaunch(s2, k2, _m=mesh, _b=btable, _q=qmat, _p=pz):
-                    return sharded_bucketed_flat(
-                        _m, q_m, span, s2, k2, m_t, keff, wide)(_b, _q, *_p)
+                    def relaunch(s2, k2, _m=mesh, _b=btable, _t=tsh,
+                                 _q=qmat, _a=acols, _i=ai, _r=rankb,
+                                 _p=pz):
+                        return sharded_bucketed_attr(
+                            _m, q_m, span, s2, k2, m_t, keff, wide,
+                            k_floors, k_elide)(_b, _t, _a, _i, _q, _r,
+                                               *_p)
 
+                    part.update(d=1, d_ent=d, shard_n=c, s=s, k=k, c=c,
+                                wide=wide, hoff=5, global_ids=True,
+                                s_cap=b_pad * c, k_cap=c)
+                else:
+                    from ..parallel.sharded import sharded_bucketed_flat
+
+                    def relaunch(s2, k2, _m=mesh, _b=btable, _q=qmat,
+                                 _p=pz):
+                        return sharded_bucketed_flat(
+                            _m, q_m, span, s2, k2, m_t, keff,
+                            wide)(_b, _q, *_p)
+
+                    part.update(d=d, shard_n=c, s=s, k=k, c=c, wide=wide,
+                                global_ids=True, s_cap=b_pad * c, k_cap=c)
                 self.n_mesh_queries += len(rows)
                 self.n_mesh_bucketed_queries += len(rows)
-                part.update(d=d, shard_n=c, s=s, k=k, c=c, wide=wide,
-                            global_ids=True, s_cap=b_pad * c, k_cap=c)
             elif kind == "dense":
                 table = self.deps.device_table()
                 n = table.capacity
@@ -2278,13 +2897,25 @@ class DeviceState:
                 s = min(self._batch_flat, b_pad * n * m_t * q_m)
                 k = min(self._batch_k, n * m_t * q_m)
                 qmat = jnp.asarray(qnp[rows_p])
+                if attributed:
+                    acols = self.deps.device_attr_cols()
+                    ai = aidx.device()
+                    pz = prune if prune is not None else _prune_zeros()
 
-                def relaunch(s2, k2, _t=table, _q=qmat, _p=prune):
-                    if _p is not None:
-                        return dk.calculate_deps_flat_pruned(
-                            _t, _q, *_p, q_m, s2, k2, wide)
-                    return dk.calculate_deps_flat(_t, _q, q_m, s2, k2,
-                                                  wide)
+                    def relaunch(s2, k2, _t=table, _q=qmat, _a=acols,
+                                 _i=ai, _r=rankb, _p=pz):
+                        return dk.calculate_deps_flat_attr(
+                            _t, _a, _i, _q, _r, *_p, q_m, s2, k2, wide,
+                            k_floors, k_elide)
+
+                    part.update(hoff=5)
+                else:
+                    def relaunch(s2, k2, _t=table, _q=qmat, _p=prune):
+                        if _p is not None:
+                            return dk.calculate_deps_flat_pruned(
+                                _t, _q, *_p, q_m, s2, k2, wide)
+                        return dk.calculate_deps_flat(_t, _q, q_m, s2,
+                                                      k2, wide)
 
                 self.n_dense_queries += len(rows)
                 part.update(d=1, shard_n=n, s=s, k=k, wide=wide,
@@ -2302,16 +2933,29 @@ class DeviceState:
                 qb = qcols[rows_p].reshape(b_pad, q_m * span)
                 qmat = jnp.asarray(np.concatenate(
                     [qnp[rows_p], qb], axis=1))
+                if attributed:
+                    acols = self.deps.device_attr_cols()
+                    ai = aidx.device()
+                    pz = prune if prune is not None else _prune_zeros()
 
-                def relaunch(s2, k2, _t=table, _b=btable, _q=qmat,
-                             _p=prune):
-                    if _p is not None:
-                        return dk.bucketed_flat_pruned(
-                            _t, _b, _q, q_m, span, s2, k2, *_p,
-                            keff=keff, wide=wide)
-                    return dk.bucketed_flat_jit(_t, _b, _q, q_m, span,
-                                                s2, k2, keff=keff,
-                                                wide=wide)
+                    def relaunch(s2, k2, _t=table, _b=btable, _q=qmat,
+                                 _a=acols, _i=ai, _r=rankb, _p=pz):
+                        return dk.bucketed_attr_jit(
+                            _t, _a, _i, _b, _q, _r, q_m, span, s2, k2,
+                            _p, keff=keff, wide=wide, floors=k_floors,
+                            elide=k_elide)
+
+                    part.update(hoff=5)
+                else:
+                    def relaunch(s2, k2, _t=table, _b=btable, _q=qmat,
+                                 _p=prune):
+                        if _p is not None:
+                            return dk.bucketed_flat_pruned(
+                                _t, _b, _q, q_m, span, s2, k2, *_p,
+                                keff=keff, wide=wide)
+                        return dk.bucketed_flat_jit(_t, _b, _q, q_m, span,
+                                                    s2, k2, keff=keff,
+                                                    wide=wide)
 
                 self.n_bucketed_queries += len(rows)
                 part.update(d=1, shard_n=table.capacity, s=s, k=k, c=c,
@@ -2320,7 +2964,7 @@ class DeviceState:
             hdr_dev, ent_dev = relaunch(s, k)
             part["relaunch"] = relaunch
             self.n_dispatches += 1
-            self._ktime("dispatch_" + kind, _t0)
+            self._ktime("dispatch_" + kname, _t0)
             box: Dict[str, object] = {"hdr": hdr_dev, "ent": ent_dev}
             part["box"] = box
             if not immediate:
@@ -2332,28 +2976,27 @@ class DeviceState:
                 # on the deterministic store-task thread (_collect_part
                 # re-checks before consuming each stage)
                 d_, nq_, s_, k_ = part["d"], b_pad, s, k
+                hoff_, de_ = part["hoff"], part["d_ent"]
 
                 def _fetch():
                     import time as _time
                     try:
                         t0 = _time.perf_counter()
-                        hdr = np.asarray(hdr_dev).reshape(d_, 2 + nq_)
+                        hdr = np.asarray(hdr_dev).reshape(d_, hoff_ + nq_)
                         box["hdr_np"] = hdr
                         box["t_hdr"] = (t0, _time.perf_counter())
-                        if int(hdr[:, 0].max()) > s_ \
-                                or int(hdr[:, 1].max()) > k_:
+                        ovf_s = int(hdr[:, 1 if hoff_ == 5 else 0].max())
+                        ovf_k = int(hdr[:, 2 if hoff_ == 5 else 1].max())
+                        if ovf_s > s_ or ovf_k > k_:
                             return    # overflowed: collector re-runs
                         t1 = _time.perf_counter()
                         box["ent_np"] = _fetch_entry_prefix(
-                            ent_dev, d_, s_, int(hdr[:, 0].max()))
+                            ent_dev, d_, de_ * s_, int(hdr[:, 0].max()))
                         box["t_ent"] = (t1, _time.perf_counter())
                     except BaseException as e:     # surfaced after join
                         box["err"] = e
 
-                import threading
-                th = threading.Thread(target=_fetch, daemon=True)
-                th.start()
-                part["th"] = th
+                part["th"] = _fetch_pool().submit(_fetch)
             parts.append(part)
 
         all_rows = np.arange(nq, dtype=np.int64)
@@ -2439,8 +3082,17 @@ class DeviceState:
             # every downstream byte — is unchanged
             part = parts[0]
             d = self.deps
-            u = np.unique(part["j"])
-            part["j"] = np.searchsorted(u, part["j"])
+            if "ent" in part:
+                cb, cj, cm, cq = part["ent"]
+                flag = np.zeros(d.capacity, bool)
+                flag[cj] = True
+                u = np.nonzero(flag)[0]
+                remap = np.empty(d.capacity, np.int64)
+                remap[u] = np.arange(len(u), dtype=np.int64)
+                part["ent"] = (cb, remap[cj], cm, cq)
+            else:
+                u = np.unique(part["j"])
+                part["j"] = np.searchsorted(u, part["j"])
             ids = (d.msb[u], d.lsb[u], d.node[u], d.obj[u], d.status[u],
                    d.emsb[u], d.elsb[u], d.enode[u], d.eknown[u])
             ivs = (d.lo[u], d.hi[u], d.domain[u])
@@ -2452,7 +3104,9 @@ class DeviceState:
             # pipelined batches over an unmutated mirror share one
             ids, ivs, _kind = self.deps.snapshot_cols()
         fmeta = {"floor_id": floor_id, "probing": probing,
-                 "immediate": immediate}
+                 "immediate": immediate, "attributed": attributed,
+                 "aidx": aidx, "rankb": rankb_np,
+                 "floor_skip": floor_skip}
         return (parts, ids, ivs, qnp, q_m, list(queries), fmeta)
 
     def _bucket_query_cols(self, qnp: np.ndarray, q_m: int):
@@ -2556,32 +3210,36 @@ class DeviceState:
         th = part.get("th")
         nq, d = part["nq"], part["d"]
         s, k = part["s"], part["k"]
+        hoff, d_ent = part.get("hoff", 2), part.get("d_ent", 1)
+        attr = hoff == 5
         itemsize = 8 if part["wide"] else 4
         faults.check("transfer", "header download")
         _t0 = _time.perf_counter()
         if th is not None:
-            th.join()
+            th.result()
             err = box.get("err")
             if err is not None:
                 raise err           # the real device/transfer failure
             hdr = box["hdr_np"]
             t_h = box.get("t_hdr")
         else:
-            hdr = np.asarray(box["hdr"]).reshape(d, 2 + nq)
+            hdr = np.asarray(box["hdr"]).reshape(d, hoff + nq)
             t_h = None
         self._ktime_span("wait_header_" + part["kind"],
                          *(t_h or (_t0, _time.perf_counter())))
         self.download_bytes += hdr.nbytes
-        self.download_bytes_padded += hdr.nbytes + d * s * itemsize
+        self.download_bytes_padded += hdr.nbytes + d * d_ent * s * itemsize
         runs = 0
-        while int(hdr[:, 0].max()) > s or int(hdr[:, 1].max()) > k:
+        while int(hdr[:, 1 if attr else 0].max()) > s \
+                or int(hdr[:, 2 if attr else 1].max()) > k:
             # overflow: re-size from the exact header (shared policy,
             # _overflow_resize), then re-dispatch against the same
             # snapshot tables via the part's relaunch closure —
             # registrations interleaved between begin and end must not
             # shift the queried snapshot
             s, k = self._overflow_resize(
-                int(hdr[:, 0].max()), int(hdr[:, 1].max()), s, k,
+                int(hdr[:, 1 if attr else 0].max()),
+                int(hdr[:, 2 if attr else 1].max()), s, k,
                 part["s_cap"], part["k_cap"], runs)
             dk.launch_check(part["kind"])
             hdr_dev, ent_dev = part["relaunch"](s, k)
@@ -2589,10 +3247,11 @@ class DeviceState:
             th = None
             faults.check("transfer", "header download")
             _t0 = _time.perf_counter()
-            hdr = np.asarray(hdr_dev).reshape(d, 2 + nq)
+            hdr = np.asarray(hdr_dev).reshape(d, hoff + nq)
             self._ktime("wait_header_" + part["kind"], _t0)
             self.download_bytes += hdr.nbytes
-            self.download_bytes_padded += hdr.nbytes + d * s * itemsize
+            self.download_bytes_padded += hdr.nbytes \
+                + d * d_ent * s * itemsize
             runs += 1
         faults.check("transfer", "entry download")
         _t1 = _time.perf_counter()
@@ -2606,17 +3265,25 @@ class DeviceState:
             # fetch rides the prefetch thread and overlaps compute, so it
             # never asks
             maxtot = int(hdr[:, 0].max())
-            if self._prefix_pays(d, s, maxtot, itemsize):
-                ent = _fetch_entry_prefix(box["ent"], d, s, maxtot)
+            if self._prefix_pays(d, d_ent * s, maxtot, itemsize):
+                ent = _fetch_entry_prefix(box["ent"], d, d_ent * s, maxtot)
             else:
-                ent = np.asarray(box["ent"]).reshape(d, s)
+                ent = np.asarray(box["ent"]).reshape(d, d_ent * s)
             t_e = None
         self._ktime_span("wait_entries_" + part["kind"],
                          *(t_e or (_t1, _time.perf_counter())))
         self.download_bytes += ent.nbytes
+        if attr:
+            # the attributed header carries the in-kernel elision tallies
+            # (eknown-graded transitive rows vs decided-below-pivot rows)
+            # and the download is the post-attribution entry set
+            self.n_elided_transitive += int(hdr[:, 3].sum())
+            self.n_elided_decided += int(hdr[:, 4].sum())
+            self.attr_download_bytes += hdr.nbytes + ent.nbytes
         tb, tj, tm, tq = _decode_triples(hdr, ent, nq, part["shard_n"],
                                          bool(part.get("global_ids")),
-                                         part["mq"], part["q_m"])
+                                         part["mq"], part["q_m"],
+                                         hoff=hoff)
         # stale/corrupted-result injection: perturb the slot indices the
         # kernel answered with.  Only where the detector actually runs —
         # paranoia shadow-verify on an IMMEDIATE flush (the protocol path);
@@ -2762,10 +3429,167 @@ class DeviceState:
         msb, lsb, node = ids[0], ids[1], ids[2]
         return (row_ptr, msb[j_idx], lsb[j_idx], node[j_idx])
 
+    def _host_attr_triples(self, handle, part=None, snapshot=None):
+        """Entry-level host answer for an ATTRIBUTED flush: the host
+        route's exact probes + the same floor/elision drops the kernels
+        fold in, over the flush's snapshot columns.  Serves the host
+        route itself, the device-fault failover and the paranoia shadow.
+        Returns (tb, tj, tm, tq)."""
+        (_parts, ids, ivs, qnp, q_m, _queries, fmeta) = handle
+        if part is not None:
+            tb, tj, cm, cq = part["ent"]
+        else:
+            tb, tj, cm, cq = self.deps.host_pairs(
+                qnp, q_m, fmeta["floor_id"], snapshot=snapshot,
+                entries=True)
+        tb, tj, tm, tq, n_t, n_d = self._attr_filter_entries(
+            tb, tj, cm, cq, ids, ivs, fmeta["aidx"], fmeta["rankb"],
+            fmeta["floor_skip"])
+        self.n_elided_transitive += n_t
+        self.n_elided_decided += n_d
+        return tb, tj, tm, tq
+
+    def _batch_collect_attr(self, handle):
+        """Collect an ATTRIBUTED dispatched batch: the kernels already
+        applied floors/elision/dedupe, so the download IS the final entry
+        set and this is a pure decode.  The host route (and any device
+        failover / paranoia shadow) applies the identical drops through
+        _attr_filter_entries over the same snapshot — every route hands
+        the shared finalize the same entries.  Returns (tb, tj, tm, tq,
+        ids, ivs, qnp, q_m, queries)."""
+        (parts, ids, ivs, qnp, q_m, queries, fmeta) = handle
+        import time as _time
+        nq = len(queries)
+        if len(parts) == 1 and parts[0]["kind"] == "host":
+            _th = _time.perf_counter()
+            tb, tj, tm, tq = self._host_attr_triples(handle,
+                                                     part=parts[0])
+            self.n_queries += nq
+            self.n_kernel_deps += len(tj)
+            self._ktime("host_attr_filter", _th)
+            return tb, tj, tm, tq, ids, ivs, qnp, q_m, queries
+        try:
+            outs = [self._collect_part(p) for p in parts]
+        except faults.DEVICE_EXCEPTIONS as e:
+            self._device_fault(e, f"collect: {e}")
+            self.n_host_queries += nq
+            self.n_fallback_queries += nq
+            self.n_dispatches += 1
+            self.n_queries += nq
+            tb, tj, tm, tq = self._host_attr_triples(handle)
+            self.n_kernel_deps += len(tj)
+            return tb, tj, tm, tq, ids, ivs, qnp, q_m, queries
+        _tg = _time.perf_counter()
+        if len(outs) == 1:
+            tb, tj, tm, tq = outs[0]
+        else:
+            tb = np.concatenate([o[0] for o in outs])
+            tj = np.concatenate([o[1] for o in outs])
+            tm = np.concatenate([o[2] for o in outs])
+            tq = np.concatenate([o[3] for o in outs])
+        if self._paranoid() and fmeta["immediate"]:
+            # shadow-verify the ATTRIBUTED answer: the surviving
+            # (query, slot) pair set must equal the host route's answer
+            # run through the same floor/elision drops
+            self.n_shadow_checks += 1
+            hb, hj, hm, hq = self._host_attr_triples(handle)
+            cap = np.int64(max(self.deps.capacity, 1))
+            if not np.array_equal(np.unique(tb * cap + tj),
+                                  np.unique(hb * cap + hj)):
+                self.n_shadow_mismatches += 1
+                self._device_fault("stale_result", "attr shadow mismatch")
+                self.n_fallback_queries += nq
+                self.n_queries += nq
+                self.n_kernel_deps += len(hj)
+                return hb, hj, hm, hq, ids, ivs, qnp, q_m, queries
+        if fmeta["probing"]:
+            self._restore_device()   # the probe flush succeeded end-to-end
+        self.n_queries += nq
+        self.n_kernel_deps += len(tj)
+        self._ktime("host_decode", _tg)
+        return tb, tj, tm, tq, ids, ivs, qnp, q_m, queries
+
+    def _finalize_attr_entries(self, tb, tj, tm, tq, ids, ivs, qnp, q_m,
+                               builders) -> None:
+        """The thin shared finalize: attributed entries -> builder CSRs.
+        Every floor/elision decision already happened (in-kernel on device
+        routes, _attr_filter_entries on the host route), so what remains
+        is pure shaping: token gathers, dense id ranks, and the two
+        columnar batch finalizes.  The (query, token, dep) dedupe built
+        into _finalize_key_batch covers the duplicate emits host probes
+        keep (the kernels drop them in-kernel only to shrink the wire)."""
+        (msb_a, lsb_a, node_a, obj_a, _status, _xm, _xl, _xn, _xk) = ids
+        lo, hi, dom = ivs
+        if len(tj) == 0:
+            return
+        key_dep = dom[tj] == int(Domain.Key)
+        all_key = key_dep.all()              # the hot-key regime: skip the
+        if all_key:                          # split gathers wholesale
+            kp = None
+        else:
+            kp = np.nonzero(key_dep)[0]
+        if all_key or len(kp):
+            if all_key:
+                bb, jj, km = tb, tj, tm
+            else:
+                bb, jj, km = tb[kp], tj[kp], tm[kp]
+            tt = lo[jj, km]                  # key-domain footprint = point
+            # token ranks: when every used query interval is a POINT the
+            # emitted tokens are a subset of the query tokens — rank
+            # against that tiny sorted set instead of sorting the emits
+            # (extra never-emitted ranks only stretch the composite)
+            q_lo = qnp[:, 7:7 + q_m]
+            q_hi = qnp[:, 7 + q_m:7 + 2 * q_m]
+            used = q_lo <= q_hi
+            if (q_lo[used] == q_hi[used]).all():
+                uniq_t2 = np.unique(q_lo[used])
+                inv_t2 = _exact_ranks(uniq_t2, tt)
+            else:
+                uniq_t2, inv_t2 = np.unique(tt, return_inverse=True)
+            # unique dep slots: presence flags + an inverse-map gather
+            # beat a sort once the emit set outgrows the snapshot's slot
+            # space (slot ids are dense by construction)
+            cap_s = len(msb_a)
+            if len(jj) > cap_s // 4:
+                flag = np.zeros(cap_s, bool)
+                flag[jj] = True
+                u_slots = np.nonzero(flag)[0]
+                remap = np.empty(cap_s, np.int64)
+                remap[u_slots] = np.arange(len(u_slots), dtype=np.int64)
+                slot_inv = remap[jj]
+            else:
+                u_slots, slot_inv = np.unique(jj, return_inverse=True)
+            ordr = np.lexsort((node_a[u_slots],
+                               lsb_a[u_slots].astype(np.uint64),
+                               msb_a[u_slots].astype(np.uint64)))
+            rank = np.empty(len(u_slots), np.int64)
+            rank[ordr] = np.arange(len(u_slots))
+            _finalize_key_batch(builders, bb, tt, inv_t2, len(uniq_t2),
+                                rank[slot_inv], len(u_slots), obj_a[jj])
+        rp = np.zeros(0, np.int64) if all_key else np.nonzero(~key_dep)[0]
+        if len(rp):
+            jj_r, bb_r, rm, rq = tj[rp], tb[rp], tm[rp], tq[rp]
+            ilo = np.maximum(lo[jj_r, rm], qnp[bb_r, 7 + rq])
+            ihi = np.minimum(hi[jj_r, rm], qnp[bb_r, 7 + q_m + rq]) + 1
+            _finalize_range_batch(builders, bb_r, ilo, ihi,
+                                  msb_a[jj_r], lsb_a[jj_r],
+                                  node_a[jj_r], obj_a[jj_r])
+
     def deps_query_batch_end_attributed(self, safe, handle, builders) -> None:
         """Collect a dispatched batch and fold each query's deps into its
-        builder with full host-path semantics (floors/elision/attribution)."""
+        builder with full host-path semantics.  Attributed handles (every
+        protocol path since r15) arrive pre-floored/pre-elided from the
+        kernels and take the thin shared finalize; raw handles keep the
+        legacy host _attribute_batch pass (the property-test oracle)."""
         import time as _time
+        if handle[6].get("attributed"):
+            tb, tj, tm, tq, ids, ivs, qnp, q_m, _queries = \
+                self._batch_collect_attr(handle)
+            _ta = _time.perf_counter()
+            self._finalize_attr_entries(tb, tj, tm, tq, ids, ivs, qnp,
+                                        q_m, builders)
+            self._ktime("host_attr_finalize", _ta)
+            return
         b_idx, j_idx, overlap, ids, ivs, qnp, queries = \
             self._batch_collect(handle)
         _ta = _time.perf_counter()
@@ -2817,11 +3641,17 @@ class DeviceState:
         dm = self.deps
         snap_stale = dm._snap is None or dm._snap[0] != dm.mut_version
         snap_elems = cap * (2 * dm.max_intervals + 10) if snap_stale else 0
+        # r15: fused launches run the ATTRIBUTED kernels — build (or
+        # reuse) this store's floor/elision index and the per-query bound
+        # ranks now, while the mirror is the begin-time state
+        aidx = self._attr_index()
         return {"dev": self, "queries": list(queries), "qnp": qnp,
                 "q_m": q_m, "floor_id": floor_id, "prune": prune_np,
                 "nq": nq, "b_pad": b_pad, "cap": cap,
                 "m_iv": self.deps.max_intervals, "solo_elems": solo_elems,
-                "snap_elems": snap_elems}
+                "snap_elems": snap_elems, "aidx": aidx,
+                "rankb_np": aidx.rank_bounds(qnp),
+                "floor_skip": aidx.floors_match(qnp, q_m, floor_id)}
 
     def fused_table(self):
         """The (cached, device-resident) table the fused launch consumes —
@@ -2862,7 +3692,19 @@ class DeviceState:
         self.n_fallback_queries += hint["nq"]
         hint["probing"] = False
         hint["host"] = self.deps.host_pairs(hint["qnp"], hint["q_m"],
-                                            hint["floor_id"])
+                                            hint["floor_id"], entries=True)
+
+    def _hint_attr_entries(self, hint, ent4) -> tuple:
+        """Turn a fused hint's host-route per-entry answer into the
+        attributed entry set: the same floor/elision drops the fused
+        kernel applies, over the hint's begin-time snapshot columns."""
+        cb, cj, cm, cq = ent4
+        tb, tj, tm, tq, n_t, n_d = self._attr_filter_entries(
+            cb, cj, cm, cq, hint["ids"], hint["ivs"],
+            hint["aidx"], hint["rankb_np"], hint.get("floor_skip", False))
+        self.n_elided_transitive += n_t
+        self.n_elided_decided += n_d
+        return tb, tj, tm, tq
 
     def _fused_snapshot(self, hint):
         return (hint["ids"][0], hint["ids"][1], hint["ids"][2],
@@ -2870,69 +3712,85 @@ class DeviceState:
                 hint["ivs"][1])
 
     def _fused_collect(self, hint, launch):
-        """Two-stage download + decode of this store's block of the fused
-        exact CSR, with the solo path's full semantics: overflow re-run
-        (solo, escalated s/k from the exact header, same snapshot table,
-        compacted transfer), stale-result injection point, paranoia
-        shadow-verify against the SNAPSHOT host scan, probe restore, and
-        whole-batch host failover on any device-boundary failure."""
+        """Download + decode of this store's block of the fused ATTRIBUTED
+        result, with the solo path's full semantics: overflow re-run
+        (solo attributed, escalated s/k from the exact header, same
+        snapshot table + attr inputs), stale-result injection point,
+        paranoia shadow-verify against the attr-filtered SNAPSHOT host
+        scan, probe restore, and whole-batch host failover on any
+        device-boundary failure.  Returns attributed ENTRY arrays
+        (tb, tj, tm, tq)."""
         import time as _time
         _t0 = _time.perf_counter()
         nq = hint["nq"]
         if "host" in hint:           # launch already failed over to host
             self.n_host_queries += nq
             self.n_dispatches += 1
-            return hint["host"]
+            return self._hint_attr_entries(hint, hint["host"])
         qnp, q_m = hint["qnp"], hint["q_m"]
-        d, shard_n = hint["d"], hint["shard_n"]
+        shard_n = hint["shard_n"]
         b_pad = hint["b_pad_c"]
         mq, qmc = hint["mq"], hint["q_m_c"]
+        pad_stride = hint.get("pad_shard_n")   # mesh: padded shard stride
         try:
             hdr_all, ent_all = launch.materialize()
-            hdr = hdr_all[hint["row"]].reshape(d, 2 + b_pad)
+            hdr = hdr_all[hint["row"]].reshape(1, 5 + b_pad)
             ent = ent_all[hint["row"]]
             s_, k_ = launch.s, launch.k
             runs = 0
-            while int(hdr[:, 0].max()) > s_ or int(hdr[:, 1].max()) > k_:
+            while int(hdr[:, 1].max()) > s_ or int(hdr[:, 2].max()) > k_:
                 # overflow: escalate EXACTLY like the solo path — re-run
-                # this store alone against the same cached table, sized
-                # from the exact header, and fetch the re-run compacted
+                # this store alone against the same cached table + attr
+                # inputs, sized from the exact header
                 cap_k = shard_n * hint["m_iv"] * qmc
                 s_, k_ = self._overflow_resize(
-                    int(hdr[:, 0].max()), int(hdr[:, 1].max()), s_, k_,
+                    int(hdr[:, 1].max()), int(hdr[:, 2].max()), s_, k_,
                     b_pad * cap_k, cap_k, runs)
                 qmat = jnp.asarray(hint["qmat_np"])
+                rankb = jnp.asarray(hint["rankb_pad"])
                 pnp = hint["prune"]
                 pz = _prune_zeros() if pnp is None else \
                     (jnp.asarray(pnp[0]), jnp.asarray(pnp[1]),
                      jnp.asarray(pnp[2]))
                 wide = hint["wide"]
+                fl_, el_ = (not hint.get("floor_skip", False),
+                            hint["aidx"].u > 0)
                 if self.mesh is not None:
-                    from ..parallel.sharded import \
-                        sharded_calculate_deps_flat_pruned
-                    hdr_dev, ent_dev = sharded_calculate_deps_flat_pruned(
-                        self.mesh, qmc, s_, k_, wide)(hint["table"], qmat,
-                                                      *pz)
+                    from ..parallel.sharded import sharded_flat_attr
+                    hdr_dev, ent_dev = sharded_flat_attr(
+                        self.mesh, qmc, s_, k_, wide, fl_, el_)(
+                        hint["table"],
+                        self.deps.device_attr_cols_sharded(self.mesh),
+                        hint["aidx"].device_replicated(self.mesh),
+                        qmat, rankb, *pz)
+                    d_ent = len(self.mesh.devices.flat)
                 else:
-                    hdr_dev, ent_dev = dk.calculate_deps_flat_pruned(
-                        hint["table"], qmat, *pz, qmc, s_, k_, wide)
+                    hdr_dev, ent_dev = dk.calculate_deps_flat_attr(
+                        hint["table"], self.deps.device_attr_cols(),
+                        hint["aidx"].device(), qmat, rankb, *pz,
+                        qmc, s_, k_, wide, fl_, el_)
+                    d_ent = 1
                 faults.check("transfer", "header download")
-                hdr = np.asarray(hdr_dev).reshape(d, 2 + b_pad)
+                hdr = np.asarray(hdr_dev).reshape(1, 5 + b_pad)
                 itemsize = 8 if wide else 4
                 self.download_bytes += hdr.nbytes
-                self.download_bytes_padded += hdr.nbytes + d * s_ * itemsize
-                if int(hdr[:, 0].max()) <= s_ and int(hdr[:, 1].max()) <= k_:
+                self.download_bytes_padded += hdr.nbytes \
+                    + d_ent * s_ * itemsize
+                if int(hdr[:, 1].max()) <= s_ \
+                        and int(hdr[:, 2].max()) <= k_:
                     faults.check("transfer", "entry download")
-                    ent = _fetch_entry_prefix(ent_dev, d, s_,
+                    ent = _fetch_entry_prefix(ent_dev, 1, d_ent * s_,
                                               int(hdr[:, 0].max()))
                     self.download_bytes += ent.nbytes
                 runs += 1
             if runs:
-                # the re-run scanned the store's OWN table, so its codes
-                # scale on the store's interval width, not the group's
+                # the re-run scanned the store's OWN table solo, so its
+                # codes scale on the store's interval width and its slot
+                # ids are contiguous-global (no fused pad stride)
                 mq = hint["m_iv"] * qmc
+                pad_stride = None
             if ent.ndim == 1:
-                ent = ent.reshape(d, -1)
+                ent = ent.reshape(1, -1)
         except faults.DEVICE_EXCEPTIONS as e:
             # whole-batch failover: quarantine every member, serve this
             # flush from the SNAPSHOT host scan (begin-time bytes)
@@ -2940,10 +3798,22 @@ class DeviceState:
             self.n_fallback_queries += nq
             self.n_host_queries += nq
             self.n_dispatches += 1
-            return self.deps.host_pairs(qnp, q_m, hint["floor_id"],
-                                        snapshot=self._fused_snapshot(hint))
+            return self._hint_attr_entries(
+                hint, self.deps.host_pairs(
+                    qnp, q_m, hint["floor_id"],
+                    snapshot=self._fused_snapshot(hint), entries=True))
+        self.n_elided_transitive += int(hdr[:, 3].sum())
+        self.n_elided_decided += int(hdr[:, 4].sum())
+        self.attr_download_bytes += hdr.nbytes + ent.nbytes
         tb, tj, tm, tq = _decode_triples(hdr, ent, b_pad, shard_n,
-                                         False, mq, qmc)
+                                         True, mq, qmc, hoff=5)
+        if pad_stride is not None:
+            # mesh fused codes number slots on the PADDED per-shard
+            # stride (every member padded to the group's largest slice):
+            # fold back onto this store's contiguous slot ids
+            tj = (tj // pad_stride) * np.int64(hint["cap"]
+                                               // hint["d_mesh"]) \
+                + tj % pad_stride
         if self._paranoid() and len(tj) \
                 and faults.should_fire("stale_result"):
             tj = (tj + np.int64(1)) % np.int64(len(hint["ids"][0]))
@@ -2951,21 +3821,20 @@ class DeviceState:
         b_global = gmap[tb]
         keep = b_global >= 0
         tb, tj, tm, tq = b_global[keep], tj[keep], tm[keep], tq[keep]
-        b_idx, j_idx, p_i = _tri_pairs(tb, tj)
-        pmq = (p_i, tm, tq)
         if self._paranoid():
             self.n_shadow_checks += 1
-            b_h, j_h, pmq_h = self.deps.host_pairs(
-                qnp, q_m, hint["floor_id"],
-                snapshot=self._fused_snapshot(hint))
+            hb, hj, hm, hq = self._hint_attr_entries(
+                hint, self.deps.host_pairs(
+                    qnp, q_m, hint["floor_id"],
+                    snapshot=self._fused_snapshot(hint), entries=True))
             cap = np.int64(len(hint["ids"][0]))
-            if not np.array_equal(np.unique(b_idx * cap + j_idx),
-                                  np.unique(b_h * cap + j_h)):
+            if not np.array_equal(np.unique(tb * cap + tj),
+                                  np.unique(hb * cap + hj)):
                 self.n_shadow_mismatches += 1
                 self._device_fault("stale_result", "fused shadow mismatch")
                 self.n_fallback_queries += nq
                 self.n_dispatches += 1
-                return b_h, j_h, pmq_h
+                return hb, hj, hm, hq
         if hint.get("probing"):
             self._restore_device()
         self.n_dispatches += 1
@@ -2975,28 +3844,27 @@ class DeviceState:
             self.n_mesh_queries += nq
         else:
             self.n_dense_queries += nq
-        self._ktime("wait_fused", _t0)
-        return b_idx, j_idx, pmq
+        self._ktime("wait_attr_fused", _t0)
+        return tb, tj, tm, tq
 
     def fused_harvest(self, safe, hint, launch) -> None:
         """Store-task leg of a fused flush: parse this store's block of
-        the fused result (the shared download happens at the first
-        member's harvest — jax's async dispatch overlapped the device work
-        with whatever host processing ran since the launch), fold the
-        answer through the exact geometry + floors/elision/attribution
-        passes over the prep-time snapshot, and fire the batch's done
-        callbacks — the same bytes the solo launch would have produced,
-        harvested at the next event-loop boundary in deterministic store
-        order."""
+        the fused ATTRIBUTED result (the shared download happens at the
+        first member's harvest — jax's async dispatch overlapped the
+        device work with whatever host processing ran since the launch)
+        and hand the pre-attributed entries straight to the shared
+        finalize over the prep-time snapshot — the same bytes the solo
+        launch would have produced, harvested at the next event-loop
+        boundary in deterministic store order."""
         batch = hint["batch"]
         try:
-            b_idx, j_idx, pmq = self._fused_collect(hint, launch)
+            tb, tj, tm, tq = self._fused_collect(hint, launch)
             self.n_queries += hint["nq"]
-            self.n_kernel_deps += len(j_idx)
-            self._attribute_batch(safe, b_idx, j_idx, pmq, hint["ids"],
-                                  hint["ivs"], hint["qnp"],
-                                  hint["queries"],
-                                  [b for _q, b, _d in batch])
+            self.n_kernel_deps += len(tj)
+            self._finalize_attr_entries(tb, tj, tm, tq, hint["ids"],
+                                        hint["ivs"], hint["qnp"],
+                                        hint["q_m"],
+                                        [b for _q, b, _d in batch])
         except BaseException as e:  # noqa: BLE001
             for _q, _b, done in batch:
                 done(e, None)

@@ -16,8 +16,9 @@ boundary:
   priced fused-vs-solo (the same micro-probe calibration the r06 router
   uses: fusing S launches saves (S-1) round trips and pays for the padding
   waste of stacking unequal tables); when fusion wins, ONE store-tagged
-  kernel launch (ops.deps_kernel.fused_flat_csr, or
-  parallel.sharded.sharded_fused_flat under a mesh) answers every member.
+  ATTRIBUTED kernel launch (ops.deps_kernel.fused_flat_attr, or
+  parallel.sharded.sharded_fused_attr under a mesh — floors/elision fold
+  in-kernel, r15) answers every member.
 - **Async harvest**: the fused launch is enqueued WITHOUT blocking — jax's
   async dispatch overlaps the device work with host protocol processing —
   and each member harvests its block in its own store task, enqueued at
@@ -80,21 +81,22 @@ def _profiled_harvest(name, dev0, members, download):
 
 
 class FusedFlushLaunch:
-    """One in-flight fused deps launch: the shared device buffers plus the
-    member hints.  The download happens at the FIRST member's harvest
-    (faults.check rides it — one transfer crossing per fused launch) and
-    is TWO-STAGE like the solo path: the stacked scalar headers first,
-    then one slice carrying only the live prefix of every member's entry
-    block; any device-boundary failure poisons the whole batch: every
-    member quarantines and serves its flush from the snapshot host scan."""
+    """One in-flight fused ATTRIBUTED deps launch: the shared device
+    buffers plus the member hints.  The download happens at the FIRST
+    member's harvest (faults.check rides it — one transfer crossing per
+    fused launch) and is TWO-STAGE like the solo path: the stacked scalar
+    headers first, then one slice carrying only the live prefix of every
+    member's (merged, under a mesh) entry block; any device-boundary
+    failure poisons the whole batch: every member quarantines and serves
+    its flush from the snapshot host scan."""
 
-    def __init__(self, dev_out, hints, s: int, k: int, d: int, b_pad: int,
-                 wide: bool):
+    def __init__(self, dev_out, hints, s: int, k: int, d_ent: int,
+                 b_pad: int, wide: bool):
         self.hdr_dev, self.ent_dev = dev_out
         self.hints = hints
         self.s = s
         self.k = k
-        self.d = d
+        self.d_ent = d_ent        # entries per store = d_ent * s
         self.b_pad = b_pad
         self.wide = wide
         self._out = None
@@ -112,11 +114,12 @@ class FusedFlushLaunch:
             hdr = _profiled_harvest(
                 "fused_flush_harvest_header", dev0,
                 n_s, lambda: np.asarray(self.hdr_dev))
-            hdr = hdr.reshape(n_s, self.d, 2 + self.b_pad)
-            maxtot = min(int(hdr[:, :, 0].max()), self.s)
-            length = _prefix_len(maxtot, self.s)
+            hdr = hdr.reshape(n_s, 5 + self.b_pad)
+            s_eff = self.d_ent * self.s
+            maxtot = min(int(hdr[:, 0].max()), s_eff)
+            length = _prefix_len(maxtot, s_eff)
             faults.check("transfer", "fused entry download")
-            ent3 = self.ent_dev.reshape(n_s, self.d, self.s)[:, :, :length]
+            ent3 = self.ent_dev.reshape(n_s, s_eff)[:, :length]
             ent = _profiled_harvest(
                 "fused_flush_harvest_entries", dev0,
                 n_s, lambda: np.asarray(ent3))
@@ -124,7 +127,8 @@ class FusedFlushLaunch:
             # harvest order is store-id order)
             dev0.download_bytes += hdr.nbytes + ent.nbytes
             dev0.download_bytes_padded += \
-                hdr.nbytes + n_s * self.d * self.s * itemsize
+                hdr.nbytes + n_s * s_eff * itemsize
+            dev0.attr_download_bytes += hdr.nbytes + ent.nbytes
             self._out = (hdr, ent)
         return self._out
 
@@ -260,6 +264,59 @@ class DeviceDispatcher:
                 dev.store.execute(PreLoadContext.empty(),
                                   partial(dev._flush_batch, batch=batch))
 
+    def _stacked_attr(self, hints):
+        """Pre-stacked [S, ...] AttrCols + AttrIndex for the fused
+        attributed launch, cached on the members' attr versions and index
+        identities: 16 stores' twenty extra per-store pytrees per launch
+        measured ~5ms of pure jax argument flattening on the tiny-flush
+        regime — stacking host-side hands the jit TWO pytrees and keeps
+        the device copies resident between launches."""
+        import jax.numpy as jnp
+        key = (tuple(id(h["dev"]) for h in hints),
+               tuple(h["dev"].deps.attr_version for h in hints),
+               tuple(h["aidx"].seq for h in hints))
+        cached = getattr(self, "_stacked_attr_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
+        n_max = max(h["dev"].deps.capacity for h in hints)
+        cols = []
+        for h in hints:
+            hc = h["dev"].deps._attr_host_cols()
+            cols.append([np.concatenate(
+                [a, np.full(n_max - len(a),
+                            dk.SLOT_FREE if i == 1 else (1 if i == 0 else 0),
+                            a.dtype)]) if len(a) < n_max else a
+                for i, a in enumerate(hc)])
+        sa = dk.AttrCols(*(jnp.asarray(np.stack(c))
+                           for c in zip(*cols)))
+        pads = [h["aidx"].pad for h in hints]
+        f_max = max(len(p[0]) for p in pads)
+        t_max = max(len(p[4]) for p in pads)
+        l_max = max(len(p[6]) for p in pads)
+        import numpy as _np
+
+        def tail(a, n, fill):
+            if len(a) >= n:
+                return a
+            out = _np.full(n, fill, a.dtype)
+            out[: len(a)] = a
+            return out
+
+        inf = _np.int64(_np.iinfo(_np.int64).max)
+        rows = []
+        for p in pads:
+            live_l = p[5][-1]
+            rows.append((tail(p[0], f_max, inf),
+                         tail(p[1], f_max + 1, 0), tail(p[2], f_max + 1, 0),
+                         tail(p[3], f_max + 1, 0),
+                         tail(p[4], t_max, inf), tail(p[5], t_max + 1, live_l),
+                         tail(p[6], l_max, inf), tail(p[7], l_max, 0),
+                         tail(p[8], l_max, 0), tail(p[9], l_max, 0),
+                         p[10]))
+        si = dk.AttrIndex(*(jnp.asarray(np.stack(c)) for c in zip(*rows)))
+        self._stacked_attr_cache = (key, sa, si)
+        return sa, si
+
     def _fused_flush_pays(self, hints) -> bool:
         """Price ONE fused launch against the members' solo launches with
         the r06 micro-probe calibration: fusing saves (S-1) round trips
@@ -305,8 +362,15 @@ class DeviceDispatcher:
         m_max = max(h["m_iv"] for h in hints)
         # the fused trace pads every table to the group's interval width,
         # so codes scale on m_max; the entry dtype must hold the WIDEST
-        # member's codes
-        wide = any(dk.wide_codes(h["cap"] // d, m_max, q_m) for h in hints)
+        # member's codes — under a mesh the merged entries carry GLOBAL
+        # slot ids on the padded shard stride, so the crossover is the
+        # whole padded slot space
+        rankbs = np.zeros((len(hints), b_pad), np.int64)
+        pad_shard_n = max(h["cap"] // d for h in hints)
+        if mesh is not None:
+            wide = dk.wide_codes(d * pad_shard_n, m_max, q_m)
+        else:
+            wide = any(dk.wide_codes(h["cap"], m_max, q_m) for h in hints)
         for i, h in enumerate(hints):
             qnp, qmi, nq = h["qnp"], h["q_m"], h["nq"]
             rows_p = np.minimum(np.arange(b_pad), nq - 1)
@@ -315,19 +379,23 @@ class DeviceDispatcher:
             qmats[i, :, 7 + q_m:] = dk.PAD_HI
             qmats[i, :, 7:7 + qmi] = qnp[rows_p, 7:7 + qmi]
             qmats[i, :, 7 + q_m:7 + q_m + qmi] = qnp[rows_p, 7 + qmi:]
+            rankbs[i] = h["rankb_np"][rows_p]
             if h["prune"] is not None:
                 pm[i], pl[i], pn[i] = h["prune"]
             h["gmap"] = np.where(np.arange(b_pad) < nq,
                                  np.arange(b_pad), -1)
             h["row"] = i
             h["d"] = d
+            h["d_mesh"] = d
             h["shard_n"] = h["cap"] // d
+            h["pad_shard_n"] = pad_shard_n if mesh is not None else None
             h["b_pad_c"] = b_pad
             h["q_m_c"] = q_m
             h["m_max"] = m_max
             h["mq"] = m_max * q_m
             h["wide"] = wide
             h["qmat_np"] = qmats[i]
+            h["rankb_pad"] = rankbs[i]
         # commit first (probe bookkeeping, mirror snapshots, route
         # observation): a launch fault below must still find the begin-time
         # snapshot to serve the host failover from
@@ -339,15 +407,26 @@ class DeviceDispatcher:
             for h, t in zip(hints, tables):
                 h["table"] = t
             import jax.numpy as jnp
+            # static leg switches, OR'd over the group: a member with a
+            # trivial floor map / empty elision index just computes
+            # nothing in the shared legs
+            fl_ = any(not h.get("floor_skip", False) for h in hints)
+            el_ = any(h["aidx"].u > 0 for h in hints)
             if mesh is not None:
-                from ..parallel.sharded import sharded_fused_flat
-                out = sharded_fused_flat(mesh, len(hints), q_m, s, k,
-                                         wide)(
-                    *tables, jnp.asarray(qmats), jnp.asarray(pm),
+                from ..parallel.sharded import sharded_fused_attr
+                attrs = [h["dev"].deps.device_attr_cols_sharded(mesh)
+                         for h in hints]
+                aidxs = [h["aidx"].device_replicated(mesh) for h in hints]
+                out = sharded_fused_attr(mesh, len(hints), q_m, s, k,
+                                         wide, fl_, el_)(
+                    *tables, *attrs, *aidxs, jnp.asarray(qmats),
+                    jnp.asarray(rankbs), jnp.asarray(pm),
                     jnp.asarray(pl), jnp.asarray(pn))
             else:
-                out = dk.fused_flat_csr(tables, qmats, (pm, pl, pn),
-                                        q_m, s, k, wide)
+                sa, si = self._stacked_attr(hints)
+                out = dk.fused_flat_attr(tables, sa, si, qmats,
+                                         rankbs, (pm, pl, pn),
+                                         q_m, s, k, wide, fl_, el_)
         except faults.DEVICE_EXCEPTIONS as e:
             # a device fault inside the fused launch fails the WHOLE batch
             # over to the host route, then quarantines per-store as solo
@@ -368,7 +447,8 @@ class DeviceDispatcher:
         if self.on_fused is not None:
             self.on_fused("flush", len(hints),
                           sum(h["nq"] for h in hints))
-        return FusedFlushLaunch(out, hints, s, k, d, b_pad, wide)
+        return FusedFlushLaunch(out, hints, s, k,
+                                d if mesh is not None else 1, b_pad, wide)
 
     # -- tick side ----------------------------------------------------------
     def register_tick(self, dev) -> None:

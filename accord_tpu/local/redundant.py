@@ -98,11 +98,12 @@ class RedundantBefore:
     it, so detecting "the floor moved" is O(1) per dispatch instead of a
     re-derivation of the floor map."""
 
-    __slots__ = ("_map", "version")
+    __slots__ = ("_map", "version", "_packed_floors")
 
     def __init__(self):
         self._map: ReducingRangeMap = ReducingRangeMap.empty()
         self.version = 0
+        self._packed_floors = None   # (version, (bnd, msb, lsb, node))
 
     def add_redundant(self, ranges: Ranges, redundant_before: TxnId) -> None:
         """Advance the SHARD-applied watermark (ref: markShardDurable)."""
@@ -234,18 +235,37 @@ class RedundantBefore:
         per distinct map segment (the map has a handful of segments; the
         batch has thousands of tokens)."""
         import numpy as np
+        bnd, fm, fl, fn = self.packed_floor_index()
+        idx = np.searchsorted(bnd, tokens, side="right")
+        return fm[idx], fl[idx], fn[idx]
+
+    def packed_floor_index(self):
+        """The whole floor map as four numpy columns: segment boundaries
+        (int64[F]) plus the per-segment deps_floor triples (int64[F+1] x 2,
+        int32[F+1]; ``searchsorted(bnd, token, side="right")`` selects the
+        segment — exactly deps_floor_batch's rule).  This is the host
+        source of the DEVICE floor index (ops.deps_kernel.AttrIndex): the
+        attributed kernels apply the exact per-token floor in-kernel, so
+        the packed form is cached on ``version`` and shared by every flush
+        until a watermark moves."""
+        import numpy as np
 
         from ..ops.packing import to_i64
+        hit = self._packed_floors
+        if hit is not None and hit[0] == self.version:
+            return hit[1]
         m = self._map
         bnd = np.asarray(m.boundaries, np.int64)
-        idx = np.searchsorted(bnd, tokens, side="right")
-        packed = np.empty((len(m.values), 3), np.int64)
+        fm = np.empty(len(m.values), np.int64)
+        fl = np.empty(len(m.values), np.int64)
+        fn = np.empty(len(m.values), np.int32)
         for i, v in enumerate(m.values):
             f = TxnId.NONE if v is None else max(v.redundant_before,
                                                  v.bootstrapped_at)
-            packed[i] = (to_i64(f.msb), to_i64(f.lsb), f.node)
-        sel = packed[idx]
-        return sel[:, 0], sel[:, 1], sel[:, 2]
+            fm[i], fl[i], fn[i] = to_i64(f.msb), to_i64(f.lsb), f.node
+        packed = (bnd, fm, fl, fn)
+        self._packed_floors = (self.version, packed)
+        return packed
 
     def boundary_dep(self, token: int) -> Optional[TxnId]:
         """The bootstrap-fence TxnId flooring this key's deps, if any.  A

@@ -263,6 +263,14 @@ INDEX_COUNTERS: List[Tuple[str, str]] = [
     # pre-r10 collect downloaded — the compaction ratio in every artifact
     ("download_bytes", "download_bytes"),
     ("download_bytes_padded", "download_bytes_padded"),
+    # r15 device-resident attribution: rows the attribution stage elided
+    # (transitively-known vs decided-below-pivot — the eknown/emsb legs)
+    # and the bytes of pre-attributed block downloads.  All routes count
+    # (the kernels report via their headers, the host route from its own
+    # filter), so a routing flip shows up as counter movement, not a gap
+    ("elided_transitive", "n_elided_transitive"),
+    ("elided_decided", "n_elided_decided"),
+    ("attr_download_bytes", "attr_download_bytes"),
 ]
 
 

@@ -241,33 +241,6 @@ def _compact_topk(dep_mask: jnp.ndarray, k: int):
     return idx, counts
 
 
-@partial(jax.jit, static_argnums=(2,))
-def calculate_deps_indices(table: DepsTable, query: DepsQuery, k: int):
-    """calculate_deps compacted ON DEVICE to per-row slot indices: ships
-    only the sparse result across the PCIe/tunnel boundary — the host reads
-    TxnIds from its own mirror.  A row whose count exceeds ``k`` overflowed;
-    the caller falls back to the bit-packed full mask."""
-    dep_mask, max_conflict = calculate_deps(table, query)
-    idx, counts = _compact_topk(dep_mask, k)
-    return idx, counts, max_conflict
-
-
-@partial(jax.jit, static_argnums=(2, 3))
-def calculate_deps_indices_fused(table: DepsTable, qmat: jnp.ndarray,
-                                 m: int, k: int) -> jnp.ndarray:
-    """The batched query with ONE upload and ONE download: ``qmat`` packs a
-    whole DepsQuery as int64[B, 7+2m] columns (msb, lsb, node, wmask,
-    self_msb, self_lsb, self_node, lo[m], hi[m]); the result fuses counts
-    and slot indices as int32[B, 1+k] (counts in column 0, -1-padded
-    ascending indices after).  On a tunneled accelerator the round trips,
-    not the kernel, dominate: the 9-array query upload and the
-    idx/counts/max_conflict downloads each cost a full RTT."""
-    query = query_from_qmat(qmat, m)
-    dep_mask, _mc = calculate_deps(table, query)
-    idx, counts = _compact_topk(dep_mask, k)
-    return jnp.concatenate([counts[:, None], idx], axis=1)
-
-
 @partial(jax.jit, static_argnames=("m", "s", "k", "wide"))
 def calculate_deps_flat(table: DepsTable, qmat: jnp.ndarray,
                         m: int, s: int, k: int, wide: bool = False):
@@ -326,6 +299,37 @@ def _compact_rows(valid: jnp.ndarray, codes: jnp.ndarray, s: int, k: int):
     return counts, row_end, ent
 
 
+def _flat_phase1(table: DepsTable, qmat: jnp.ndarray, m: int, k: int,
+                 prune=None):
+    """Shared phase 1 of the dense flat kernels: exact mask -> per-row
+    compacted slot indices -> overlap-triple expansion.  Returns
+    (query, idx, pair_counts, sel, tlo, valid[B,kp,M,Q])."""
+    query = query_from_qmat(qmat, m)
+    if prune is None:
+        mask, _conflict = _dep_mask_and_conflict(table, query)
+    else:
+        mask, _conflict = _dep_mask_and_conflict(table, query, *prune)
+    n = mask.shape[1]
+    kp = min(k, n)
+    idx, pair_counts = _compact_topk(mask, kp)                 # [B,kp],[B]
+    sel = jnp.clip(idx, 0)
+    tlo = table.lo[sel]                                        # [B,kp,M]
+    thi = table.hi[sel]
+    qlo = query.lo[:, None, None, :]                           # [B,1,1,Q]
+    qhi = query.hi[:, None, None, :]
+    ov = (qlo <= thi[:, :, :, None]) & (tlo[:, :, :, None] <= qhi)
+    valid = ov & (idx >= 0)[:, :, None, None]                  # [B,kp,M,Q]
+    return query, idx, pair_counts, sel, tlo, valid
+
+
+def _triple_codes(sel, m_t: int, m: int, wide: bool):
+    dt = _code_dtype(wide)
+    mq = m_t * m
+    return (sel.astype(dt)[:, :, None, None] * mq
+            + jnp.arange(m_t, dtype=dt)[None, None, :, None] * m
+            + jnp.arange(m, dtype=dt)[None, None, None, :])
+
+
 def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
                    m: int, s: int, k: int, prune=None, wide: bool = False):
     """The traceable body of calculate_deps_flat: exact mask over THIS
@@ -343,28 +347,11 @@ def flat_csr_local(table: DepsTable, qmat: jnp.ndarray,
     exact per-row triple count when every pair fit phase 1, and at least
     the (truncated-past-k) pair count otherwise — either way overflow
     reads as ``maxc > k`` and the caller re-runs escalated."""
-    query = query_from_qmat(qmat, m)
-    if prune is None:
-        mask, _conflict = _dep_mask_and_conflict(table, query)
-    else:
-        mask, _conflict = _dep_mask_and_conflict(table, query, *prune)
-    n = mask.shape[1]
+    _query, idx, pair_counts, sel, _tlo, valid = \
+        _flat_phase1(table, qmat, m, k, prune)
     m_t = table.lo.shape[1]
-    kp = min(k, n)
-    idx, pair_counts = _compact_topk(mask, kp)                 # [B,kp],[B]
-    sel = jnp.clip(idx, 0)
-    tlo = table.lo[sel]                                        # [B,kp,M]
-    thi = table.hi[sel]
-    qlo = query.lo[:, None, None, :]                           # [B,1,1,Q]
-    qhi = query.hi[:, None, None, :]
-    ov = (qlo <= thi[:, :, :, None]) & (tlo[:, :, :, None] <= qhi)
-    valid = ov & (idx >= 0)[:, :, None, None]                  # [B,kp,M,Q]
-    dt = _code_dtype(wide)
-    mq = m_t * m
-    codes = (sel.astype(dt)[:, :, None, None] * mq
-             + jnp.arange(m_t, dtype=dt)[None, None, :, None] * m
-             + jnp.arange(m, dtype=dt)[None, None, None, :])
-    b = mask.shape[0]
+    codes = _triple_codes(sel, m_t, m, wide)
+    b = valid.shape[0]
     valid_f = valid.reshape(b, -1)
     codes_f = codes.reshape(b, -1)   # ascending: slot-major, then col, q
     counts, row_end, ent = _compact_rows(valid_f, codes_f, s, k)
@@ -651,23 +638,25 @@ def calculate_deps_flat_pruned(table: DepsTable, qmat: jnp.ndarray,
 
 
 def pack_query_matrix(queries: Sequence[tuple], max_intervals: int) -> np.ndarray:
-    """Host packer for calculate_deps_indices_fused: one int64 matrix instead
-    of nine arrays (single device upload).  queries as in build_query."""
+    """Host packer for the flat/attributed kernels: one int64 matrix
+    instead of nine arrays (single device upload).  queries as in
+    build_query."""
     b = len(queries)
     m = max_intervals
     q = np.empty((b, 7 + 2 * m), np.int64)
     q[:, 7:7 + m] = PAD_LO
     q[:, 7 + m:] = PAD_HI
+    cols = ([], [], [], [], [], [], [])
     for i, item in enumerate(queries):
         (bound, witnesses, toks, rngs), self_id = \
             item[:4], (item[4] if len(item) > 4 else item[0])
-        q[i, 0] = to_i64(bound.msb)
-        q[i, 1] = to_i64(bound.lsb)
-        q[i, 2] = bound.node
-        q[i, 3] = witnesses.mask()
-        q[i, 4] = to_i64(self_id.msb)
-        q[i, 5] = to_i64(self_id.lsb)
-        q[i, 6] = self_id.node
+        cols[0].append(to_i64(bound.msb))
+        cols[1].append(to_i64(bound.lsb))
+        cols[2].append(bound.node)
+        cols[3].append(witnesses.mask())
+        cols[4].append(to_i64(self_id.msb))
+        cols[5].append(to_i64(self_id.lsb))
+        cols[6].append(self_id.node)
         if len(toks) + len(rngs) > m:
             raise ValueError(f"txn touches > {m} intervals")
         j = 0
@@ -679,25 +668,9 @@ def pack_query_matrix(queries: Sequence[tuple], max_intervals: int) -> np.ndarra
             q[i, 7 + j] = r.start
             q[i, 7 + m + j] = r.end - 1
             j += 1
+    for c in range(7):
+        q[:, c] = cols[c]
     return q
-
-
-@jax.jit
-def calculate_deps_packed(table: DepsTable, query: DepsQuery,
-                          prune_msb: jnp.ndarray = None,
-                          prune_lsb: jnp.ndarray = None,
-                          prune_node: jnp.ndarray = None):
-    """calculate_deps with the dep mask bit-packed ON DEVICE
-    (uint8[B, ceil(N/8)]): the mask is the dominant host<->device transfer
-    (B x N bools), and packing shrinks it 8x before it crosses the
-    PCIe/tunnel boundary.  Host side unpacks with np.unpackbits."""
-    dep_mask, max_conflict = calculate_deps(table, query, prune_msb,
-                                            prune_lsb, prune_node)
-    pad = (-dep_mask.shape[1]) % 8
-    if pad:
-        dep_mask = jnp.pad(dep_mask, ((0, 0), (0, pad)))
-    packed = jnp.packbits(dep_mask, axis=1)
-    return packed, max_conflict
 
 
 # -- host bridge --------------------------------------------------------------
@@ -781,3 +754,334 @@ def extract_deps(table: DepsTable, dep_mask) -> List[List[TxnId]]:
         idx = np.nonzero(mask[b])[0]
         out.append(sorted(unpack_txn_id(msb[j], lsb[j], node[j]) for j in idx))
     return out
+
+
+# -- device-resident attribution + elision (r15) ------------------------------
+#
+# r10 moved the exact overlap geometry on-device; what remained host-side was
+# the ATTRIBUTION pass: per-token RedundantBefore floors, CommandsForKey
+# transitive elision, and the per-(query, token, dep) dedupe — ~6ms/batch of
+# numpy on the r13 profile, the last big host tax on every route.  The
+# attributed kernel variants below fold all three INTO the device program:
+# an entry that a floor or the elision rule would drop never enters the CSR
+# (and never crosses the wire), and duplicate (slot, interval) emits reached
+# through several query columns collapse in-kernel.  The attribution runs
+# POST-COMPACTION — over the thousands of surviving codes, not the
+# candidate matrix — so the stage costs O(s), and STATIC leg switches
+# (``floors``/``elide``) drop dead legs from the traced program entirely
+# (an empty elision index or a trivially-covered floor map compiles to the
+# raw kernel plus a dedupe).
+#
+# Inputs, all device-resident / replicated:
+#  - AttrCols: per-slot columns the dep MASK never needed but attribution
+#    does — domain (key deps emit at their own footprint points), a FRESH
+#    status (live->live moves included; elision reads the
+#    TRANSITIVE/COMMITTED grades), the packed dep id (the floor compare;
+#    redundant with DepsTable but the mesh bucketed shards have no local
+#    slot table), and the decided executeAt.
+#  - AttrIndex: the per-store floor + elision index.  Floors are the packed
+#    RedundantBefore segment map (searchsorted per emitted token — exactly
+#    deps_floor_batch's rule).  Elision is a CSR over the store's elidable
+#    tokens: per token the SORTED committed-write executeAt list, flattened,
+#    with each exec replaced by its composite rank ``seg * estride + rank``
+#    so ONE int64 searchsorted answers "how many committed writes on token
+#    t execute before bound b".  The per-query bound ranks (``rankb``) are
+#    computed host-side against the same index and ride in as a [B] array —
+#    no 128-bit comparisons on device.
+#
+# Attributed header layout (int32[5 + B]):
+#    [0] total entries   [1] overflow-vs-s watermark  [2] overflow-vs-k
+#    [3] rows elided as TRANSITIVE   [4] rows elided below a decided pivot
+#    [5:] row_end[B]
+# The overflow watermarks are the RAW (pre-attribution) totals — the
+# learned s/k budgets size the raw compaction — and stay per-shard maxima
+# under the mesh merge, so the collect-side re-run check is uniform:
+# hdr[1] > s or hdr[2] > k.
+
+
+class AttrCols(NamedTuple):
+    """Per-slot attribution columns (device-resident, scatter-updated in
+    lockstep with the DepsTable by the mirror).  The packed dep id rides
+    here TOO (redundant with DepsTable.msb/lsb/node): the post-compaction
+    attribution stage gathers ids per surviving entry, and the
+    mesh-sharded BUCKETED kernel has no local slot table to gather from —
+    one column set serves every route."""
+
+    dom: jnp.ndarray      # int32[N]  Domain ordinal (Key == 0)
+    status: jnp.ndarray   # int32[N]  fresh SLOT_* (elision reads grades)
+    dmsb: jnp.ndarray     # int64[N]  packed TxnId (floor compares)
+    dlsb: jnp.ndarray     # int64[N]
+    dnode: jnp.ndarray    # int32[N]
+    emsb: jnp.ndarray     # int64[N]  decided executeAt (valid iff eknown)
+    elsb: jnp.ndarray     # int64[N]
+    enode: jnp.ndarray    # int32[N]
+    eknown: jnp.ndarray   # bool[N]
+
+
+class AttrIndex(NamedTuple):
+    """Replicated per-store floor + elision index (host-built, cached on
+    the RedundantBefore / CommandsForKey versions; pow2-padded so jit
+    compiles a bounded number of shapes)."""
+
+    fbnd: jnp.ndarray     # int64[F]   floor segment boundaries (pad +INF)
+    fmsb: jnp.ndarray     # int64[F+1] per-segment deps_floor triples
+    flsb: jnp.ndarray     # int64[F+1]
+    fnode: jnp.ndarray    # int32[F+1]
+    etok: jnp.ndarray     # int64[T]   elidable tokens, sorted (pad +INF)
+    eptr: jnp.ndarray     # int32[T+1] CSR into the exec arrays (pad L)
+    erank: jnp.ndarray    # int64[L]   seg*estride+rank composites, asc
+    exm: jnp.ndarray      # int64[L]   the pivot executeAt triples
+    exl: jnp.ndarray      # int64[L]
+    exn: jnp.ndarray      # int32[L]
+    estride: jnp.ndarray  # int64[]    U+1 — the composite stride erank used
+
+
+def _attr_key_masks(tok, dmsb, dlsb, dnode, status, emsb, elsb, enode,
+                    eknown, rankb_b, aidx: AttrIndex,
+                    floors: bool = True, elide: bool = True):
+    """The in-kernel attribution predicate for KEY-domain candidates, all
+    elementwise over one candidate shape.  ``tok`` is the emitted token
+    (the dep's own footprint point), ``rankb_b`` the per-candidate bound
+    rank (broadcast from the query row).  Returns (keep_floor,
+    elide_trans, elide_dec) — the caller scopes them to key-domain
+    candidates.  ``floors``/``elide`` are STATIC leg switches the
+    dispatcher sets per flush: when the exact per-token floors equal the
+    already-applied batch prune, or the elision index is empty, the
+    corresponding gathers and searches never enter the program."""
+    ones = None
+    if floors:
+        # exact per-token RedundantBefore floor: dep >= deps_floor(token)
+        fi = jnp.searchsorted(aidx.fbnd, tok, side="right")
+        keep_floor = ~ts_lt(dmsb, dlsb, dnode,
+                            aidx.fmsb[fi], aidx.flsb[fi], aidx.fnode[fi])
+    else:
+        ones = jnp.ones(jnp.broadcast_shapes(tok.shape, dmsb.shape), bool)
+        keep_floor = ones
+    # transitively-known entries never emit
+    elide_trans = status == SLOT_TRANSITIVE
+    if not elide:
+        z = (~ones) if ones is not None else \
+            jnp.zeros(jnp.broadcast_shapes(tok.shape, dmsb.shape), bool)
+        return keep_floor, elide_trans, z
+    # decided entries executing below the token's latest committed write
+    # before the bound are reached through that write's stable deps
+    t = aidx.etok.shape[0]
+    seg = jnp.searchsorted(aidx.etok, tok)
+    seg_c = jnp.minimum(seg, max(t - 1, 0))
+    seg_ok = (aidx.etok[seg_c] == tok) if t else jnp.zeros(tok.shape, bool)
+    base = aidx.eptr[seg_c]
+    cnt = jnp.searchsorted(aidx.erank,
+                           seg_c.astype(jnp.int64) * aidx.estride
+                           + rankb_b) - base
+    has_pivot = seg_ok & (cnt > 0)
+    pidx = jnp.clip(base + cnt - 1, 0)
+    below = ts_lt(emsb, elsb, enode,
+                  aidx.exm[pidx], aidx.exl[pidx], aidx.exn[pidx])
+    decided = (status >= SLOT_COMMITTED) & (status <= SLOT_APPLIED) & eknown
+    elide_dec = decided & has_pivot & below
+    return keep_floor, elide_trans, elide_dec
+
+
+def _attr_post(tlo, attr: AttrCols, aidx: AttrIndex, rankb: jnp.ndarray,
+               hdr_raw, ent, m_t: int, m: int,
+               floors: bool = True, elide: bool = True, tok=None):
+    """The POST-COMPACTION attribution stage shared by every attributed
+    kernel: floors, elision and the key-domain query-column dedupe run
+    over the COMPACTED entry buffer — thousands of surviving codes — not
+    the candidate matrix (hundreds of thousands of cells).  The raw
+    kernels already sorted/compacted, so rows are contiguous and
+    same-(slot, col) key emits are adjacent; dropping entries is a mask +
+    one global cumsum scatter, no re-sort.
+
+    ``tlo`` is the interval-start matrix the emitted token gathers from
+    (the slot table's lo; a mesh-bucketed caller passes ``tok``
+    precomputed via a cross-shard psum instead).  Returns the attributed
+    (header int32[5+B], entries) pair; the header's overflow watermarks
+    are the RAW totals (the learned s/k budgets size the pre-attribution
+    compaction)."""
+    s = ent.shape[0]
+    total = hdr_raw[0].astype(jnp.int64)
+    maxc_raw = hdr_raw[1]
+    row_end = hdr_raw[2:].astype(jnp.int64)
+    b = row_end.shape[0]
+    pos = jnp.arange(s, dtype=jnp.int64)
+    live = pos < total
+    code = ent.astype(jnp.int64)
+    mq = m_t * m
+    slot = jnp.clip(code // mq, 0)
+    col = jnp.clip(code % mq // m, 0, m_t - 1)
+    row_of = jnp.searchsorted(row_end, pos, side="right")
+    row_of = jnp.minimum(row_of, b - 1)
+    key_dep = attr.dom[slot] == 0
+    status = attr.status[slot]
+    if tok is None:
+        tok = tlo[slot, col]
+    # the key masks at entry level (1-D gathers only)
+    keep_floor, el_trans, el_dec = _attr_key_masks(
+        tok, attr.dmsb[slot], attr.dlsb[slot], attr.dnode[slot], status,
+        attr.emsb[slot], attr.elsb[slot], attr.enode[slot],
+        attr.eknown[slot], rankb[row_of], aidx, floors, elide)
+    # key-domain query-column dedupe: codes are (slot, col, q)-ascending
+    # within each row, so same-(slot, col) runs are adjacent
+    pairkey = row_of * jnp.int64(1 << 40) + code // m
+    firstp = jnp.concatenate(
+        [jnp.ones(1, bool), pairkey[1:] != pairkey[:-1]])
+    drop_key = ~keep_floor | el_trans | el_dec | ~firstp
+    keep = live & (~key_dep | ~drop_key)
+    n_trans = jnp.sum(live & key_dep & firstp & keep_floor & el_trans)
+    n_dec = jnp.sum(live & key_dep & firstp & keep_floor
+                    & ~el_trans & el_dec)
+    out_pos = jnp.cumsum(keep) - 1
+    out = jnp.full(s, -1, ent.dtype).at[
+        jnp.where(keep, out_pos, s)].set(ent, mode="drop")
+    drops = jnp.zeros(b, jnp.int64).at[
+        jnp.where(live & ~keep, row_of, b)].add(1, mode="drop")
+    new_end = row_end - jnp.cumsum(drops)
+    header = jnp.concatenate(
+        [jnp.stack([new_end[-1], total, maxc_raw.astype(jnp.int64),
+                    n_trans, n_dec]).astype(jnp.int32),
+         new_end.astype(jnp.int32)])
+    return header, out
+
+
+def flat_attr_local(table: DepsTable, attr: AttrCols, aidx: AttrIndex,
+                    qmat: jnp.ndarray, rankb: jnp.ndarray,
+                    m: int, s: int, k: int, prune=None, wide: bool = False,
+                    floors: bool = True, elide: bool = True):
+    """flat_csr_local with the attribution pass fused in AFTER the raw
+    compaction: per-token floors, elision and the per-(slot, interval)
+    key dedupe drop entries from the compacted CSR, so what ships is
+    EXACTLY the entry set the host builders will keep.  Range-domain
+    entries pass through untouched (the mask's batch-global prune floor
+    is their whole floor story, matching the host oracle)."""
+    hdr_raw, ent = flat_csr_local(table, qmat, m, s, k, prune, wide=wide)
+    return _attr_post(table.lo, attr, aidx, rankb, hdr_raw, ent,
+                      table.lo.shape[1], m, floors, elide)
+
+
+@partial(jax.jit, static_argnames=("m", "s", "k", "wide", "floors",
+                                   "elide"))
+def calculate_deps_flat_attr(table: DepsTable, attr: AttrCols,
+                             aidx: AttrIndex, qmat: jnp.ndarray,
+                             rankb: jnp.ndarray,
+                             prune_msb: jnp.ndarray, prune_lsb: jnp.ndarray,
+                             prune_node: jnp.ndarray,
+                             m: int, s: int, k: int, wide: bool = False,
+                             floors: bool = True, elide: bool = True):
+    """The dispatchable dense attributed kernel (always pruned: the
+    attributed paths are the protocol paths, which enable the batch-global
+    floor; a zero triple prunes nothing)."""
+    return flat_attr_local(table, attr, aidx, qmat, rankb,
+                           m, s, k, (prune_msb, prune_lsb, prune_node),
+                           wide=wide, floors=floors, elide=elide)
+
+
+def bucketed_attr(table, attr: AttrCols, aidx: AttrIndex, buckets: BucketTable,
+                  qmat: jnp.ndarray, rankb: jnp.ndarray, m: int, span: int,
+                  s: int, k: int, prune=None, row_offset=None,
+                  keff: int = None, wide: bool = False, m_t: int = None,
+                  floors: bool = True, elide: bool = True, tok=None):
+    """bucketed_flat with the post-compaction attribution stage.  The
+    emitted token gathers from ``table.lo`` by the entry's global
+    (slot, col); the mesh-sharded wrapper passes ``tok`` resolved via a
+    cross-shard psum instead (its local table holds only a slot slice)."""
+    hdr_raw, ent = bucketed_flat(table, buckets, qmat, m, span, s, k,
+                                 prune, row_offset=row_offset, keff=keff,
+                                 wide=wide, m_t=m_t)
+    if m_t is None:
+        m_t = table.lo.shape[1]
+    tlo = table.lo if table is not None else None
+    return _attr_post(tlo, attr, aidx, rankb, hdr_raw, ent, m_t, m,
+                      floors, elide, tok=tok)
+
+
+bucketed_attr_jit = jax.jit(
+    bucketed_attr,
+    static_argnames=("m", "span", "s", "k", "keff", "wide", "m_t",
+                     "floors", "elide"))
+
+
+def _pad_attr_cols(cols, n: int):
+    """Pad one store's attribution columns to ``n`` slots: appended
+    slots are FREE (structurally excluded by the mask) so their grades are
+    never read."""
+    dom, status, dmsb, dlsb, dnode, emsb, elsb, enode, eknown = cols
+    pad1 = lambda a, fill: jnp.pad(a, (0, n - a.shape[0]),       # noqa: E731
+                                   constant_values=fill)
+    return (pad1(dom, 1), pad1(status, SLOT_FREE), pad1(dmsb, 0),
+            pad1(dlsb, 0), pad1(dnode, 0), pad1(emsb, 0),
+            pad1(elsb, 0), pad1(enode, 0), pad1(eknown, False))
+
+
+def _pad_attr_index(aidx: AttrIndex, f: int, t: int, l: int):
+    """Pad one store's AttrIndex to the fused group's (F, T, L) shapes.
+    Floor boundaries and elidable tokens pad with +INF (unreachable by any
+    real token); exec composites pad with +INF (sort after every real
+    key); eptr pads with the store's own live length so padded segments
+    are empty."""
+    inf = jnp.int64(np.iinfo(np.int64).max)
+
+    def tail(a, n, fill):
+        d = n - a.shape[0]
+        return jnp.concatenate([a, jnp.full(d, fill, a.dtype)])
+
+    live_l = aidx.eptr[-1]
+    return AttrIndex(
+        tail(aidx.fbnd, f, inf),
+        tail(aidx.fmsb, f + 1, 0), tail(aidx.flsb, f + 1, 0),
+        tail(aidx.fnode, f + 1, 0),
+        tail(aidx.etok, t, inf),
+        jnp.concatenate([aidx.eptr,
+                         jnp.broadcast_to(live_l, (t + 1 - aidx.eptr.shape[0],))
+                         .astype(aidx.eptr.dtype)]),
+        tail(aidx.erank, l, inf),
+        tail(aidx.exm, l, 0), tail(aidx.exl, l, 0), tail(aidx.exn, l, 0),
+        aidx.estride)
+
+
+_FUSED_ATTR_CACHE = {}
+
+
+def fused_flat_attr(tables: Sequence[DepsTable], stacked_attr: AttrCols,
+                    stacked_aidx: AttrIndex, qmats: np.ndarray,
+                    rankbs: np.ndarray,
+                    prunes: Tuple[np.ndarray, np.ndarray, np.ndarray],
+                    m: int, s: int, k: int, wide: bool = False,
+                    floors: bool = True, elide: bool = True):
+    """One fused launch for S stores' ATTRIBUTED deps scans — the r08
+    coalescing shape with the r15 attribution fused in: per-store tables
+    are padded to the group maxima and stacked INSIDE the jitted program,
+    then flat_attr_local is vmapped over the store axis.  Row i of the
+    outputs is exactly the solo calculate_deps_flat_attr answer for store
+    i (codes on the GROUP interval width).
+
+    ``stacked_attr`` / ``stacked_aidx`` arrive PRE-STACKED on the leading
+    store axis ([S, n_max] / [S, ...]; the dispatcher pads host-side and
+    caches on the members' attr versions): passing 16 stores' 20 extra
+    pytrees per launch measured ~5ms of pure argument flattening on the
+    config-5 tiny-flush regime — the launch-tax the fused path exists to
+    amortize."""
+    caps = tuple((t.capacity, t.lo.shape[1]) for t in tables)
+    b = qmats.shape[1]
+    key = (caps, stacked_aidx.fbnd.shape, stacked_aidx.etok.shape,
+           stacked_aidx.erank.shape, b, m, s, k, wide, floors, elide)
+    fn = _FUSED_ATTR_CACHE.get(key)
+    if fn is None:
+        n_max = max(c for c, _ in caps)
+        m_max = max(mi for _, mi in caps)
+
+        def traced(flat_cols, stacked_a, stacked_i, qm, rb, pm, pl, pn):
+            padded = [_pad_table_cols(cols, n_max, m_max)
+                      for cols in flat_cols]
+            stacked = DepsTable(*(jnp.stack(col) for col in zip(*padded)))
+            return jax.vmap(
+                lambda t, a, i, q, r, x, y, z: flat_attr_local(
+                    t, a, i, q, r, m, s, k, (x, y, z), wide=wide,
+                    floors=floors, elide=elide)
+            )(stacked, stacked_a, stacked_i, qm, rb, pm, pl, pn)
+
+        fn = _FUSED_ATTR_CACHE[key] = jax.jit(traced)
+    return fn(tuple(tuple(t) for t in tables), stacked_attr, stacked_aidx,
+              jnp.asarray(qmats), jnp.asarray(rankbs),
+              jnp.asarray(prunes[0]), jnp.asarray(prunes[1]),
+              jnp.asarray(prunes[2]))

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -393,3 +394,251 @@ def sharded_protocol_step(mesh: Mesh):
         return dep_mask, max_conflict, applied, newly
 
     return step
+
+
+# -- device-resident attribution (r15): sharded attributed kernels ------------
+#
+# The attributed variants return ONE merged per-store CSR block instead of D
+# per-shard blocks: every shard computes its slice's attributed entries, the
+# shard results are all-gathered over ICI, and the cross-shard merge — the
+# reference's ``Deps.merge`` — happens ON DEVICE: per-row concatenation in
+# (row, code) order via one flat sort, cross-shard dedupe (bucketed only:
+# slot-sharded dense slices are disjoint by construction), and a recompacted
+# merged row_end.  The host downloads one replicated block (header int32[5+B]
+# in the attributed layout, entries int64/int32[d * s]) and hands it straight
+# to the shared block finalize — no host-side shard offsetting, no global
+# triple dedupe pass.
+
+
+def _merge_shard_blocks(hdrs, ents, b: int, s: int, codespace: int,
+                        dedupe_key_m: int, dom=None, mq: int = None):
+    """The on-device cross-shard merge: ``hdrs`` int32[d, 5+B], ``ents``
+    [d, s] GLOBAL codes.  ``dedupe_key_m`` > 0 enables the bucketed
+    cross-shard dedupe (identical codes + key-domain same-(slot, col)
+    runs; needs ``dom``/``mq`` for the key-domain test).  Replicated
+    output: (header int32[5+B], entries [d*s])."""
+    d = hdrs.shape[0]
+    totals = hdrs[:, 0].astype(jnp.int64)
+    row_end = hdrs[:, 5:].astype(jnp.int64)                    # [d, B]
+    pos = jnp.arange(s, dtype=jnp.int64)
+    row_of = jax.vmap(lambda re: jnp.searchsorted(re, pos, side="right"))(
+        row_end)                                               # [d, s]
+    live = pos[None, :] < totals[:, None]
+    inf = jnp.int64(np.iinfo(np.int64).max)
+    code = ents.astype(jnp.int64)
+    comp = jnp.where(live, row_of * jnp.int64(codespace) + code, inf)
+    comp = jnp.sort(comp.reshape(-1))                          # [d*s]
+    keep = comp != inf
+    if dedupe_key_m:
+        first = jnp.concatenate([jnp.ones(1, bool), comp[1:] != comp[:-1]])
+        pair = comp // jnp.int64(dedupe_key_m)                 # (row,slot,col)
+        firstp = jnp.concatenate([jnp.ones(1, bool), pair[1:] != pair[:-1]])
+        mcode = comp % jnp.int64(codespace)
+        is_key = dom[jnp.clip(mcode // jnp.int64(mq), 0,
+                              dom.shape[0] - 1)] == 0
+        keep = keep & first & (~is_key | firstp)
+    out_pos = jnp.cumsum(keep) - 1
+    merged_row = jnp.where(keep, comp // jnp.int64(codespace), 0)
+    counts = jnp.zeros(b, jnp.int64).at[merged_row].add(
+        keep.astype(jnp.int64), mode="drop")
+    m_end = jnp.cumsum(counts)
+    out = jnp.full(d * s, -1, ents.dtype)
+    out = out.at[jnp.where(keep, out_pos, d * s)].set(
+        (comp % jnp.int64(codespace)).astype(ents.dtype), mode="drop")
+    header = jnp.concatenate(
+        [jnp.stack([m_end[-1], jnp.max(hdrs[:, 1].astype(jnp.int64)),
+                    jnp.max(hdrs[:, 2].astype(jnp.int64)),
+                    jnp.sum(hdrs[:, 3].astype(jnp.int64)),
+                    jnp.sum(hdrs[:, 4].astype(jnp.int64))]).astype(jnp.int32),
+         m_end.astype(jnp.int32)])
+    return header, out
+
+
+_ATTR_SH_CACHE = {}
+
+
+def sharded_flat_attr(mesh: Mesh, m: int, s: int, k: int,
+                      wide: bool = False, floors: bool = True,
+                      elide: bool = True):
+    """Mesh-sharded calculate_deps_flat_attr: slots sharded, attribution
+    columns sharded ALONGSIDE the slots (each shard grades its own slice),
+    the floor/elision index and query batch replicated.  Entries are
+    globalized in-kernel (local code + shard offset) and merged on device;
+    the host sees one block with GLOBAL slot codes.
+
+    Returns fn(table, attr, aidx, qmat, rankb, pm, pl, pn) ->
+    (header int32[5+B] replicated, entries [d*s] replicated)."""
+    from ..ops import deps_kernel as dk
+    dev_key = tuple(d.id for d in mesh.devices.flat)
+    key = ("flat", dev_key, m, s, k, wide, floors, elide)
+    fn = _ATTR_SH_CACHE.get(key)
+    if fn is not None:
+        return fn
+    d = int(np.prod(list(mesh.shape.values())))
+    table_specs = DepsTable(P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS, None), P(STORE_AXIS, None))
+    attr_specs = dk.AttrCols(*([P(STORE_AXIS)] * 9))
+    aidx_specs = dk.AttrIndex(*([P()] * 11))
+
+    def local(table, attr, aidx, qmat, rankb, pm, pl, pn):
+        hdr, ent = dk.flat_attr_local(table, attr, aidx, qmat, rankb,
+                                      m, s, k, (pm, pl, pn), wide=wide,
+                                      floors=floors, elide=elide)
+        shard_n = table.msb.shape[0]
+        m_t = table.lo.shape[1]
+        off = lax.axis_index(STORE_AXIS).astype(ent.dtype) \
+            * shard_n * m_t * m
+        ent = jnp.where(ent >= 0, ent + off, ent)
+        hdrs = lax.all_gather(hdr, STORE_AXIS, axis=0)        # [d, 5+B]
+        ents = lax.all_gather(ent, STORE_AXIS, axis=0)        # [d, s]
+        b = qmat.shape[0]
+        codespace = d * shard_n * m_t * m
+        return _merge_shard_blocks(hdrs, ents, b, s, codespace, 0)
+
+    fn = jax.jit(_shard_map(local, mesh,
+                            (table_specs, attr_specs, aidx_specs,
+                             P(), P(), P(), P(), P()),
+                            (P(), P())))
+    _ATTR_SH_CACHE[key] = fn
+    return fn
+
+
+def sharded_bucketed_attr(mesh: Mesh, m: int, span: int, s: int, k: int,
+                          m_t: int, keff: int, wide: bool = False,
+                          floors: bool = True, elide: bool = True):
+    """Mesh-sharded bucketed_attr: bucket rows and the wide list sharded as
+    in sharded_bucketed_flat; the attribution columns are REPLICATED (the
+    entries carry global slot ids, and a shard must grade slots whose rows
+    it does not own), the floor/elision index replicated.  The on-device
+    merge removes cross-shard duplicates (one triple reachable via bucket
+    rows on different shards) and applies the key-domain (slot, col) dedupe
+    across shards — the host-side global triple dedupe has nothing left to
+    do.
+
+    The entry TOKEN (a key dep's own footprint point) lives in the
+    slot-sharded interval table, so each shard contributes the tokens of
+    the slots it owns and a psum assembles the full per-entry token
+    column — the [N, M] interval matrix itself stays sharded.
+
+    Returns fn(buckets, table, attr, aidx, qmat, rankb, pm, pl, pn) ->
+    (header int32[5+B] replicated, entries [d*s] replicated)."""
+    from ..ops import deps_kernel as dk
+    dev_key = tuple(dv.id for dv in mesh.devices.flat)
+    key = ("buck", dev_key, m, span, s, k, m_t, keff, wide,
+           floors, elide)
+    fn = _ATTR_SH_CACHE.get(key)
+    if fn is not None:
+        return fn
+    d = int(np.prod(list(mesh.shape.values())))
+    bucket_specs = BucketTable(*([P(STORE_AXIS, None)] * 8),
+                               *([P(STORE_AXIS)] * 8))
+    table_specs = DepsTable(P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS, None), P(STORE_AXIS, None))
+    attr_specs = dk.AttrCols(*([P()] * 9))
+    aidx_specs = dk.AttrIndex(*([P()] * 11))
+
+    def local(buckets, table, attr, aidx, qmat, rankb, pm, pl, pn):
+        off = lax.axis_index(STORE_AXIS).astype(jnp.int32) \
+            * buckets.blo.shape[0]
+        hdr_raw, ent = dk.bucketed_flat(None, buckets, qmat, m, span, s,
+                                        k, (pm, pl, pn), row_offset=off,
+                                        keff=keff, wide=wide, m_t=m_t)
+        # per-entry token via cross-shard psum over the WHOLE gathered
+        # entry set: every shard's entries reference global slots, so the
+        # codes are all-gathered first, each shard contributes
+        # lo[slot, col] for the slots its slice owns (zero elsewhere),
+        # and the psum assembles the complete [d, s] token matrix — each
+        # shard then attributes its own row
+        ents_all = lax.all_gather(ent, STORE_AXIS, axis=0)   # [d, s]
+        n_local = table.lo.shape[0]
+        soff = lax.axis_index(STORE_AXIS).astype(jnp.int64) * n_local
+        code = ents_all.astype(jnp.int64)
+        mq = m_t * m
+        slot = jnp.clip(code // mq, 0)
+        col = jnp.clip(code % mq // m, 0, m_t - 1)
+        mine = (slot >= soff) & (slot < soff + n_local) & (code >= 0)
+        lslot = jnp.clip(slot - soff, 0, n_local - 1)
+        tok_all = lax.psum(jnp.where(mine, table.lo[lslot, col], 0),
+                           STORE_AXIS)                       # [d, s]
+        me = lax.axis_index(STORE_AXIS)
+        hdr, ent = dk._attr_post(None, attr, aidx, rankb, hdr_raw, ent,
+                                 m_t, m, floors, elide, tok=tok_all[me])
+        hdrs = lax.all_gather(hdr, STORE_AXIS, axis=0)
+        ents = lax.all_gather(ent, STORE_AXIS, axis=0)
+        b = qmat.shape[0]
+        codespace = attr.dom.shape[0] * m_t * m
+        return _merge_shard_blocks(hdrs, ents, b, s, codespace,
+                                   m, dom=attr.dom, mq=m_t * m)
+
+    fn = jax.jit(_shard_map(local, mesh,
+                            (bucket_specs, table_specs, attr_specs,
+                             aidx_specs, P(), P(), P(), P(), P()),
+                            (P(), P())))
+    _ATTR_SH_CACHE[key] = fn
+    return fn
+
+
+def sharded_fused_attr(mesh: Mesh, n_stores: int, m: int, s: int, k: int,
+                       wide: bool = False, floors: bool = True,
+                       elide: bool = True):
+    """Batched-over-stores sharded_flat_attr — the r08 fused launch with
+    the attribution pass and the on-device cross-shard merge.  Store row i
+    of the outputs is the solo sharded_flat_attr answer for store i (codes
+    on the GROUP interval width m_max).
+
+    Returns fn(*tables, *attrs, *aidxs, qmats, rankbs, pm, pl, pn) ->
+    (header int32[S, 5+B] replicated, entries [S, d*s] replicated)."""
+    from ..ops import deps_kernel as dk
+    dev_key = tuple(dv.id for dv in mesh.devices.flat)
+    key = ("fused", dev_key, n_stores, m, s, k, wide, floors, elide)
+    fn = _ATTR_SH_CACHE.get(key)
+    if fn is not None:
+        return fn
+    d = int(np.prod(list(mesh.shape.values())))
+    table_specs = DepsTable(P(STORE_AXIS), P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS), P(STORE_AXIS),
+                            P(STORE_AXIS, None), P(STORE_AXIS, None))
+    attr_specs = dk.AttrCols(*([P(STORE_AXIS)] * 9))
+    aidx_specs = dk.AttrIndex(*([P()] * 11))
+    in_specs = tuple([table_specs] * n_stores) \
+        + tuple([attr_specs] * n_stores) \
+        + tuple([aidx_specs] * n_stores) + (P(), P(), P(), P(), P())
+
+    def local(*args):
+        tables = args[:n_stores]
+        attrs = args[n_stores:2 * n_stores]
+        aidxs = args[2 * n_stores:3 * n_stores]
+        qmats, rankbs, pm, pl, pn = args[3 * n_stores:]
+        n_max = max(t.msb.shape[0] for t in tables)
+        m_max = max(t.lo.shape[1] for t in tables)
+        f_max = max(a.fbnd.shape[0] for a in aidxs)
+        t_max = max(a.etok.shape[0] for a in aidxs)
+        l_max = max(a.erank.shape[0] for a in aidxs)
+        padded = [dk._pad_table_cols(tuple(t), n_max, m_max)
+                  for t in tables]
+        stacked = DepsTable(*(jnp.stack(col) for col in zip(*padded)))
+        pa = [dk._pad_attr_cols(tuple(a), n_max) for a in attrs]
+        stacked_a = dk.AttrCols(*(jnp.stack(col) for col in zip(*pa)))
+        pi = [dk._pad_attr_index(a, f_max, t_max, l_max) for a in aidxs]
+        stacked_i = dk.AttrIndex(*(jnp.stack(col) for col in zip(*pi)))
+        hdr, ent = jax.vmap(
+            lambda t, a, i, q, r, x, y, z: dk.flat_attr_local(
+                t, a, i, q, r, m, s, k, (x, y, z), wide=wide,
+                floors=floors, elide=elide)
+        )(stacked, stacked_a, stacked_i, qmats, rankbs, pm, pl, pn)
+        off = lax.axis_index(STORE_AXIS).astype(ent.dtype) \
+            * n_max * m_max * m
+        ent = jnp.where(ent >= 0, ent + off, ent)
+        hdrs = lax.all_gather(hdr, STORE_AXIS, axis=0)       # [d, S, 5+B]
+        ents = lax.all_gather(ent, STORE_AXIS, axis=0)       # [d, S, s]
+        b = qmats.shape[1]
+        codespace = d * n_max * m_max * m
+        return jax.vmap(
+            lambda h, e: _merge_shard_blocks(h, e, b, s, codespace, 0)
+        )(hdrs.swapaxes(0, 1), ents.swapaxes(0, 1))
+
+    fn = jax.jit(_shard_map(local, mesh, in_specs, (P(), P())))
+    _ATTR_SH_CACHE[key] = fn
+    return fn

@@ -385,7 +385,7 @@ def bench_hot_keys():
 
     for batch in batches:
         pending.append((dev.deps_query_batch_begin(
-            batch, prune_floors=True), batch))
+            batch, prune_floors=True, attributed=True), batch))
         if len(pending) >= 2:
             n_deps += collect3(*pending.pop(0))
     while pending:
@@ -857,7 +857,8 @@ def main(em: Emitter):
 
         for batch in batches:
             t1 = time.time()
-            handle = dev.deps_query_batch_begin(batch)
+            handle = dev.deps_query_batch_begin(batch, prune_floors=True,
+                                                attributed=True)
             phases["begin"] += time.time() - t1
             pending.append((handle, batch))
             if len(pending) >= PIPELINE:

@@ -364,3 +364,69 @@ def test_device_fault_context_restores_prior_arming():
     finally:
         faults.clear_device_faults()
     assert faults.active_device_faults() == {}
+
+
+# ---------------------------------------------------------------------------
+# r15: faults during the ATTRIBUTED collect (the in-kernel
+# floors/elision path every protocol flush now rides)
+# ---------------------------------------------------------------------------
+
+def _attr_blocks(dev, safe, qs):
+    from tests.test_routing import _attributed_blocks
+    return _attributed_blocks(dev, safe, qs, prune=True)
+
+
+@pytest.mark.parametrize("route", ROUTES)
+@pytest.mark.parametrize("kind", RAISING)
+def test_attr_collect_fault_fails_whole_flush_to_host(route, kind):
+    """Launch/transfer faults at p=1.0 during an ATTRIBUTED flush: the
+    WHOLE flush fails over to the host attribution path (same bytes —
+    the host filter applies the identical floor/elision drops), then the
+    store quarantines."""
+    store, dev, safe, entries, floor, qs = _build(seed=53)
+    dev.route_override = route
+    expect = _attr_blocks(dev, safe, qs)
+    with faults.device_fault(kind, 1.0, _rng()):
+        got = _attr_blocks(dev, safe, qs)
+    assert got == expect
+    if route == "host":
+        assert dev.n_device_faults == 0
+    else:
+        assert dev.n_device_faults >= 1
+        assert dev.n_quarantines >= 1
+        assert dev.n_fallback_queries >= len(qs)
+
+
+@pytest.mark.parametrize("route", ("device", "dense"))
+def test_attr_stale_result_detected_by_shadow(route):
+    """Injected stale results inside an attributed collect: paranoia
+    shadow-verifies the pre-attributed entry set against the host filter
+    and serves the host answer — bytes never change."""
+    store, dev, safe, entries, floor, qs = _build(seed=53)
+    dev.route_override = route
+    expect = _attr_blocks(dev, safe, qs)
+    dev.paranoia = True
+    with faults.device_fault("stale_result", 1.0, _rng()):
+        got = _attr_blocks(dev, safe, qs)
+    assert got == expect
+    assert dev.n_shadow_mismatches >= 1
+    assert dev.n_quarantines >= 1
+
+
+def test_attr_quarantine_recovers_and_serves_device_again():
+    """After an attributed-collect fault the quarantine expires, the next
+    device flush is the probe, and a healthy device serves attributed
+    blocks again — all byte-identical throughout."""
+    store, dev, safe, entries, floor, qs = _build(seed=53)
+    dev.route_override = "dense"
+    expect = _attr_blocks(dev, safe, qs)
+    with faults.device_fault("transfer", 1.0, _rng()):
+        assert _attr_blocks(dev, safe, qs) == expect
+    assert dev._dev_quar_flushes > 0
+    while dev._dev_quar_flushes > 0:
+        assert _attr_blocks(dev, safe, qs) == expect
+    assert _attr_blocks(dev, safe, qs) == expect     # the probe
+    assert dev._dev_backoff == 0 and dev.n_restores >= 1
+    before = dev.n_fallback_queries
+    assert _attr_blocks(dev, safe, qs) == expect     # healthy again
+    assert dev.n_fallback_queries == before

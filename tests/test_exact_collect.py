@@ -284,3 +284,185 @@ def test_download_byte_counters_and_compaction_ratio():
                                         [DepsBuilder() for _ in qs])
     assert dev.download_bytes > 0
     assert dev.download_bytes < dev.download_bytes_padded
+
+
+# -- r15: device-resident attribution + elision -------------------------------
+#
+# The attributed kernels fold per-token RedundantBefore floors, CFK
+# transitive elision and the key dedupe INTO the device program and emit
+# pre-attributed CSR blocks.  The retired host pass (_attribute_batch)
+# survives exactly as _exact_geometry did in r10: as the property-test
+# oracle these sweeps compare every route against, byte-for-byte at the
+# builder level.
+
+from accord_tpu.local.commands_for_key import CommandsForKey
+
+
+def _build_attr_store(rs, mesh=None, n=90, hot=24):
+    """Randomized ELISION-ACTIVE store from one RandomSource: a hot token
+    set dense enough that committed-write pivots, transitive entries and
+    floor positions all land, with the CFK state co-registered (the sync
+    invariant the elision registry leans on).  Returns (dev, safe, qs)."""
+    from accord_tpu.primitives.timestamp import Timestamp
+    store, dev, safe = make_device_state(mesh=mesh)
+    floor_pos = rs.next_int(60 * n)
+    floor_id = TxnId.create(1, 1 + floor_pos, TxnKind.ExclusiveSyncPoint,
+                            Domain.Range, 1)
+    span = 1 + rs.next_int(2 * hot)
+    store.redundant_before.add_redundant(
+        Ranges.of(Range(0, span)), floor_id)
+    seen = set()
+    for _ in range(n):
+        hlc = 1 + rs.next_int(60 * n)
+        while hlc in seen:
+            hlc = 1 + rs.next_int(60 * n)
+        seen.add(hlc)
+        kind = TxnKind.Write if rs.next_int(10) < 7 else TxnKind.Read
+        domain = Domain.Key if rs.next_int(10) < 8 else Domain.Range
+        if domain == Domain.Key:
+            toks = [rs.next_int(hot) for _ in range(1 + rs.next_int(3))]
+            keys = Keys([IntKey(t) for t in toks])
+            rngs = []
+        else:
+            s0 = rs.next_int(hot)
+            rngs = [Range(s0, s0 + 1 + rs.next_int(6))]
+            keys = Ranges.of(*rngs)
+            toks = []
+        tid = TxnId.create(1, hlc, kind, domain, 1 + rs.next_int(5))
+        draw = rs.next_int(10)
+        if draw < 4:
+            status = InternalStatus.PREACCEPTED
+        elif draw < 8:
+            status = InternalStatus.COMMITTED
+        elif draw < 9:
+            status = InternalStatus.TRANSITIVELY_KNOWN
+        else:
+            status = InternalStatus.APPLIED
+        dev.register(tid, int(status), keys)
+        exec_at = None
+        if status >= InternalStatus.COMMITTED:
+            # executeAt sometimes moved off the id (recovery-proposed)
+            exec_at = tid if rs.next_int(4) else Timestamp(
+                tid.msb, tid.lsb + 1 + rs.next_int(50), tid.node)
+            dev.update_status(tid, int(status), execute_at=exec_at)
+        for t in toks:
+            cfk = store.commands_for_key.get(t)
+            if cfk is None:
+                cfk = store.commands_for_key[t] = CommandsForKey(t)
+            cfk.update(tid, status, execute_at=exec_at)
+    qs = []
+    for _ in range(10):
+        bound = TxnId.create(1, 60 * n + rs.next_int(40 * n),
+                             TxnKind.Write, Domain.Key, 1)
+        toks, rngs = [], []
+        for _ in range(1 + rs.next_int(3)):
+            if rs.next_int(10) < 7:
+                toks.append(rs.next_int(hot))
+            else:
+                s0 = rs.next_int(hot)
+                rngs.append(Range(s0, s0 + 1 + rs.next_int(8)))
+        qs.append((bound, bound, bound.kind().witnesses(), toks, rngs))
+    return dev, safe, qs
+
+
+def _builders_out(dev, safe, qs, attributed, route=None):
+    from tests.test_routing import _unpack_builders
+    if route is not None:
+        dev.route_override = route
+    builders = [DepsBuilder() for _ in qs]
+    h = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=True,
+                                   attributed=attributed)
+    dev.deps_query_batch_end_attributed(safe, h, builders)
+    return _unpack_builders(builders)
+
+
+def test_attributed_blocks_match_oracle_property():
+    """Seeded property sweep (tests/proptest.py run_property): on a
+    randomized elision-active store — random floor positions, committed
+    writes with moved executeAts, transitive entries, point AND range
+    queries — every route's device-attributed blocks build byte-equal
+    Deps to the retired host oracle."""
+    from tests.proptest import case_budget, run_property
+
+    def make_case(rs):
+        return rs.seed()
+
+    def check(seed):
+        rs = RandomSource(seed)
+        dev, safe, qs = _build_attr_store(rs, mesh=None)
+        oracle = _builders_out(dev, safe, qs, False, route="host")
+        for route in ("host", "dense", "bucketed"):
+            got = _builders_out(dev, safe, qs, True, route=route)
+            assert got == oracle, f"route={route}"
+
+    run_property(case_budget(25), 0xA77B, make_case, check,
+                 replay_hint="tests/test_exact_collect.py "
+                             "test_attributed_blocks_match_oracle_property")
+
+
+def test_attributed_mesh_routes_match_oracle():
+    """The mesh-sharded attributed kernels — slot-sharded dense and
+    row-sharded bucketed, with the cross-shard merge ON DEVICE — build
+    byte-equal Deps to the host oracle on an elision-active store."""
+    rs = RandomSource(0x51AB)
+    dev, safe, qs = _build_attr_store(rs, mesh="auto")
+    if dev.mesh is None:
+        pytest.skip("virtual mesh unavailable")
+    oracle = _builders_out(dev, safe, qs, False, route="host")
+    for route in ("host", "dense", "bucketed"):
+        assert _builders_out(dev, safe, qs, True, route=route) == oracle, \
+            f"mesh route={route}"
+
+
+def test_attributed_int32_int64_crossover(monkeypatch):
+    """Lowering the int32 code ceiling flips the attributed kernels to
+    int64 entries; results stay byte-identical (the dtype is wire format,
+    never semantics)."""
+    rs = RandomSource(0xC0DE)
+    dev, safe, qs = _build_attr_store(rs, mesh=None)
+    narrow = _builders_out(dev, safe, qs, True, route="dense")
+    monkeypatch.setattr(dk, "INT32_CODE_MAX", 16)
+    wide = _builders_out(dev, safe, qs, True, route="dense")
+    buck = _builders_out(dev, safe, qs, True, route="bucketed")
+    assert narrow == wide == buck
+
+
+def test_attributed_overflow_rerun_interleaving():
+    """An attributed flush whose learned s/k overflow forces the
+    exact-header-sized re-run — with registrations landing BETWEEN begin
+    and end — still answers for the begin-time snapshot, byte-equal to
+    the oracle computed at begin."""
+    rs = RandomSource(0x0F10)
+    dev, safe, qs = _build_attr_store(rs, mesh=None)
+    oracle = _builders_out(dev, safe, qs, False, route="host")
+    for route in ("dense", "bucketed"):
+        dev.route_override = route
+        dev._batch_flat, dev._batch_k = 16, 2     # guaranteed overflow
+        builders = [DepsBuilder() for _ in qs]
+        h = dev.deps_query_batch_begin(qs, prune_floors=True,
+                                       attributed=True)
+        # interleaved registration: must not shift the queried snapshot
+        late = TxnId.create(1, 7, TxnKind.Write, Domain.Key, 3)
+        dev.register(late, int(InternalStatus.PREACCEPTED),
+                     Keys([IntKey(1)]))
+        dev.deps_query_batch_end_attributed(safe, h, builders)
+        from tests.test_routing import _unpack_builders
+        assert _unpack_builders(builders) == oracle, route
+        dev.free(late)
+
+
+def test_attributed_elision_counters_count():
+    """The elided-row counters (eknown/emsb legs) move on a store where
+    elision provably fires, on the kernel routes AND the host route, and
+    attributed downloads are accounted."""
+    rs = RandomSource(0xE11D)
+    dev, safe, qs = _build_attr_store(rs, mesh=None)
+    base_t, base_d = dev.n_elided_transitive, dev.n_elided_decided
+    _builders_out(dev, safe, qs, True, route="host")
+    host_moved = (dev.n_elided_transitive + dev.n_elided_decided
+                  - base_t - base_d)
+    _builders_out(dev, safe, qs, True, route="dense")
+    dense_moved = (dev.n_elided_transitive + dev.n_elided_decided
+                   - base_t - base_d - host_moved)
+    assert host_moved > 0 and dense_moved > 0
+    assert dev.attr_download_bytes > 0

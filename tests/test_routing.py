@@ -343,3 +343,51 @@ def test_adaptive_route_is_invisible():
     for a, b in zip(want, got):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert dev.n_queries == len(qs) * 2
+
+
+# -- r15: attributed-block route identity -------------------------------------
+
+def _attributed_blocks(dev, safe, qs, prune):
+    """The r15 ATTRIBUTED path (floors/elision/dedupe in-kernel, thin
+    shared finalize) — same output surface as the legacy oracle pass."""
+    builders = [DepsBuilder() for _ in qs]
+    h = dev.deps_query_batch_begin(qs, immediate=True, prune_floors=prune,
+                                   attributed=True)
+    dev.deps_query_batch_end_attributed(safe, h, builders)
+    return _unpack_builders(builders)
+
+
+@pytest.mark.parametrize("seed", [11, 47])
+def test_attributed_routes_bit_identical(seed):
+    """Every route's ATTRIBUTED blocks — host filter, dense/bucketed
+    in-kernel attribution, mesh-merged variants — build byte-equal Deps
+    to the legacy host oracle (_attribute_batch), which survives exactly
+    as _exact_geometry did in r10: as this test's reference."""
+    store, dev, safe, entries, floor, qs = _build(seed)
+    dev.route_override = "host"
+    oracle = _attributed(dev, safe, qs, prune=True)
+    for mesh in (dev.mesh, None):
+        dev.mesh = mesh
+        for route in ROUTES:
+            dev.route_override = route
+            got = _attributed_blocks(dev, safe, qs, prune=True)
+            assert got == oracle, f"route={route} mesh={mesh is not None}"
+
+
+def test_attributed_fused_matches_solo():
+    """Fused ATTRIBUTED launches (the dispatcher's coalesced path, now
+    running fused_flat_attr / sharded_fused_attr with the on-device
+    merge) build the same bytes as the solo oracle for every member."""
+    from tests.conftest import make_dispatch_node
+    node, stores = make_dispatch_node((11, 23, 47), fusion=True)
+    oracles = [_attributed(dev, safe, qs, prune=True)
+               for dev, safe, qs in stores]
+    outs = []
+    for dev, _safe, qs in stores:
+        builders, failures = _enqueue_flush(dev, qs)
+        outs.append((builders, failures))
+    node.scheduler.run()
+    assert node.dispatcher.n_fused_launches >= 1
+    for (builders, failures), oracle in zip(outs, oracles):
+        assert not failures
+        assert _unpack_builders(builders) == oracle

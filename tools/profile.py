@@ -175,18 +175,21 @@ def mode_headline(args):
         phase("download(entry prefix)",
               lambda: _fetch_entry_prefix(out_dev[1], 1, s,
                                           int(hdr_np[0])))
-        res = phase("begin+collect(e2e)", lambda: dev._batch_collect(
-            dev.deps_query_batch_begin(queries)))
-        b_idx, j_idx, overlap, ids, ivs, qnp2, qs = res
-        print(f"pairs after keep: {len(j_idx)}", file=sys.stderr)
+        res = phase("begin+collect(attributed)",
+                    lambda: dev._batch_collect_attr(
+                        dev.deps_query_batch_begin(queries,
+                                                   prune_floors=True,
+                                                   attributed=True)))
+        tb, tj, tm, tq, ids, ivs, qnp2, q_m2, qs = res
+        print(f"attributed entries: {len(tj)}", file=sys.stderr)
 
         def attr():
             builders = [DepsBuilder() for _ in queries]
-            dev._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs,
-                                 qnp2, qs, builders)
+            dev._finalize_attr_entries(tb, tj, tm, tq, ids, ivs, qnp2,
+                                       q_m2, builders)
             return builders
 
-        builders = phase("attribute", attr)
+        builders = phase("finalize(attributed)", attr)
         phase("build-all", lambda: [b.build() for b in builders])
 
         def full():
@@ -200,23 +203,48 @@ def mode_headline(args):
 
 
 def mode_attr(args):
+    """The r15 ATTRIBUTED path under the lens: per-stage timing of the
+    pre-attributed collect (decode of the in-kernel floored/elided CSR)
+    and the thin shared finalize, next to the retired host oracle
+    (_attribute_batch) for an apples-to-apples of what moved on device."""
     from accord_tpu.primitives.deps import DepsBuilder
 
     store, dev, safe, keyspace, m = build_headline(args.n)
     queries = headline_queries(args.batch, keyspace, m)
     dev.deps_query_batch_attributed(safe, queries,
                                     [DepsBuilder() for _ in queries])
-    res = dev._batch_collect(dev.deps_query_batch_begin(queries))
-    b_idx, j_idx, overlap, ids, ivs, qnp2, qs = res
+    tb, tj, tm, tq, ids, ivs, qnp2, q_m2, _qs = \
+        phase("collect(attributed)",
+              lambda: dev._batch_collect_attr(
+                  dev.deps_query_batch_begin(queries, immediate=True,
+                                             prune_floors=True,
+                                             attributed=True)))
+    print(f"attributed entries: {len(tj)} "
+          f"(elided t={dev.n_elided_transitive} d={dev.n_elided_decided})",
+          file=sys.stderr)
 
-    def attr():
+    def finalize():
         builders = [DepsBuilder() for _ in queries]
-        dev._attribute_batch(safe, b_idx, j_idx, overlap, ids, ivs, qnp2,
-                             qs, builders)
+        dev._finalize_attr_entries(tb, tj, tm, tq, ids, ivs, qnp2, q_m2,
+                                   builders)
 
-    attr()   # warm
-    phase("attribute", attr)
-    maybe_cprofile(True, attr, top=args.top or 25, sort="cumulative")
+    finalize()   # warm
+    phase("finalize(attributed)", finalize)
+
+    # the retired oracle, for comparison: raw collect + the host
+    # attribute re-sort the kernels replaced
+    res = dev._batch_collect(dev.deps_query_batch_begin(queries))
+    b_idx, j_idx, overlap, ids0, ivs0, qnp0, qs0 = res
+
+    def oracle():
+        builders = [DepsBuilder() for _ in queries]
+        dev._attribute_batch(safe, b_idx, j_idx, overlap, ids0, ivs0,
+                             qnp0, qs0, builders)
+
+    oracle()   # warm
+    phase("oracle(_attribute_batch)", oracle)
+    maybe_cprofile(args.cprofile, finalize, top=args.top or 25,
+                   sort="cumulative")
     print_index(dev)
 
 
@@ -235,7 +263,8 @@ def mode_hot(args):
     with maybe_trace(args.trace):
         for bi, batch in enumerate(batches):
             t0 = time.time()
-            handle = dev.deps_query_batch_begin(batch, prune_floors=True)
+            handle = dev.deps_query_batch_begin(batch, prune_floors=True,
+                                                attributed=True)
             t1 = time.time()
             builders = [DepsBuilder() for _ in batch]
             dev.deps_query_batch_end_attributed(safe, handle, builders)
@@ -248,7 +277,8 @@ def mode_hot(args):
 
         def one():
             builders = [DepsBuilder() for _ in batches[0]]
-            h = dev.deps_query_batch_begin(batches[0], prune_floors=True)
+            h = dev.deps_query_batch_begin(batches[0], prune_floors=True,
+                                            attributed=True)
             dev.deps_query_batch_end_attributed(safe, h, builders)
 
         maybe_cprofile(args.cprofile, one, top=10)
