@@ -31,6 +31,7 @@ sim's restart tests already exercise.
 
 from __future__ import annotations
 
+import os
 import sys
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -38,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 from .. import wire
 from ..local.journal import Journal, _Bodies, _Registers
 from ..local.status import SaveStatus
+from ..primitives.timestamp import TxnId
 from ..sim.kvstore import KVDataStore
 from .commit import GroupCommit
 from .wal import DEFAULT_SEGMENT_BYTES, WriteAheadLog
@@ -47,7 +49,13 @@ from .wal import DEFAULT_SEGMENT_BYTES, WriteAheadLog
 # most recent replies keep the at-most-once contract exact while a soak
 # can't grow the table forever
 REPLIED_CAP = 65536
-DEFAULT_SNAPSHOT_EVERY = 8192          # WAL records between snapshots
+# WAL records between snapshots.  The interval exists to bound the
+# kill -9 rejoin wall (replay = records x replay rate) against the cost
+# of a whole-state capture; r13 set 8192 against ~4.8k records/s of JSON
+# replay, and the r16 binary record codec replays ~5x faster — same
+# rejoin bound, 4x fewer whole-state walks (each is O(total state), the
+# dominant journal tax once command state has grown)
+DEFAULT_SNAPSHOT_EVERY = 32768
 
 
 class DurableJournal(Journal):
@@ -105,6 +113,16 @@ class DurableJournal(Journal):
         self.commit = GroupCommit(self.wal, defer=defer,
                                   window_micros=window_micros,
                                   metrics=metrics, async_exec=async_exec)
+        # r16: register rows are LATEST-WINS facts (replay installs the
+        # last row per (store, txn)), so one group-commit window's worth
+        # of transitions for one command serializes once, drained into
+        # the batch by the commit's pre_flush hook.  Crash-equivalent:
+        # everything appended since the last flush dies together anyway
+        # (the r13 crash sweep already pins message-present/register-
+        # stale truncation points as valid recovery states).
+        self._pending_regs: Dict[tuple, object] = {}
+        self.commit.pre_flush = self._drain_pending_registers
+        self.commit.deferred_pending = lambda: bool(self._pending_regs)
         from . import recover as recover_mod
         self.replay_stats = recover_mod.replay(self)
         self._snap_floor = self.replay_stats["snapshot_floor"]
@@ -147,8 +165,15 @@ class DurableJournal(Journal):
                 # base class routes it there; journaling here too would
                 # double-record the fact)
                 try:
+                    # r16: a request that arrived over the wire carries
+                    # its own encoded doc (decode∘encode is the identity,
+                    # pinned by the golden-frame gate) — re-encoding the
+                    # whole payload tree per record was a first-order
+                    # journal tax on the serving path
+                    doc = getattr(request, "_wire_doc", None)
                     self._append({"k": "msg", "f": from_id,
-                                  "p": wire.encode(request)})
+                                  "p": doc if doc is not None
+                                  else wire.encode(request)})
                 except TypeError as exc:
                     # a side-effecting verb without a wire codec: loud
                     # once, never fatal (the in-memory journal still
@@ -167,14 +192,47 @@ class DurableJournal(Journal):
 
     def record_registers(self, store_id: int, command) -> None:
         if not self._replaying:
-            self._append({"k": "reg", "sid": store_id,
-                          "t": wire.encode(command.txn_id),
-                          "ss": wire.encode(command.save_status),
-                          "ex": wire.encode(command.execute_at),
-                          "pr": wire.encode(command.promised),
-                          "ac": wire.encode(command.accepted),
-                          "du": wire.encode(command.durability)})
+            if self.commit.failed:
+                # degraded journal: no window ever drains again, so a
+                # parked Command per (store, txn) would leak forever on
+                # exactly the degraded-but-alive node the bounded-memory
+                # contract covers
+                self._pending_regs.clear()
+            else:
+                # park the command snapshot (immutable value object): the
+                # window-close drain serializes only the LAST row per
+                # (store, txn) — back-to-back transitions (commit+stable
+                # in one message) cost one WAL record, not one each
+                self._pending_regs[(store_id, command.txn_id)] = command
+                self.commit.schedule_window()
         super().record_registers(store_id, command)
+
+    def _drain_pending_registers(self) -> None:
+        if not self._pending_regs:
+            return
+        pend, self._pending_regs = self._pending_regs, {}
+        for (store_id, _txn_id), command in pend.items():
+            # columnar v2 row: raw (msb, lsb, node) triples + enum NAMES
+            # instead of six generic wire.encode walks — reg rows are
+            # over half the WAL's records, and this was the serving
+            # path's biggest per-record cost.  apply_record keeps the
+            # r13 keyed shape decoding forever (journals outlive code).
+            t = command.txn_id
+            ex = command.execute_at
+            pr = command.promised
+            ac = command.accepted
+            self._append({"k": "reg", "c": [
+                store_id, [t.msb, t.lsb, t.node],
+                command.save_status.name,
+                # executeAt may literally BE the TxnId (the fast path);
+                # a 4th element tags that so replay rebuilds the exact
+                # type the live journal held (byte-identity contract)
+                None if ex is None else
+                ([ex.msb, ex.lsb, ex.node, 1] if isinstance(ex, TxnId)
+                 else [ex.msb, ex.lsb, ex.node]),
+                None if pr is None else [pr.msb, pr.lsb, pr.node],
+                None if ac is None else [ac.msb, ac.lsb, ac.node],
+                command.durability.name]})
 
     def record_watermarks(self, store_id: int, durable_entries: list,
                           redundant_entries: list) -> None:
@@ -272,10 +330,28 @@ class DurableJournal(Journal):
             self.record_propagate(wire.decode(doc["t"]),
                                   wire.decode(doc["ok"]))
         elif k == "reg":
-            self._install_register(
-                doc["sid"], wire.decode(doc["t"]), wire.decode(doc["ss"]),
-                wire.decode(doc["ex"]), wire.decode(doc["pr"]),
-                wire.decode(doc["ac"]), wire.decode(doc["du"]))
+            if "c" in doc:
+                from ..local.status import Durability
+                from ..primitives.timestamp import Ballot, Timestamp, TxnId
+                sid, t, ss, ex, pr, ac, du = doc["c"]
+                if ex is None:
+                    ex_v = None
+                elif len(ex) == 4:
+                    ex_v = TxnId(ex[0], ex[1], ex[2])
+                else:
+                    ex_v = Timestamp(*ex)
+                self._install_register(
+                    sid, TxnId(*t), SaveStatus[ss], ex_v,
+                    None if pr is None else Ballot(*pr),
+                    None if ac is None else Ballot(*ac),
+                    Durability[du])
+            else:
+                # r13/r16 keyed shape: journals on disk outlive code
+                self._install_register(
+                    doc["sid"], wire.decode(doc["t"]),
+                    wire.decode(doc["ss"]), wire.decode(doc["ex"]),
+                    wire.decode(doc["pr"]), wire.decode(doc["ac"]),
+                    wire.decode(doc["du"]))
         elif k == "wm":
             super().record_watermarks(
                 doc["sid"],
@@ -321,13 +397,24 @@ class DurableJournal(Journal):
     # -- whole-state serialization (the snapshot payload) --------------------
     def encode_state(self, data_store: Optional[KVDataStore] = None) -> dict:
         enc = wire.encode
+
+        def enc_req(x):
+            # a wire-arrived request carries its own encoded doc
+            # (decode∘encode is the identity per the golden-frame gate —
+            # the same premise record_message already banks on); the
+            # whole-state walk re-encoding every body tree was the
+            # snapshot's dominant cost
+            d = getattr(x, "_wire_doc", None)
+            return d if d is not None else enc(x)
+
         bodies = []
         for txn_id in sorted(self._bodies):
             b = self._bodies[txn_id]
             bodies.append([enc(txn_id), {
                 "txn": enc(b.txn), "route": enc(b.route),
-                "accepts": [[enc(bal), enc(req)] for bal, req in b.accepts],
-                "commit": enc(b.commit), "apply": enc(b.apply),
+                "accepts": [[enc(bal), enc_req(req)]
+                            for bal, req in b.accepts],
+                "commit": enc_req(b.commit), "apply": enc_req(b.apply),
                 "prop": enc(b.propagate)}])
         registers = []
         for sid in sorted(self._registers):
@@ -413,21 +500,43 @@ class DurableJournal(Journal):
 
     # -- snapshot + compaction ----------------------------------------------
     def maybe_snapshot(self, data_store: Optional[KVDataStore] = None,
-                       force: bool = False) -> bool:
+                       force: bool = False, busy: bool = False) -> bool:
         """Write a snapshot when enough WAL has accumulated since the last
-        floor; recycle every segment the new floor strands.  The state is
-        captured on the calling (protocol) thread — consistency — but the
-        file write + fsync ride the commit's worker when one is wired:
-        an inline multi-ms snapshot fsync would stall every peer and
-        client on the single event loop (the same stall class the async
-        group commit exists to avoid)."""
+        floor; recycle every segment the new floor strands.
+
+        Serving path (``async_exec`` wired, POSIX): the capture forks —
+        the child encodes + writes + ``_exit``s against the fork-instant
+        copy-on-write image (the BGSAVE shape), so the whole-state
+        ``encode_state`` walk (measured: 300-600ms once the command state
+        has grown) never stalls the protocol thread, and consistency is
+        the fork's memory snapshot instead of a loop-thread capture.  The
+        parent polls for the child and advances the floor on success.
+
+        Fallback (no fork / fork failed): the state is captured on the
+        calling (protocol) thread — consistency — and the file write +
+        fsync ride the commit's worker when one is wired: an inline
+        multi-ms snapshot fsync would stall every peer and client on the
+        single event loop (the same stall class the async group commit
+        exists to avoid)."""
         if self.commit.failed or self._replaying or self._snap_inflight:
             return False
         since = self.wal.tail_seq - self._snap_floor
         if not force and since < self.snapshot_every:
             return False
+        if busy and not force and since < 4 * self.snapshot_every:
+            # maintenance yields to traffic (the compaction-throttling
+            # discipline): a loaded node defers the whole-state walk to
+            # the next load valley — replay stays bounded by the 4x hard
+            # cap, past which the snapshot runs regardless
+            return False
         from .snapshot import write_snapshot
         floor = self.wal.tail_seq
+        if (self.commit.async_exec is not None
+                and self.commit.defer is not None and hasattr(os, "fork")):
+            forked = self._snapshot_in_child(data_store, floor)
+            if forked:
+                return True
+            # fork failed: fall through to the capture-on-thread paths
         state = self.encode_state(data_store)
         if self.commit.async_exec is not None:
             self._snap_inflight = True
@@ -455,6 +564,64 @@ class DurableJournal(Journal):
             return False
         self._snap_floor = floor
         self.wal.drop_below(floor)
+        return True
+
+    def _snapshot_in_child(self, data_store, floor: int) -> bool:
+        """Fork; the child serializes the fork-instant state and writes
+        the snapshot file, the parent polls and owns the floor advance.
+        Returns False when the fork itself failed (caller falls back)."""
+        from .snapshot import write_snapshot
+        try:
+            import warnings
+            with warnings.catch_warnings():
+                # jax warns on ANY os.fork in a process with its
+                # threads; this child never touches jax (or any lock a
+                # worker thread could hold at fork) — it runs pure-python
+                # encode + raw file IO and os._exit()s
+                warnings.simplefilter("ignore", RuntimeWarning)
+                pid = os.fork()
+        except OSError as exc:
+            print(f"[journal] snapshot fork failed: {exc!r}",
+                  file=sys.stderr)
+            return False
+        if pid == 0:
+            # child: encode + write + _exit.  os._exit is REQUIRED — a
+            # normal exit would flush the forked copy of the WAL's
+            # buffered writer into the SHARED file offset (duplicate
+            # bytes under the parent's tail).  No metrics (the parent
+            # accounts on reap), no loop, no locks beyond a fresh GIL.
+            code = 0
+            try:
+                write_snapshot(self.directory, floor,
+                               self.encode_state(data_store), metrics=None)
+            except BaseException:
+                code = 1
+            os._exit(code)
+        self._snap_inflight = True
+
+        def _reap() -> None:
+            try:
+                done_pid, status = os.waitpid(pid, os.WNOHANG)
+                if done_pid == 0:
+                    self.commit.defer(0.05, _reap)
+                    return
+                ok = os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0
+            except ChildProcessError:
+                # reaped elsewhere (a stray SIGCHLD handler): trust the
+                # artifact, not the lost exit status
+                ok = os.path.exists(os.path.join(
+                    self.directory, f"snap-{floor:016d}.snap"))
+            self._snap_inflight = False
+            if ok:
+                if self.metrics is not None:
+                    self.metrics.counter("journal_snapshots").inc()
+                    self.metrics.gauge("journal_snapshot_floor").set(floor)
+                self._snap_floor = floor
+                self.wal.drop_below(floor)
+            else:
+                print("[journal] snapshot child failed", file=sys.stderr)
+
+        self.commit.defer(0.05, _reap)
         return True
 
     # -- surface -------------------------------------------------------------

@@ -23,12 +23,12 @@ file under the final name.
 
 from __future__ import annotations
 
-import json
 import os
 import re
 import zlib
 from typing import List, Optional, Tuple
 
+from . import record as rec_mod
 from . import segment as seg_mod
 from .segment import fsync_dir, frame
 
@@ -48,8 +48,12 @@ def _snap_paths(directory: str) -> List[Tuple[int, str]]:
 def write_snapshot(directory: str, floor_seq: int, state: dict,
                    metrics=None) -> str:
     """Durably persist ``state`` covering WAL records <= floor_seq."""
-    payload = json.dumps({"floor": floor_seq, "state": state},
-                         sort_keys=True, separators=(",", ":")).encode()
+    # same versioned record codec as the WAL (sniffed on load, so a JSON
+    # snapshot from an older process keeps loading): the whole-state doc
+    # is megabytes at scale, and serializing it shares the GIL with the
+    # protocol thread even on the commit worker
+    payload = rec_mod.encode_record({"floor": floor_seq, "state": state},
+                                    rec_mod.default_codec())
     final = os.path.join(directory, f"snap-{floor_seq:016d}.snap")
     tmp = final + ".tmp"
     with open(tmp, "wb") as f:
@@ -91,7 +95,12 @@ def load_latest(directory: str) -> Tuple[int, Optional[dict]]:
         if len(payload) != length or zlib.crc32(payload) != crc:
             continue   # torn/corrupt: fall back to the previous snapshot
         try:
-            doc = json.loads(payload.decode())
+            doc = rec_mod.decode_record(payload)
+        except rec_mod.RecordError:
+            # CRC-valid but unsupported version: a downgrade, not a torn
+            # file — falling back to an older snapshot would silently
+            # regress acked-durable state
+            raise
         except ValueError:
             continue
         return int(doc["floor"]), doc["state"]

@@ -7,7 +7,10 @@ Layout of a journal directory::
     recycle-0.seg        fully-snapshotted segment awaiting reuse
     snap-<floor>.snap    snapshots (journal/snapshot.py)
 
-Records are JSON docs; ``append`` stamps each with the next sequence
+Records are docs serialized by the versioned record codec
+(``journal/record.py``: 0xB2+version+msgpack by default, canonical JSON
+as the debug codec and per-record fallback — decode sniffs, so mixed
+journals replay fine); ``append`` stamps each with the next sequence
 number under key ``"s"`` and frames it (segment.frame).  Segments roll at
 ``segment_bytes``; rolling creates (or RECYCLES) the next file and the
 old one stays until the snapshot floor passes its last record, at which
@@ -24,11 +27,11 @@ lost.  The LAST segment reopens for append at its truncation point.
 
 from __future__ import annotations
 
-import json
 import os
 import re
 from typing import Dict, List, Optional
 
+from . import record as rec_mod
 from . import segment as seg_mod
 from .segment import Segment, fsync_dir
 
@@ -56,9 +59,14 @@ class _SealedInfo:
 
 class WriteAheadLog:
     def __init__(self, directory: str,
-                 segment_bytes: int = DEFAULT_SEGMENT_BYTES):
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 record_codec: Optional[str] = None):
         self.directory = directory
         self.segment_bytes = segment_bytes
+        # record payload codec for NEW appends; decode always sniffs, so
+        # this never constrains what an existing journal may contain
+        self.record_codec = (record_codec if record_codec is not None
+                             else rec_mod.default_codec())
         os.makedirs(directory, exist_ok=True)
         # counters (mirrored into obs by the owning journal)
         self.n_appended = 0
@@ -148,7 +156,7 @@ class WriteAheadLog:
                     # gap the sequence — unreachable for replay
                     corrupt = True
             for payload in payloads:
-                doc = json.loads(payload.decode())
+                doc = rec_mod.decode_record(payload)
                 tail_seq = int(doc["s"])
                 self.recovered.append(doc)
             live.append(_SealedInfo(path, header[0], header[1], tail_seq))
@@ -179,8 +187,7 @@ class WriteAheadLog:
         seq = self.tail_seq + 1
         doc = dict(doc)
         doc["s"] = seq
-        payload = json.dumps(doc, sort_keys=True,
-                             separators=(",", ":")).encode()
+        payload = rec_mod.encode_record(doc, self.record_codec)
         if self._active.size >= self.segment_bytes:
             self._roll(seq)
         if not any(f is self._active._f for f, _p in self._dirty):

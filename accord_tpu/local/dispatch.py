@@ -196,6 +196,16 @@ class DeviceDispatcher:
         self.n_fused_tick_launches = 0
         self.n_fused_tick_members = 0
         self.n_solo_ticks = 0
+        # cross-request flush occupancy (r16): one dispatcher event
+        # serves every store flush registered in the same scheduler tick,
+        # and each store's batch carries every query queued by that
+        # tick's ops — the serving path's batch envelopes land their
+        # sub-ops in one tick precisely so these ratios grow.  events ->
+        # member flushes -> queries is the device-side occupancy ladder
+        # (the wire-side analogue is the server's batch_occupancy_p50).
+        self.n_flush_events = 0
+        self.n_flush_members = 0
+        self.n_flush_queries = 0
         # observer(kind, n_members, nq) — the sim cluster wires stats/trace
         self.on_fused = None
 
@@ -227,6 +237,10 @@ class DeviceDispatcher:
             dev._q_pending = []
             if batch:
                 plans.append((dev, batch))
+        if plans:
+            self.n_flush_events += 1
+            self.n_flush_members += len(plans)
+            self.n_flush_queries += sum(len(b) for _d, b in plans)
         hints: Dict[int, dict] = {}
         launch = None
         if self.fusion and len(plans) >= 2:

@@ -112,7 +112,29 @@ class MaelstromSink(api.MessageSink):
     def _emit(self, to: int, body: dict) -> None:
         self.process.emit_packet(to, body)
 
+    def _is_self(self, to: int) -> bool:
+        node = getattr(self.process, "node", None)
+        return node is not None and to == node.node_id
+
+    def _deliver_local(self, request, msg_id: Optional[int]) -> None:
+        """Self-send fast path (r16): a request to our own node skips the
+        wire codec entirely — the OBJECT is handed to ``node.receive`` at
+        the next scheduler hop (deferred, never reentrant: same policy as
+        ``emit_packet``'s body loop-back this replaces on the hot path).
+        Object sharing across the node boundary is exactly the sim
+        NodeSink's semantics, so the protocol's tolerance of it is already
+        pinned by the whole sim suite; with rf == cluster size a third of
+        all protocol messages were paying encode+decode to reach their own
+        process."""
+        node = self.process.node
+        my_id = node.node_id
+        self.process.scheduler.now(
+            lambda: node.receive(request, my_id, msg_id))
+
     def send(self, to: int, request) -> None:
+        if self._is_self(to):
+            self._deliver_local(request, self._msg_id())
+            return
         self._emit(to, {"type": "accord_req", "msg_id": self._msg_id(),
                         "payload": wire.encode(request)})
 
@@ -132,6 +154,12 @@ class MaelstromSink(api.MessageSink):
         entry = [deadline, msg_id, msg_id]
         self.pending[msg_id] = _Pending(callback, to, deadline, entry)
         heapq.heappush(self._timeouts, entry)
+        if self._is_self(to):
+            # the pending-table entry above still owns the timeout: a
+            # self-request wedged behind a stalled store times out exactly
+            # like a remote one
+            self._deliver_local(request, msg_id)
+            return
         self._emit(to, {"type": "accord_req", "msg_id": msg_id,
                         "payload": wire.encode(request)})
 
@@ -163,6 +191,20 @@ class MaelstromSink(api.MessageSink):
     def reply(self, to: int, reply_context, reply) -> None:
         if reply_context is None:
             return   # local requests (Propagate) have no reply path
+        if self._is_self(to):
+            # self-reply fast path: dispatch the reply OBJECT back into
+            # our own response handler at the next scheduler hop — same
+            # journal gating as the wire path below (a promise to
+            # ourselves is still a promise about durable state)
+            my_id = self.process.node.node_id
+            deliver = lambda: self.process.scheduler.now(  # noqa: E731
+                lambda: self.on_response(my_id, reply_context, reply))
+            journal = self.process.durable_journal()
+            if journal is not None and journal.gate_protocol_replies():
+                journal.commit.after_durable(deliver)
+            else:
+                deliver()
+            return
         body = {"type": "accord_rsp", "msg_id": self._msg_id(),
                 "in_reply_to": reply_context,
                 "payload": wire.encode(reply)}
@@ -185,6 +227,12 @@ class MaelstromSink(api.MessageSink):
             # failure must not vanish: stderr is maelstrom's log channel
             import sys
             print(f"local request failed: {failure!r}", file=sys.stderr)
+            return
+        if self._is_self(to):
+            my_id = self.process.node.node_id
+            self.process.scheduler.now(
+                lambda: self.on_failure_response(my_id, reply_context,
+                                                 repr(failure)))
             return
         self._emit(to, {"type": "accord_fail", "msg_id": self._msg_id(),
                         "in_reply_to": reply_context,
@@ -361,8 +409,35 @@ class MaelstromProcess:
         if self.node is None:
             # Maelstrom guarantees init first; tolerate strays
             return
-        if typ == "accord_req":
+        if typ == "accord_batch":
+            # cross-request fused fan-out (r16): one envelope carries N
+            # ops' bodies from one peer tick — unbatch HERE, at the
+            # protocol receiver, into the unchanged per-op path below (the
+            # envelope is transport amortization, never protocol state:
+            # per-op decisions, deps and replies are byte-identical to N
+            # separate frames).  The sub-bodies run in one scheduler tick,
+            # so their store flushes coalesce into one deps flush (and one
+            # fused device launch under --device-mode) by construction.
+            import sys
+            for sub in body.get("msgs") or ():
+                try:
+                    self.handle({"src": src, "dest": packet.get("dest"),
+                                 "body": sub})
+                except Exception as exc:   # one poisoned sub-body must
+                    # not drop the rest of the batch on the floor
+                    print(f"batch sub-handler error on "
+                          f"{(sub or {}).get('type')}: {exc!r}",
+                          file=sys.stderr)
+        elif typ == "accord_req":
             request = wire.decode(body["payload"])
+            try:
+                # r16: the inbound doc IS wire.encode(request) (the
+                # golden-frame gate pins decode∘encode as the identity) —
+                # the durable journal reuses it instead of re-encoding
+                # the whole request at record_message time
+                request._wire_doc = body["payload"]
+            except AttributeError:
+                pass   # slotted/exotic request: journal re-encodes
             self.node.receive(request, node_name_to_id(src), body["msg_id"])
         elif typ == "accord_rsp":
             reply = wire.decode(body["payload"])

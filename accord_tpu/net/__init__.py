@@ -9,10 +9,14 @@ changes to the determinism tiers).
 
 Three layers (ISSUE r12):
 
-- :mod:`accord_tpu.net.framing` — length-prefixed JSON frames carrying the
-  exact ``{src, dest, body}`` packets the Maelstrom adapter already speaks
-  (``accord_tpu.wire`` payloads inside), byte-identical through partial
-  reads and coalesced writes.
+- :mod:`accord_tpu.net.framing` / :mod:`accord_tpu.net.codec` —
+  length-prefixed frames carrying the exact ``{src, dest, body}`` packets
+  the Maelstrom adapter already speaks (``accord_tpu.wire`` payloads
+  inside), byte-identical through partial reads and coalesced writes.
+  r16: payloads are sniffed per frame between the versioned BINARY codec
+  (the serving default: magic + version + a (kind, src, msg_id) prelude
+  for pre-decode admission, msgpack body; golden pins in
+  ``tests/test_net.py`` freeze the format) and JSON (the debug codec).
 - :mod:`accord_tpu.net.transport` / :mod:`accord_tpu.net.server` — an
   asyncio TCP node process: ``MaelstromProcess``'s node wiring behind a
   socket loop instead of stdin/stdout, per-peer reconnect with capped

@@ -194,6 +194,16 @@ class AdmissionGate:
         retry_ms = max(25, min(2000, (p99 or 100_000) // 1000))
         return False, reason, retry_ms
 
+    def unadmit(self) -> None:
+        """Reverse one ``try_admit()`` whose slot was never used (the
+        fast-shed peek lost a race to a release): hand the slot back and
+        back the admitted count out, so the slow path's authoritative
+        ``try_admit`` doesn't double-count the op in admitted/released."""
+        self.inflight = max(0, self.inflight - 1)
+        self.n_admitted -= 1
+        if self.metrics is not None:
+            self.metrics.counter("admission_admitted").inc(-1)
+
     def release(self, duration_micros: Optional[int], ok: bool = True) -> None:
         """One admitted coordination completed.  A COORDINATED failure
         (timeout, recovery loss) still feeds the controller — timeouts ARE

@@ -47,11 +47,14 @@ class NodeConnection:
     # memory (a soak at ~100 txn/s would otherwise grow the set forever)
     SEEN_CAP = 65536
 
-    def __init__(self, name: str, host: str, port: int, src: str):
+    def __init__(self, name: str, host: str, port: int, src: str,
+                 codec: str = "json"):
         self.name = name
         self.host = host
         self.port = port
         self.src = src
+        self.codec = codec   # frames WE send; replies arrive in kind
+        #                      (the server answers in the codec spoken)
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self._pending: Dict[int, asyncio.Future] = {}
@@ -130,7 +133,8 @@ class NodeConnection:
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
         self.writer.write(encode_frame(
-            {"src": self.src, "dest": self.name, "body": body}))
+            {"src": self.src, "dest": self.name, "body": body},
+            self.codec))
         try:
             return await asyncio.wait_for(fut, timeout)
         finally:
@@ -149,9 +153,14 @@ class ClusterClient:
 
     def __init__(self, addrs: List[Tuple[str, str, int]],
                  src: Optional[str] = None,
-                 timeout: float = 10.0, retry_seed: int = 1):
+                 timeout: float = 10.0, retry_seed: int = 1,
+                 codec: str = "json"):
         import os
         self.addrs = addrs
+        # "json" (default: the debug codec, greppable captures) or
+        # "binary" — the load harness passes binary so the generator's
+        # own encode/decode share of the box does not cap the cluster
+        self.codec = codec
         if src is None:
             ClusterClient._incarnation += 1
             src = f"c{os.getpid()}i{ClusterClient._incarnation}"
@@ -173,7 +182,8 @@ class ClusterClient:
 
     async def connect(self) -> None:
         for name, host, port in self.addrs:
-            conn = NodeConnection(name, host, port, self.src)
+            conn = NodeConnection(name, host, port, self.src,
+                                  codec=self.codec)
             await conn.connect()
             self.conns[name] = conn
 
@@ -259,7 +269,8 @@ class ClusterClient:
         if old is not None:
             await old.close()
         name, host, port = next(a for a in self.addrs if a[0] == node)
-        conn = NodeConnection(name, host, port, self.src)
+        conn = NodeConnection(name, host, port, self.src,
+                              codec=self.codec)
         await conn.connect()
         # carry the dedupe census across the re-dial: duplicates are a
         # cluster property the kill-9 test asserts on

@@ -1,12 +1,13 @@
-"""Length-prefixed JSON framing for the TCP serving surface.
+"""Length-prefixed framing for the TCP serving surface.
 
-One frame = 4-byte big-endian payload length + UTF-8 JSON payload (the same
-``{src, dest, body}`` packet dicts the Maelstrom adapter exchanges as
-stdin/stdout lines).  The decoder is a plain byte-stream state machine so a
-frame survives ANY segmentation the kernel chooses — partial reads mid-
-header, mid-payload, or many frames coalesced into one read — and the
-golden-frame test asserts byte-identical round trips over a real loopback
-socket under all three.
+One frame = 4-byte big-endian payload length + payload.  The payload is
+one ``{src, dest, body}`` packet under either wire codec (``net.codec``):
+UTF-8 JSON (the r12 format, kept as the debug codec) or the versioned
+binary encoding, sniffed per frame by its magic byte.  The decoder is a
+plain byte-stream state machine so a frame survives ANY segmentation the
+kernel chooses — partial reads mid-header, mid-payload, or many frames
+coalesced into one read — and the golden-frame test asserts byte-identical
+round trips over a real loopback socket under all three.
 
 A frame larger than ``MAX_FRAME`` is a protocol violation (a desynced or
 hostile peer), surfaced as :class:`FrameError` so the connection layer can
@@ -15,9 +16,10 @@ drop the link instead of allocating unboundedly.
 
 from __future__ import annotations
 
-import json
 import struct
 from typing import List
+
+from .codec import decode_payload, encode_packet
 
 _LEN = struct.Struct(">I")
 
@@ -31,12 +33,12 @@ class FrameError(ValueError):
     """Framing-layer protocol violation (oversized/garbage length)."""
 
 
-def encode_frame(packet: dict) -> bytes:
-    """One packet dict -> length-prefixed wire bytes.  Encoding is plain
-    ``json.dumps`` with compact separators; key order is preserved, so
-    decode -> re-encode reproduces the exact bytes (the golden-frame
-    contract)."""
-    payload = json.dumps(packet, separators=(",", ":")).encode("utf-8")
+def encode_frame(packet: dict, codec: str = "json") -> bytes:
+    """One packet dict -> length-prefixed wire bytes under ``codec``
+    ("json" default — the debug codec — or "binary").  Under either,
+    key order is preserved, so decode -> re-encode reproduces the exact
+    bytes (the golden-frame contract)."""
+    payload = encode_packet(packet, codec)
     if len(payload) > MAX_FRAME:
         raise FrameError(f"frame of {len(payload)} bytes exceeds MAX_FRAME")
     return _LEN.pack(len(payload)) + payload
@@ -44,16 +46,18 @@ def encode_frame(packet: dict) -> bytes:
 
 class FrameDecoder:
     """Incremental decoder: ``feed(chunk)`` returns every COMPLETE packet
-    the stream holds so far, buffering any trailing partial frame."""
+    the stream holds so far (codec sniffed per frame), buffering any
+    trailing partial frame.  ``feed_raw`` returns the undecoded payloads
+    instead — the server's pre-decode admission path."""
 
     __slots__ = ("_buf",)
 
     def __init__(self):
         self._buf = bytearray()
 
-    def feed(self, data: bytes) -> List[dict]:
+    def feed_raw(self, data: bytes) -> List[bytes]:
         self._buf.extend(data)
-        out: List[dict] = []
+        out: List[bytes] = []
         while True:
             if len(self._buf) < _LEN.size:
                 return out
@@ -65,7 +69,10 @@ class FrameDecoder:
                 return out
             payload = bytes(self._buf[_LEN.size:_LEN.size + n])
             del self._buf[:_LEN.size + n]
-            out.append(json.loads(payload.decode("utf-8")))
+            out.append(payload)
+
+    def feed(self, data: bytes) -> List[dict]:
+        return [decode_payload(p) for p in self.feed_raw(data)]
 
     def pending_bytes(self) -> int:
         return len(self._buf)

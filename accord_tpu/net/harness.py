@@ -61,7 +61,8 @@ class ServeCluster:
                  net_faults: Optional[str] = None,
                  log_dir: Optional[str] = None,
                  extra_args: Optional[List[str]] = None,
-                 journal_root: Optional[str] = None):
+                 journal_root: Optional[str] = None,
+                 wire_codec: str = "binary"):
         self.names = [f"n{i}" for i in range(1, n_nodes + 1)]
         ports = free_ports(n_nodes)
         self.addrs: List[Tuple[str, str, int]] = [
@@ -73,6 +74,7 @@ class ServeCluster:
         self.request_timeout_ms = request_timeout_ms
         self.durability = durability
         self.net_faults = net_faults
+        self.wire_codec = wire_codec
         self.extra_args = extra_args or []
         # per-node durable journal dirs (<root>/<name>): a kill -9'd node
         # respawned with the same name recovers its pre-crash state
@@ -99,7 +101,8 @@ class ServeCluster:
                "--peers", self._peers_arg(),
                "--stores", str(self.stores),
                "--admit-max", str(self.admit_max),
-               "--target-p99-ms", str(self.target_p99_ms)]
+               "--target-p99-ms", str(self.target_p99_ms),
+               "--wire-codec", self.wire_codec]
         if self.request_timeout_ms is not None:
             cmd += ["--request-timeout-ms", str(self.request_timeout_ms)]
         if not self.durability:
@@ -345,7 +348,13 @@ async def cluster_net_stats(client: ClusterClient,
     """Aggregate serving stats across nodes: reconnect counters, sheds,
     admission state — the bench-row columns."""
     agg = {"reconnects": 0, "dial_failures": 0, "dropped_frames": 0,
-           "shed_total": 0, "admitted": 0, "per_node": {}}
+           "shed_total": 0, "admitted": 0,
+           # the r16 serving counters (cluster totals; the bench rows and
+           # the # index: line quote these)
+           "wire_bytes_tx": 0, "wire_bytes_rx": 0, "frames_coalesced": 0,
+           "batched_fanouts": 0, "batched_ops": 0, "fast_sheds": 0,
+           "batch_occupancy_p50": 0, "per_node": {}}
+    occupancy = []
     for name in names:
         try:
             s = await client.stats(name)
@@ -360,6 +369,17 @@ async def cluster_net_stats(client: ClusterClient,
         adm = s.get("admission") or {}
         agg["shed_total"] += adm.get("shed_total", 0)
         agg["admitted"] += adm.get("admitted", 0)
+        agg["wire_bytes_tx"] += s.get("wire_bytes_tx", 0)
+        agg["wire_bytes_rx"] += s.get("wire_bytes_rx", 0)
+        agg["frames_coalesced"] += s.get("frames_coalesced", 0)
+        b = s.get("batching") or {}
+        agg["batched_fanouts"] += b.get("batched_fanouts", 0)
+        agg["batched_ops"] += b.get("batched_ops", 0)
+        agg["fast_sheds"] += b.get("fast_sheds", 0)
+        if b.get("batch_occupancy_p50"):
+            occupancy.append(b["batch_occupancy_p50"])
+    if occupancy:
+        agg["batch_occupancy_p50"] = sorted(occupancy)[len(occupancy) // 2]
     return agg
 
 
@@ -369,7 +389,8 @@ async def cluster_net_stats(client: ClusterClient,
 
 async def _smoke_async(cluster: ServeCluster, n_txns: int,
                        concurrency: int = 8) -> dict:
-    client = ClusterClient(cluster.addrs, timeout=8.0)
+    client = ClusterClient(cluster.addrs, timeout=8.0,
+                           codec=cluster.wire_codec)
     try:
         await wait_ready(cluster, client)
         rng = random.Random(7)
@@ -426,7 +447,8 @@ async def _dump_postmortems(cluster: ServeCluster, out_dir: str,
 def run_smoke(n_txns: int = 100, n_nodes: int = 2,
               net_faults: Optional[str] = None,
               out_dir: Optional[str] = None,
-              admit_max: int = 32) -> dict:
+              admit_max: int = 32,
+              wire_codec: str = "binary") -> dict:
     """Spawn an ``n_nodes`` cluster, run ``n_txns`` client txns (bounded
     concurrency, retry-with-backoff), assert full success and cluster
     liveness.  On failure under a fault leg, dumps flight post-mortems to
@@ -436,7 +458,8 @@ def run_smoke(n_txns: int = 100, n_nodes: int = 2,
     # Maelstrom adapter's cold-compile-sized 20s
     cluster = ServeCluster(n_nodes=n_nodes, net_faults=net_faults,
                            admit_max=admit_max,
-                           request_timeout_ms=800)
+                           request_timeout_ms=800,
+                           wire_codec=wire_codec)
     cluster.spawn_all()
     try:
         result = asyncio.run(_smoke_async(cluster, n_txns))
@@ -470,6 +493,10 @@ def main(argv=None) -> int:
     p.add_argument("--nodes", type=int, default=2)
     p.add_argument("--net-faults", default=None,
                    help="kind:prob:seed[,...] armed in every node process")
+    p.add_argument("--wire-codec", choices=("json", "binary"),
+                   default="binary",
+                   help="cluster + client wire codec for this smoke (the "
+                        "fault-matrix net leg sweeps both)")
     p.add_argument("--out", default=os.environ.get("FAULT_MATRIX_OUT",
                                                    "/tmp"))
     args = p.parse_args(argv)
@@ -477,10 +504,12 @@ def main(argv=None) -> int:
         p.error("--smoke is the only mode")
     t0 = time.time()
     result = run_smoke(n_txns=args.txns, n_nodes=args.nodes,
-                       net_faults=args.net_faults, out_dir=args.out)
+                       net_faults=args.net_faults, out_dir=args.out,
+                       wire_codec=args.wire_codec)
     net = result["net"]
     print(f"smoke ok: {result['ok']}/{result['n_txns']} txns in "
           f"{time.time() - t0:.1f}s faults={args.net_faults or 'none'} "
+          f"codec={args.wire_codec} "
           f"reconnects={net['reconnects']} sheds={net['shed_total']} "
           f"dup_replies={result['duplicate_replies']}")
     return 0

@@ -38,9 +38,11 @@ from typing import Callable, Dict, Optional, Tuple
 from .. import api
 from ..utils import faults
 from ..utils.random_source import RandomSource
+from . import codec as wire_codec
 from .admission import AdmissionGate, device_health_of
-from .framing import encode_frame
-from .transport import FrameServer, PeerLink
+from .framing import FrameError, encode_frame
+from .codec import decode_payload
+from .transport import FrameServer, PeerLink, coalesce_window_micros
 
 
 class _Scheduled(api.Scheduled):
@@ -114,7 +116,8 @@ class NodeServer:
                  journal_window_us: Optional[int] = None,
                  journal_snapshot_every: Optional[int] = None,
                  journal_segment_bytes: Optional[int] = None,
-                 journal_sync: Optional[str] = None):
+                 journal_sync: Optional[str] = None,
+                 wire_codec_name: str = "binary"):
         self.name = name
         self.host = host
         self.port = port
@@ -132,10 +135,22 @@ class NodeServer:
         self.journal_snapshot_every = journal_snapshot_every
         self.journal_segment_bytes = journal_segment_bytes
         self.journal_sync = journal_sync
+        # the peer wire codec: "binary" (the serving default; falls back
+        # to json per-frame when msgpack is absent) or "json" (the debug
+        # codec — human-greppable captures).  Clients are answered in the
+        # codec THEY spoke (sniffed per frame), so a debug JSON client
+        # against a binary cluster just works.
+        if wire_codec_name == "binary" and not wire_codec.binary_available():
+            print("[net] msgpack unavailable: --wire-codec binary serves "
+                  "JSON frames", file=sys.stderr)
+            wire_codec_name = "json"
+        self.wire_codec = wire_codec_name
         self._start_ns = time.monotonic_ns()
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.links: Dict[str, PeerLink] = {}
         self._clients: Dict[str, asyncio.StreamWriter] = {}
+        self._client_codec: Dict[str, str] = {}
+        self._peer_hello: Dict[str, dict] = {}   # codec_hello per peer src
         self.proc = None
         self.journal = None
         self.gate: Optional[AdmissionGate] = None
@@ -143,6 +158,17 @@ class NodeServer:
         self.n_client_replies = 0
         self.n_unroutable = 0
         self.n_reply_drops = 0
+        # cross-request fused fan-out (r16): outbound peer bodies emitted
+        # within one event-loop tick share one accord_batch envelope per
+        # peer; client-reply frames to one connection share one write
+        self._peer_pend: Dict[str, list] = {}
+        self._client_pend: Dict = {}
+        self._flush_scheduled = False
+        self.n_batched_fanouts = 0     # envelopes sent (occupancy >= 2)
+        self.n_batched_ops = 0         # sub-bodies riding envelopes
+        self.batch_sizes: Dict[int, int] = {}   # envelope occupancy census
+        self.n_unbatched_envelopes = 0  # envelopes received
+        self.n_fast_sheds = 0          # sheds decided pre-body-decode
 
     def now_micros(self) -> int:
         return (time.monotonic_ns() - self._start_ns) // 1_000
@@ -152,6 +178,9 @@ class NodeServer:
     # (at-most-once delivery allows it; the client's timeout owns
     # recovery) — the admission contract is bounded resources everywhere
     CLIENT_WRITE_BUFFER_CAP = 4 * 1024 * 1024
+    # most bodies one accord_batch envelope carries (a pathological tick
+    # chunks instead of building a frame that courts MAX_FRAME)
+    MAX_BATCH_OPS = 512
 
     def _write_bounded(self, dest: str,
                        writer: asyncio.StreamWriter, frame: bytes) -> bool:
@@ -163,20 +192,101 @@ class NodeServer:
             writer.write(frame)
             return True
         except Exception:
+            # evict BOTH maps: _client_gone derives its keys from
+            # _clients, so a codec entry orphaned here would never be
+            # reaped (one per departed client src, forever)
             self._clients.pop(dest, None)
+            self._client_codec.pop(dest, None)
             return False
 
     # -- outbound -------------------------------------------------------------
+    def _schedule_flush(self) -> None:
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self.loop.call_soon(self._flush_tick)
+
+    def _flush_tick(self) -> None:
+        """End-of-tick flush: every peer's pending bodies leave as ONE
+        frame (an accord_batch envelope when more than one — the shared
+        fan-out N concurrent ops' PreAccept/Accept/Commit rounds ride),
+        and every client connection's pending reply frames leave as one
+        joined write.  Batching here is pure transport amortization: the
+        receiver unbatches into the unchanged per-op protocol path."""
+        self._flush_scheduled = False
+        if self._peer_pend:
+            pend, self._peer_pend = self._peer_pend, {}
+            for dest, bodies in pend.items():
+                # chunk a pathological tick: one envelope must never
+                # approach MAX_FRAME (a lost giant frame would take every
+                # rider with it; the queue bound already caps frames)
+                for at in range(0, len(bodies), self.MAX_BATCH_OPS):
+                    chunk = bodies[at:at + self.MAX_BATCH_OPS]
+                    if len(chunk) == 1:
+                        body = chunk[0]
+                    else:
+                        body = {"type": "accord_batch", "msgs": chunk}
+                        self.n_batched_fanouts += 1
+                        self.n_batched_ops += len(chunk)
+                    n = len(chunk)
+                    self.batch_sizes[n] = self.batch_sizes.get(n, 0) + 1
+                    try:
+                        self.links[dest].send(encode_frame(
+                            {"src": self.name, "dest": dest, "body": body},
+                            self.wire_codec))
+                    except FrameError:
+                        # the op-count cap bounds the envelope, not its
+                        # bytes: a chunk of giant bodies can still top
+                        # MAX_FRAME.  Fall back to per-op frames so one
+                        # oversized rider fails alone instead of taking
+                        # up to MAX_BATCH_OPS messages with it.
+                        for sub in chunk:
+                            try:
+                                self.links[dest].send(encode_frame(
+                                    {"src": self.name, "dest": dest,
+                                     "body": sub}, self.wire_codec))
+                            except Exception as exc:
+                                print(f"[{self.name}] frame to {dest} "
+                                      f"failed: {exc!r}", file=sys.stderr)
+                    except Exception as exc:   # one peer's bad frame must
+                        # not drop every OTHER peer's batch this tick
+                        print(f"[{self.name}] batch encode to {dest} "
+                              f"failed: {exc!r}", file=sys.stderr)
+        if self._client_pend:
+            pend, self._client_pend = self._client_pend, {}
+            for writer, (dest, frames) in pend.items():
+                self._write_bounded(
+                    dest, writer,
+                    frames[0] if len(frames) == 1 else b"".join(frames))
+
+    def _send_client(self, dest: str, writer, frame: bytes) -> None:
+        """Queue one client-bound frame for the end-of-tick joined write
+        (N txn_ok replies released by one journal group-commit fsync — or
+        simply completing in one tick — cost one syscall, not N)."""
+        ent = self._client_pend.get(writer)
+        if ent is None:
+            self._client_pend[writer] = (dest, [frame])
+            self._schedule_flush()
+        else:
+            ent[1].append(frame)
+
     def _emit(self, dest, body: dict) -> None:
-        packet = {"src": self.name, "dest": dest, "body": body}
-        frame = encode_frame(packet)
         if dest in self.links:
-            self.links[dest].send(frame)
+            # peer fan-out: batch within this event-loop tick — N ops'
+            # protocol messages to one peer become one envelope, one
+            # frame, one (coalesced) write
+            pend = self._peer_pend.get(dest)
+            if pend is None:
+                self._peer_pend[dest] = [body]
+                self._schedule_flush()
+            else:
+                pend.append(body)
             return
         writer = self._clients.get(dest)
         if writer is not None:
             self.n_client_replies += 1
-            self._write_bounded(dest, writer, frame)
+            self._send_client(dest, writer, encode_frame(
+                {"src": self.name, "dest": dest, "body": body},
+                self._client_codec.get(dest, "json")))
             return
         # init_ok to the synthetic "boot" client, or a reply to a client
         # whose connection is gone: at-most-once delivery — drop
@@ -191,19 +301,82 @@ class NodeServer:
         gone = [src for src, w in self._clients.items() if w is writer]
         for src in gone:
             del self._clients[src]
+            self._client_codec.pop(src, None)
+        self._client_pend.pop(writer, None)
 
     # -- inbound --------------------------------------------------------------
-    def _on_packet(self, packet: dict, writer: asyncio.StreamWriter) -> None:
+    def _on_payload(self, payload: bytes,
+                    writer: asyncio.StreamWriter) -> None:
+        """Raw frame payload in.  Binary frames carry a (kind, src,
+        msg_id) prelude, so under overload a txn is SHED before its body
+        — ops, datums, payload trees — is ever decoded: the shed stays
+        the cheapest outcome the admission contract promises even now
+        that decode is the next-biggest per-request cost.  JSON (debug
+        codec) frames take the full-decode path below."""
+        hdr = wire_codec.peek_header(payload)
+        if hdr is not None and hdr[0] == wire_codec.KIND_TXN \
+                and self.gate is not None and self.proc is not None:
+            _kind, src, msg_id = hdr
+            self._clients[src] = writer
+            self._client_codec[src] = "binary"
+            if msg_id is not None \
+                    and self.gate.inflight >= self.gate.effective_budget():
+                # duplicate of an already-answered request? the journaled
+                # at-most-once table replays it even under overload —
+                # dedupe outranks shedding (it costs one dict lookup)
+                j = self.proc.journal
+                stored = (j.replied_body(src, msg_id)
+                          if j is not None and hasattr(j, "replied_body")
+                          else None)
+                if stored is None:
+                    admitted, reason, retry_ms = self.gate.try_admit()
+                    if admitted:
+                        # a release raced the peek: keep the slow path's
+                        # single admission point authoritative
+                        self.gate.unadmit()
+                    else:
+                        self.n_fast_sheds += 1
+                        self.proc._reply_client(src, msg_id, {
+                            "type": "error", "code": 11,
+                            "text": "overloaded", "overloaded": True,
+                            "reason": reason, "retry_after_ms": retry_ms})
+                        return
+        try:
+            packet = decode_payload(payload)
+        except ValueError:
+            raise   # FrameServer counts + drops this connection
+        self._on_packet(packet, writer,
+                        binary=payload[0] == wire_codec.MAGIC)
+
+    def _on_packet(self, packet: dict, writer: asyncio.StreamWriter,
+                   binary: bool = False) -> None:
         body = packet.get("body") or {}
         typ = body.get("type")
         src = packet.get("src", "")
+        if typ == "codec_hello":
+            # link-handshake codec announcement (first frame after every
+            # peer (re)connect): record it; an unsupported version is
+            # surfaced loudly here AND in stats, instead of one silent
+            # CodecError per frame
+            self._peer_hello[src] = body
+            v = body.get("version", 0)
+            if v and v not in wire_codec.SUPPORTED_VERSIONS:
+                print(f"[{self.name}] peer {src} announced unsupported "
+                      f"wire codec version {v} (supported: "
+                      f"{wire_codec.SUPPORTED_VERSIONS})", file=sys.stderr)
+            return
         if typ in ("ping", "stats", "dump"):
+            self._client_codec[src] = "binary" if binary else "json"
             self._control(typ, src, body, writer)
             return
         if typ == "txn":
             # remember the connection this client speaks on: its replies
-            # (including sheds) route back over the same socket
+            # (including sheds) route back over the same socket, in the
+            # codec the client spoke
             self._clients[src] = writer
+            self._client_codec[src] = "binary" if binary else "json"
+        elif typ == "accord_batch":
+            self.n_unbatched_envelopes += 1
         try:
             self.proc.handle(packet)
         except Exception as exc:   # a poisoned packet must not kill the node
@@ -227,16 +400,51 @@ class NodeServer:
                                 else None),
                      "metrics": (obs.metrics.snapshot()
                                  if obs is not None else None)}
-        self._write_bounded(src, writer, encode_frame(
-            {"src": self.name, "dest": src, "body": reply}))
+        self._send_client(src, writer, encode_frame(
+            {"src": self.name, "dest": src, "body": reply},
+            self._client_codec.get(src, "json")))
+
+    def batch_occupancy_p50(self) -> int:
+        """Weighted median outbound per-peer batch size (1 = no sharing;
+        the envelope census counts every flushed fan-out)."""
+        total = sum(self.batch_sizes.values())
+        if not total:
+            return 0
+        seen = 0
+        for size in sorted(self.batch_sizes):
+            seen += self.batch_sizes[size]
+            if seen * 2 >= total:
+                return size
+        return 0
 
     def stats(self) -> dict:
         proc = self.proc
+        links = {n: l.stats() for n, l in sorted(self.links.items())}
         return {
             "name": self.name, "pid": os.getpid(),
             "uptime_micros": self.now_micros(),
             "admission": self.gate.stats() if self.gate else None,
-            "links": {n: l.stats() for n, l in sorted(self.links.items())},
+            "links": links,
+            "wire_codec": self.wire_codec,
+            "peer_hello": dict(sorted(self._peer_hello.items())),
+            "batching": {
+                "batched_fanouts": self.n_batched_fanouts,
+                "batched_ops": self.n_batched_ops,
+                "batch_occupancy_p50": self.batch_occupancy_p50(),
+                "unbatched_envelopes": self.n_unbatched_envelopes,
+                "fast_sheds": self.n_fast_sheds,
+            },
+            "dispatch": (lambda d: None if d is None else {
+                "flush_events": d.n_flush_events,
+                "flush_members": d.n_flush_members,
+                "flush_queries": d.n_flush_queries,
+                "fused_launches": d.n_fused_launches,
+            })(getattr(getattr(proc, "node", None), "dispatcher", None)),
+            "wire_bytes_tx": sum(l["bytes_tx"] for l in links.values()),
+            "wire_bytes_rx": (self.frame_server.bytes_rx
+                              if self.frame_server else 0),
+            "frames_coalesced": sum(l["frames_coalesced"]
+                                    for l in links.values()),
             "client_replies": self.n_client_replies,
             "unroutable": self.n_unroutable,
             "reply_drops": self.n_reply_drops,
@@ -252,8 +460,19 @@ class NodeServer:
 
     # -- lifecycle ------------------------------------------------------------
     async def start(self) -> None:
+        import gc
         from ..maelstrom.node import MaelstromProcess
         from ..obs import Observability
+        # cyclic-gc cadence tuned for a protocol server: the default gen-0
+        # threshold (700 allocations) fires the collector thousands of
+        # times per second under load, walking the same long-lived command
+        # state every pass.  Freeze what start-up built (module graph,
+        # jax, topology) out of the collector entirely and raise the
+        # thresholds; cycles still collect, just in batches sized to the
+        # allocation rate of real traffic.
+        gc.collect()
+        gc.freeze()
+        gc.set_threshold(50_000, 25, 25)
         self.loop = asyncio.get_event_loop()
         faults.arm_socket_faults_from_env()
         faults.arm_disk_faults_from_env()
@@ -304,17 +523,25 @@ class NodeServer:
             metrics=obs.metrics,
             phase_p99=phase_feed)
         self.proc.admission = self.gate
-        # outbound links (deterministic per-(me, peer) jitter streams)
+        # outbound links (deterministic per-(me, peer) jitter streams);
+        # each link announces its wire codec + format version as the
+        # first frame after every (re)connect, and coalesces same-window
+        # frames into one write priced off the write micro-probe
         import zlib
+        hello = encode_frame(
+            {"src": self.name, "dest": "", "body":
+             wire_codec.hello_body(self.name, self.wire_codec)},
+            self.wire_codec)
         for peer, (host, port) in sorted(self.peers.items()):
             # stable per-(me, peer) seed: hash() is salted per process,
             # crc32 is not — the backoff schedule must be reproducible
             jitter = RandomSource(
                 0x7C9 ^ zlib.crc32(f"{self.name}->{peer}".encode()))
-            self.links[peer] = PeerLink(self.name, peer, host, port, jitter)
+            self.links[peer] = PeerLink(self.name, peer, host, port, jitter,
+                                        hello_frame=hello)
         self.frame_server = FrameServer(self.host, self.port,
-                                        self._on_packet,
-                                        on_close=self._client_gone)
+                                        on_close=self._client_gone,
+                                        on_payload=self._on_payload)
         await self.frame_server.start()
         for link in self.links.values():
             link.start()
@@ -332,14 +559,18 @@ class NodeServer:
             def snap_tick():
                 try:
                     self.journal.maybe_snapshot(
-                        data_store=self.proc.node.data_store)
+                        data_store=self.proc.node.data_store,
+                        busy=(self.gate is not None
+                              and self.gate.inflight > 0))
                 except Exception as exc:   # snapshotting must never kill
                     print(f"[{self.name}] snapshot tick failed: {exc!r}",
                           file=sys.stderr)
             scheduler.recurring(2_000_000, snap_tick)
         print(f"[{self.name}] serving on {self.host}:{self.port} "
               f"peers={sorted(self.peers)} pid={os.getpid()} "
-              f"journal={'on' if self.journal is not None else 'off'}",
+              f"journal={'on' if self.journal is not None else 'off'} "
+              f"codec={self.wire_codec} "
+              f"coalesce_us={coalesce_window_micros()}",
               file=sys.stderr, flush=True)
 
     async def close(self) -> None:
@@ -412,6 +643,13 @@ def main(argv=None) -> int:
                         "default — acked => durable; protocol promises "
                         "ride the page cache like Cassandra's periodic "
                         "commitlog), or nothing (periodic)")
+    p.add_argument("--wire-codec", choices=("json", "binary"),
+                   default="binary",
+                   help="peer-link wire codec: versioned binary TLV "
+                        "(default; compact + pre-decode admission) or "
+                        "json (the debug codec — human-greppable "
+                        "captures).  Frames are self-describing, so "
+                        "mixed-codec clusters and clients interoperate")
     args = p.parse_args(argv)
 
     host, port = parse_addr(args.listen)
@@ -427,7 +665,19 @@ def main(argv=None) -> int:
         journal_window_us=args.journal_window_us,
         journal_snapshot_every=args.journal_snapshot_every,
         journal_segment_bytes=args.journal_segment_bytes,
-        journal_sync=args.journal_sync)
+        journal_sync=args.journal_sync,
+        wire_codec_name=args.wire_codec)
+
+    # ACCORD_TPU_NODE_PROFILE=<dir>: cProfile the whole node lifetime and
+    # dump <dir>/<name>.pstats at clean shutdown (SIGTERM).  The serving
+    # twin of tools/profile.py — attribution for per-op protocol CPU, the
+    # quantity that now bounds the sim→wire gap (ROADMAP item 4).
+    prof_dir = os.environ.get("ACCORD_TPU_NODE_PROFILE")
+    profiler = None
+    if prof_dir:
+        import cProfile
+        profiler = cProfile.Profile()
+        profiler.enable()
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)
@@ -443,6 +693,12 @@ def main(argv=None) -> int:
     finally:
         loop.run_until_complete(server.close())
         loop.close()
+        if profiler is not None:
+            profiler.disable()
+            os.makedirs(prof_dir, exist_ok=True)
+            out = os.path.join(prof_dir, f"{args.name}.pstats")
+            profiler.dump_stats(out)
+            print(f"[profile] {out}", file=sys.stderr, flush=True)
     return 0
 
 

@@ -14,30 +14,109 @@ and never replays on reconnect — so a reply racing a reconnect can only
 arrive zero or one times, and the sink's pending-table pop makes dispatch
 idempotent even against a reply racing its own timeout.
 
+Write coalescing (r16): frames queued on a link within one event-loop
+tick leave in ONE joined write — the r12 transport paid one ``write`` +
+``drain`` round per frame, which at a dozen protocol frames per txn was a
+first-order tax on the serving path.  The greedy drain is free (those
+frames were already queued); on top of it a LINGER window lets a write
+wait briefly for the next frame, priced off a once-per-process socket
+write micro-probe exactly like the journal's group-commit window prices
+its fsync batching (never a hard threshold): the linger may cost at most
+``COALESCE_FACTOR`` write-syscalls' worth of latency, clamped.  Injected
+socket faults keep their r12 per-FRAME draw rate (intensity invariant
+under coalescing) while a ``conn_reset`` draw anywhere in a batch tears
+the WHOLE coalesced write — the at-most-once contract already covers it
+(nothing is replayed; the sink times the lost ops out), and the
+fault-matrix net leg asserts zero duplicate replies under exactly this.
+
 Reconnect: capped exponential backoff with deterministic jitter drawn from
 a dedicated :class:`RandomSource` stream (same policy as the r07 device
-quarantine backoff — co-failed links must not re-dial in lockstep).
+quarantine backoff — co-failed links must not re-dial in lockstep).  When
+a ``hello_frame`` is configured (the codec handshake, ``net.codec``), it
+is sent first on every (re)connect before any queued frame.
 
 Fault injection (``utils.faults`` socket kinds, armed per-process via
-ACCORD_TPU_NET_FAULTS): ``conn_reset`` aborts the link mid-frame,
+ACCORD_TPU_NET_FAULTS): ``conn_reset`` aborts the link mid-write,
 ``stalled_peer`` holds the writer for a drawn interval, ``slow_link``
-delays each frame — all drawn from the injected seeded source only.
+delays each write — all drawn from the injected seeded source only.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Callable, Dict, List, Optional, Tuple
+import os
+import socket
+import time
+from typing import Callable, List, Optional
 
 from ..utils import faults
 from ..utils.random_source import RandomSource
-from .framing import FrameDecoder, FrameError, encode_frame
+from .framing import FrameDecoder, FrameError
 
 # reconnect backoff: 50ms, 100ms, ... capped at 2s, plus up to 50% jitter
 BACKOFF_BASE_MICROS = 50_000
 BACKOFF_CAP_MICROS = 2_000_000
 # frames buffered per link while disconnected (drop-oldest beyond)
 LINK_QUEUE_FRAMES = 2048
+# one coalesced write never exceeds this many bytes (a bound, not a
+# target: the greedy drain stops here so a burst cannot build one
+# pathological multi-MB write)
+COALESCE_MAX_BYTES = 256 * 1024
+# linger pricing: waiting for the next frame may cost at most this many
+# measured write-syscalls' worth of latency, clamped to the window below
+COALESCE_FACTOR = 8
+COALESCE_MIN_MICROS = 0
+COALESCE_MAX_MICROS = 1_000
+
+_write_probe_cache: Optional[int] = None
+
+
+def probe_write_micros(rounds: int = 32) -> int:
+    """Median cost of one small socket write syscall, measured ONCE per
+    process over a loopback socketpair — the price signal the coalescing
+    linger is derived from (same discipline as the journal group-commit
+    window's fsync micro-probe)."""
+    global _write_probe_cache
+    if _write_probe_cache is not None:
+        return _write_probe_cache
+    samples = []
+    try:
+        a, b = socket.socketpair()
+        try:
+            a.setblocking(False)
+            payload = b"\x00" * 512
+            for _ in range(rounds):
+                t0 = time.perf_counter_ns()
+                a.send(payload)
+                samples.append((time.perf_counter_ns() - t0) // 1_000)
+                # drain so the buffer never fills
+                try:
+                    b.recv(4096)
+                except BlockingIOError:
+                    pass
+        finally:
+            a.close()
+            b.close()
+    except OSError:
+        samples = [5]
+    samples.sort()
+    _write_probe_cache = max(1, samples[len(samples) // 2])
+    return _write_probe_cache
+
+
+def coalesce_window_micros() -> int:
+    """The priced linger window: COALESCE_FACTOR write-syscalls' worth of
+    wall clock, clamped.  Env override ACCORD_TPU_COALESCE_US (0 disables
+    the linger; the same-tick greedy drain always runs)."""
+    env = os.environ.get("ACCORD_TPU_COALESCE_US")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return max(COALESCE_MIN_MICROS,
+               min(COALESCE_MAX_MICROS,
+                   probe_write_micros() * COALESCE_FACTOR))
 
 
 def backoff_micros(attempt: int, jitter: RandomSource) -> int:
@@ -51,12 +130,16 @@ class PeerLink:
     """One outbound connection to a peer, kept alive forever.
 
     ``send`` enqueues a pre-encoded frame and never blocks the caller; the
-    writer task drains the queue into the socket, reconnecting with capped
-    backoff on any failure.  Counters feed the serving stats surface."""
+    writer task drains the queue into the socket — coalescing every frame
+    available within the priced linger window into one write — and
+    reconnects with capped backoff on any failure.  Counters feed the
+    serving stats surface."""
 
     def __init__(self, me: str, peer: str, host: str, port: int,
                  jitter: RandomSource,
-                 max_queue: int = LINK_QUEUE_FRAMES):
+                 max_queue: int = LINK_QUEUE_FRAMES,
+                 hello_frame: Optional[bytes] = None,
+                 linger_micros: Optional[int] = None):
         self.me = me
         self.peer = peer
         self.host = host
@@ -64,11 +147,18 @@ class PeerLink:
         self._jitter = jitter
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._task: Optional[asyncio.Task] = None
+        self._hello = hello_frame
+        self._linger_s = (coalesce_window_micros()
+                          if linger_micros is None else linger_micros) / 1e6
         self.connected = False
         self.n_connects = 0        # successful dials (first + re-)
         self.n_reconnects = 0      # successful dials after the first
         self.n_dial_failures = 0
         self.n_sent = 0
+        self.n_writes = 0          # coalesced write syscall rounds
+        self.n_frames_coalesced = 0  # frames that shared a write beyond
+        #                              the first of their batch
+        self.bytes_tx = 0
         self.n_dropped = 0         # frames dropped by the bounded queue
         self.n_reset_faults = 0    # injected conn_reset firings
 
@@ -115,6 +205,12 @@ class PeerLink:
                 self.n_reconnects += 1
             attempt = 0
             try:
+                if self._hello is not None:
+                    # codec handshake: announce this link's wire codec +
+                    # format version before any protocol frame
+                    writer.write(self._hello)
+                    self.bytes_tx += len(self._hello)
+                    await writer.drain()
                 await self._pump(writer)
             except (ConnectionError, OSError, asyncio.IncompleteReadError):
                 pass
@@ -128,23 +224,64 @@ class PeerLink:
             # acceptor isn't hammered at loop speed
             await asyncio.sleep(backoff_micros(0, self._jitter) / 1e6)
 
+    def _drain_batch(self, batch: List[bytes], budget: int) -> int:
+        """Greedily move every queued frame into ``batch`` up to the byte
+        budget; returns the bytes taken."""
+        taken = 0
+        while taken < budget:
+            try:
+                frame = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            batch.append(frame)
+            taken += len(frame)
+        return taken
+
     async def _pump(self, writer: asyncio.StreamWriter) -> None:
         while True:
-            frame = await self._queue.get()
+            first = await self._queue.get()
+            batch = [first]
+            nbytes = len(first)
+            nbytes += self._drain_batch(batch, COALESCE_MAX_BYTES - nbytes)
+            if len(batch) == 1 and self._linger_s > 0:
+                # nothing else queued: linger one priced window — a burst
+                # mid-arrival coalesces instead of going out frame-by-
+                # frame, and the window costs at most a few syscalls'
+                # worth of latency by construction
+                await asyncio.sleep(self._linger_s)
+                nbytes += self._drain_batch(batch,
+                                            COALESCE_MAX_BYTES - nbytes)
             # injected socket faults (seedable; see utils.faults) — drawn
-            # per frame, exactly like the device layer draws per launch
-            if faults.socket_fault_fires("slow_link"):
-                await asyncio.sleep(
-                    faults.socket_fault_delay_micros("slow_link") / 1e6)
-            if faults.socket_fault_fires("stalled_peer"):
-                await asyncio.sleep(
-                    faults.socket_fault_delay_micros("stalled_peer") / 1e6)
-            if faults.socket_fault_fires("conn_reset"):
+            # per FRAME exactly as r12 did, so the configured fault
+            # intensity is invariant under coalescing (a per-write draw
+            # would concentrate the same probability into correlated
+            # whole-batch kills and make the armed rate mean something
+            # different at every batch depth).  The BLAST RADIUS is the
+            # write: one reset draw anywhere in the batch tears the whole
+            # coalesced write — the half-written-batch case the fault
+            # matrix asserts never replays acked ops
+            delay_micros = 0
+            reset = False
+            for _ in batch:
+                if faults.socket_fault_fires("slow_link"):
+                    delay_micros += faults.socket_fault_delay_micros(
+                        "slow_link")
+                if faults.socket_fault_fires("stalled_peer"):
+                    delay_micros += faults.socket_fault_delay_micros(
+                        "stalled_peer")
+                if faults.socket_fault_fires("conn_reset"):
+                    reset = True
+            if delay_micros:
+                await asyncio.sleep(delay_micros / 1e6)
+            if reset:
                 self.n_reset_faults += 1
-                writer.transport.abort()   # frame lost, link reconnects
+                writer.transport.abort()   # batch lost, link reconnects
                 raise ConnectionResetError("injected conn_reset")
-            writer.write(frame)
-            self.n_sent += 1
+            writer.write(batch[0] if len(batch) == 1 else b"".join(batch))
+            self.n_sent += len(batch)
+            self.n_writes += 1
+            self.n_frames_coalesced += len(batch) - 1
+            self.bytes_tx += nbytes
             await writer.drain()
 
     def stats(self) -> dict:
@@ -152,27 +289,35 @@ class PeerLink:
                 "connects": self.n_connects,
                 "reconnects": self.n_reconnects,
                 "dial_failures": self.n_dial_failures,
-                "sent": self.n_sent, "dropped": self.n_dropped,
+                "sent": self.n_sent, "writes": self.n_writes,
+                "frames_coalesced": self.n_frames_coalesced,
+                "bytes_tx": self.bytes_tx,
+                "dropped": self.n_dropped,
                 "reset_faults": self.n_reset_faults,
                 "queued": self._queue.qsize()}
 
 
 class FrameServer:
-    """Accept loop: every inbound connection (peer or client) is decoded
-    frame-by-frame and handed to ``on_packet(packet, writer)``.  A framing
-    violation drops THAT connection only."""
+    """Accept loop: every inbound connection (peer or client) is split
+    into frames and handed on — raw payload bytes to ``on_payload`` when
+    wired (the server's pre-decode admission path), else decoded packets
+    to ``on_packet``.  A framing/codec violation drops THAT connection
+    only."""
 
     def __init__(self, host: str, port: int,
-                 on_packet: Callable[[dict, asyncio.StreamWriter], None],
+                 on_packet: Optional[Callable] = None,
                  on_close: Optional[
-                     Callable[[asyncio.StreamWriter], None]] = None):
+                     Callable[[asyncio.StreamWriter], None]] = None,
+                 on_payload: Optional[Callable] = None):
         self.host = host
         self.port = port
         self.on_packet = on_packet
+        self.on_payload = on_payload
         self.on_close = on_close
         self._server: Optional[asyncio.AbstractServer] = None
         self.n_accepted = 0
         self.n_frame_errors = 0
+        self.bytes_rx = 0
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -192,9 +337,17 @@ class FrameServer:
                 chunk = await reader.read(65536)
                 if not chunk:
                     return
-                for packet in decoder.feed(chunk):
-                    self.on_packet(packet, writer)
-        except FrameError:
+                self.bytes_rx += len(chunk)
+                if self.on_payload is not None:
+                    for payload in decoder.feed_raw(chunk):
+                        self.on_payload(payload, writer)
+                else:
+                    for packet in decoder.feed(chunk):
+                        self.on_packet(packet, writer)
+        except (FrameError, ValueError):
+            # FrameError = desynced length prefix; ValueError covers a
+            # CodecError/garbage payload — either way this stream cannot
+            # be trusted past this point
             self.n_frame_errors += 1
         except (ConnectionError, OSError, asyncio.IncompleteReadError):
             pass

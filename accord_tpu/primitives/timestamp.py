@@ -176,6 +176,12 @@ class Kinds(enum.IntEnum):
 
 _KINDS_MASKS: dict = {}
 
+# ordinal -> member tables for the TxnId flag decoders (an enum __call__
+# costs a classmethod dispatch + value lookup; these are two of the most
+# frequent calls on the serving path)
+_TXNKIND_BY_ORDINAL = tuple(TxnKind(i) for i in range(len(TxnKind)))
+_DOMAIN_BY_ORDINAL = (Domain.Key, Domain.Range)
+
 
 class Timestamp:
     """Immutable HLC timestamp. Totally ordered by (msb, lsb, node)."""
@@ -259,26 +265,59 @@ class Timestamp:
         return type(big)(big.msb, big.lsb, big.node)
 
     # -- ordering -----------------------------------------------------------
+    # the comparison dunders are the hottest calls in the whole protocol
+    # path (every sort, dict probe and watermark compare lands here), so
+    # they compare fields directly instead of building _key() tuples
     def _key(self) -> Tuple[int, int, int]:
         return (self.msb, self.lsb, self.node)
 
-    def __lt__(self, o): return self._key() < o._key()
-    def __le__(self, o): return self._key() <= o._key()
-    def __gt__(self, o): return self._key() > o._key()
-    def __ge__(self, o): return self._key() >= o._key()
+    def __lt__(self, o):
+        if self.msb != o.msb:
+            return self.msb < o.msb
+        if self.lsb != o.lsb:
+            return self.lsb < o.lsb
+        return self.node < o.node
+
+    def __le__(self, o):
+        if self.msb != o.msb:
+            return self.msb < o.msb
+        if self.lsb != o.lsb:
+            return self.lsb < o.lsb
+        return self.node <= o.node
+
+    def __gt__(self, o):
+        if self.msb != o.msb:
+            return self.msb > o.msb
+        if self.lsb != o.lsb:
+            return self.lsb > o.lsb
+        return self.node > o.node
+
+    def __ge__(self, o):
+        if self.msb != o.msb:
+            return self.msb > o.msb
+        if self.lsb != o.lsb:
+            return self.lsb > o.lsb
+        return self.node >= o.node
 
     def __eq__(self, o):
-        return isinstance(o, Timestamp) and self._key() == o._key()
+        return (self.msb == o.msb and self.lsb == o.lsb
+                and self.node == o.node) if isinstance(o, Timestamp) \
+            else NotImplemented
 
     def __hash__(self):
-        return hash(self._key())
+        return hash((self.msb, self.lsb, self.node))
 
     def compare_to(self, o: "Timestamp") -> int:
-        a, b = self._key(), o._key()
-        return -1 if a < b else (0 if a == b else 1)
+        if self.msb != o.msb:
+            return -1 if self.msb < o.msb else 1
+        if self.lsb != o.lsb:
+            return -1 if self.lsb < o.lsb else 1
+        n = self.node - o.node
+        return -1 if n < 0 else (0 if n == 0 else 1)
 
     def equals_strict(self, o: "Timestamp") -> bool:
-        return self._key() == o._key() and type(self) is type(o)
+        return (self.msb == o.msb and self.lsb == o.lsb
+                and self.node == o.node and type(self) is type(o))
 
     def __repr__(self):
         return f"[{self.epoch()},{self.hlc()},{self.flags()},{self.node}]"
@@ -302,10 +341,12 @@ class TxnId(Timestamp):
         return cls.create(ts.epoch(), ts.hlc(), kind, domain, ts.node)
 
     def kind(self) -> TxnKind:
-        return TxnKind((self.flags() >> 1) & 0x7)
+        # table lookup: the enum __call__ protocol is measurable on the
+        # serving hot path (every witness predicate lands here)
+        return _TXNKIND_BY_ORDINAL[(self.lsb >> 1) & 0x7]
 
     def domain(self) -> Domain:
-        return Domain(self.flags() & 0x1)
+        return _DOMAIN_BY_ORDINAL[self.lsb & 0x1]
 
     def is_write(self) -> bool:
         return self.kind() is TxnKind.Write

@@ -11,6 +11,7 @@ also what makes them natural device arrays (searchsorted lookup).
 
 from __future__ import annotations
 
+from bisect import bisect_left, bisect_right
 from typing import Callable, Generic, List, Optional, Sequence, TypeVar
 
 from ..utils import invariants
@@ -58,8 +59,7 @@ class ReducingRangeMap(Generic[V]):
 
     # -- lookup -------------------------------------------------------------
     def _index_of(self, token: int) -> int:
-        import bisect
-        return bisect.bisect_right(self.boundaries, token)
+        return bisect_right(self.boundaries, token)
 
     def get(self, token: int) -> Optional[V]:
         return self.values[self._index_of(token)]
@@ -114,9 +114,8 @@ class ReducingRangeMap(Generic[V]):
             return ReducingRangeMap(other.boundaries, other.values)
         all_bounds = sorted(set(self.boundaries) | set(other.boundaries))
         values: List[Optional[V]] = []
-        # evaluate each resulting gap at a representative point
-        import bisect
 
+        # evaluate each resulting gap at a representative point
         def at(m: "ReducingRangeMap[V]", i_gap: int) -> Optional[V]:
             # gap i spans (all_bounds[i-1], all_bounds[i]); probe with the
             # left edge (or -inf for the first gap)
@@ -148,8 +147,61 @@ class ReducingRangeMap(Generic[V]):
 
     def add(self, ranges, value: V,
             reduce_fn: Callable[[V, V], V]) -> "ReducingRangeMap[V]":
-        """Merge ``value`` over ``ranges`` into this map."""
-        return self.merge(ReducingRangeMap.of_ranges(ranges, value), reduce_fn)
+        """Merge ``value`` over ``ranges`` into this map.
+
+        The hot shape on the serving path is ONE range into a map of N
+        segments (MaxConflicts/RedundantBefore take one add per commit),
+        so ranges splice in one at a time via :meth:`_add_one` — O(log N
+        + touched) instead of the full merge's O(N) rebuild-and-compact.
+        The result is the same canonical compacted form the merge path
+        produces (``tests/test_utils.py`` pins the equivalence over
+        randomized cases)."""
+        out = self
+        for r in ranges:
+            out = out._add_one(r.start, r.end, value, reduce_fn)
+        return out
+
+    def _add_one(self, s: int, e: int, value: V,
+                 reduce_fn: Callable[[V, V], V]) -> "ReducingRangeMap[V]":
+        """Splice ``value`` over [s, e): copy the untouched prefix/suffix,
+        reduce only the covered gaps, and re-compact only the joints the
+        splice could have made equal (the rest was compacted already)."""
+        if s >= e:
+            return self
+        b, v = self.boundaries, self.values
+        lo = bisect_right(b, s)    # gap containing s (== first interior
+        #                            boundary index)
+        hi = bisect_left(b, e)     # first boundary >= e
+        covered = [value if x is None else reduce_fn(x, value)
+                   for x in v[lo:hi + 1]]
+        nb: List[int] = list(b[:lo])
+        nv: List[Optional[V]] = list(v[:lo])
+        if not (lo and b[lo - 1] == s):
+            nb.append(s)
+            nv.append(v[lo])       # left sliver of the split gap
+        w0 = len(nb) - 1           # first joint the splice can affect
+        nb.extend(b[lo:hi])
+        nv.extend(covered)
+        if hi < len(b) and b[hi] == e:
+            w1 = len(nb)           # joint between last covered and suffix
+            nb.extend(b[hi:])
+            nv.extend(v[hi + 1:])
+        else:
+            w1 = len(nb)
+            nb.append(e)
+            nb.extend(b[hi:])
+            nv.extend(v[hi:])      # right sliver keeps the old value
+        # local compaction over boundary indices [w0, w1]: drop any
+        # boundary whose two sides became equal (reduce can equalize
+        # neighbours — e.g. a max() above both)
+        kb: List[int] = list(nb[:max(w0, 0)])
+        kv: List[Optional[V]] = list(nv[:max(w0, 0) + 1])
+        for k in range(max(w0, 0), len(nb)):
+            if k <= w1 and nv[k + 1] == kv[-1]:
+                continue
+            kb.append(nb[k])
+            kv.append(nv[k + 1])
+        return ReducingRangeMap(kb, kv)
 
     def __eq__(self, o):
         return (isinstance(o, ReducingRangeMap)

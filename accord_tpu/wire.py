@@ -66,7 +66,37 @@ def register_enum(enum_cls: type, tag: Optional[str] = None) -> None:
              lambda d: enum_cls[d["n"]])
 
 
+# exact-type fast sets for the hot dispatch below: encode/decode run for
+# every value of every protocol message on the serving path, so the
+# common cases (scalars, lists, registered classes) dispatch on
+# ``type(obj)`` in one set/dict probe; anything exotic (enum without its
+# exact class registered, scalar/list SUBCLASSES like np.float64,
+# frozenset) falls through to the original isinstance chain
+_SCALARS = frozenset((str, int, float, bool, type(None)))
+
+
 def encode(obj: Any) -> Any:
+    t = obj.__class__
+    if t in _SCALARS:
+        return obj
+    if t is list:
+        return [encode(v) for v in obj]
+    ent = _ENCODERS.get(t)
+    if ent is not None:   # registered classes AND registered enums (an
+        #                   enum member's __class__ IS its enum class)
+        tag, enc = ent
+        doc = enc(obj)
+        doc["_t"] = tag
+        return doc
+    if t is tuple:
+        return {"_t": "tup", "v": [encode(v) for v in obj]}
+    if t is dict:
+        return {"_t": "map", "v": [[encode(k), encode(v)]
+                                   for k, v in obj.items()]}
+    return _encode_slow(obj)
+
+
+def _encode_slow(obj: Any) -> Any:
     if isinstance(obj, _enum.Enum):   # before scalars: IntEnum is an int
         ent = _ENCODERS.get(type(obj))
         if ent is None:
@@ -97,21 +127,35 @@ def encode(obj: Any) -> Any:
 
 
 def decode(doc: Any) -> Any:
-    if doc is None or isinstance(doc, (bool, int, str, float)):
+    t = doc.__class__
+    if t is dict:
+        tag = doc.get("_t")
+        dec = _DECODERS.get(tag)
+        if dec is None:
+            raise TypeError(f"no wire codec for tag {tag!r}")
+        return dec(doc)
+    if t is list:
+        return [decode(v) for v in doc]
+    if t in _SCALARS:
         return doc
+    if isinstance(doc, (bool, int, str, float)) or doc is None:
+        return doc   # scalar subclasses
     if isinstance(doc, list):
         return [decode(v) for v in doc]
-    tag = doc.get("_t")
-    if tag == "tup":
-        return tuple(decode(v) for v in doc["v"])
-    if tag == "fset":
-        return frozenset(decode(v) for v in doc["v"])
-    if tag == "map":
-        return {decode(k): decode(v) for k, v in doc["v"]}
-    dec = _DECODERS.get(tag)
-    if dec is None:
+    if isinstance(doc, dict):
+        tag = doc.get("_t")
+        dec = _DECODERS.get(tag)
+        if dec is not None:
+            return dec(doc)
         raise TypeError(f"no wire codec for tag {tag!r}")
-    return dec(doc)
+    raise TypeError(f"cannot decode {type(doc).__name__}")
+
+
+# the structural tags ride the same decoder registry as classes (one dict
+# probe decodes everything)
+_DECODERS["tup"] = lambda d: tuple(decode(v) for v in d["v"])
+_DECODERS["fset"] = lambda d: frozenset(decode(v) for v in d["v"])
+_DECODERS["map"] = lambda d: {decode(k): decode(v) for k, v in d["v"]}
 
 
 # ---------------------------------------------------------------------------

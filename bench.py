@@ -939,8 +939,10 @@ def main(em: Emitter):
     except Exception as e:
         recovery_burn = None
         em.note(f"# recovery-nemesis burn failed: {e!r}")
+    import os as _os
     em.note(
-        f"# device={jax.devices()[0].platform} N={N} B={B} "
+        f"# device={jax.devices()[0].platform} cpus={_os.cpu_count()} "
+        f"N={N} B={B} "
         f"queries_per_rep={B * BATCHES} reps={REPS}\n"
         f"# dev_median={dev_med:.1f}/s dev_min={dev_min:.1f}/s "
         f"spread={max(rates) / min(rates):.2f}x\n"
@@ -1078,12 +1080,37 @@ def main(em: Emitter):
              os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "serve_bench.py"), "--bench"],
             env=env, capture_output=True, text=True, timeout=600)
+        serve_rows = []
         for line in serve.stdout.splitlines():
             if line.strip().startswith("{"):
-                em.config(json.loads(line.strip()))
+                row = json.loads(line.strip())
+                serve_rows.append(row)
+                em.config(row)
         if serve.returncode != 0:
             em.note(f"# CONFIG 6/7 (serving) FAILED rc={serve.returncode}: "
                     f"{serve.stderr[-600:]}")
+        # r16: the serving counters join the # index: line (a second
+        # line; the parsers merge them) as PER-TXN ints — comparable
+        # across rounds while the box's absolute speed oscillates.
+        # wire_bytes_* gate lower-is-better, the batching counters
+        # higher-is-better (bench_compare/bench_trend direction maps).
+        sat_row = next((r for r in serve_rows
+                        if "saturation" in r.get("metric", "")
+                        and "wire_bytes_tx_per_txn" in r), None)
+        if sat_row is not None:
+            em.note("# index: "
+                    f"wire_bytes_tx={sat_row['wire_bytes_tx_per_txn']} "
+                    f"wire_bytes_rx={sat_row['wire_bytes_rx_per_txn']} "
+                    "frames_coalesced="
+                    f"{sat_row['frames_coalesced_per_1k_txn']} "
+                    "batched_fanouts="
+                    f"{sat_row['batched_fanouts_per_1k_txn']} "
+                    "batch_occupancy_p50="
+                    f"{sat_row['batch_occupancy_p50']} "
+                    f"fast_sheds={sat_row['fast_sheds']}\n"
+                    "# serving index counters are per-committed-txn "
+                    "(bytes) / per-1k-txn (frames, fanouts) over the "
+                    "whole config-6 sweep")
     except Exception as e:
         em.note(f"# CONFIG 6/7 (serving) failed: {e!r}")
 

@@ -111,10 +111,18 @@ def test_series_cover_the_documented_families():
     assert any(".phase[" in k for k in keys)
     assert any(".fast_path_rate" in k for k in keys)
     assert any(k.startswith("index.") for k in keys)
-    # index counters other than download_bytes are drift-reported, not gated
+    # gated index counters follow the r16 direction map (download bytes +
+    # the serving wire counters); everything else on the line stays
+    # drift-reported, not gated
     assert series["index.download_bytes"]["dir"] == "down"
-    assert all(s["dir"] is None for k, s in series.items()
-               if k.startswith("index.") and "download_bytes" != k[6:])
+    assert bench_trend.INDEX_GATED["wire_bytes_tx"] == "down"
+    assert bench_trend.INDEX_GATED["batched_fanouts"] == "up"
+    for k, s in series.items():
+        if k.startswith("index."):
+            assert s["dir"] == bench_trend.INDEX_GATED.get(k[6:]), k
+    # the serving counters are live in the trajectory from r16 on
+    assert "index.wire_bytes_tx" in keys
+    assert "index.batch_occupancy_p50" in keys
 
 
 # ---------------------------------------------------------------------------

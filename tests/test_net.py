@@ -13,6 +13,7 @@ import json
 
 import pytest
 
+from accord_tpu.net import codec as wcodec
 from accord_tpu.net.admission import (AdmissionGate, Overloaded,
                                       device_health_of)
 from accord_tpu.net.framing import (MAX_FRAME, FrameDecoder, FrameError,
@@ -114,6 +115,150 @@ def test_frame_error_on_garbage_length():
 def test_encode_rejects_oversized_payload():
     with pytest.raises(FrameError):
         encode_frame({"pad": "x" * (MAX_FRAME + 1)})
+
+
+# ---------------------------------------------------------------------------
+# the versioned binary wire codec (r16): cross-codec decode identity,
+# pre-decode header peeking, and the golden pins that freeze the format
+# ---------------------------------------------------------------------------
+
+def test_binary_roundtrip_decodes_identically_to_json():
+    """The codec-compatibility gate's core claim: every packet decodes to
+    the SAME dict under both codecs, and re-encode under each codec is
+    byte-stable."""
+    for pkt in PACKETS:
+        jb = wcodec.encode_packet(pkt, "json")
+        bb = wcodec.encode_packet(pkt, "binary")
+        assert bb[0] == wcodec.MAGIC and not wcodec.is_binary(jb)
+        assert wcodec.decode_payload(jb) == pkt
+        assert wcodec.decode_payload(bb) == pkt
+        # decode -> re-encode is the identity on the bytes (both codecs)
+        assert wcodec.encode_packet(wcodec.decode_payload(bb), "binary") == bb
+        assert wcodec.encode_packet(wcodec.decode_payload(jb), "json") == jb
+
+
+def test_binary_frames_interleave_with_json_on_one_stream():
+    """Frames are self-describing: one connection may carry both codecs
+    (debug JSON client against a binary cluster)."""
+    dec = FrameDecoder()
+    blob = b"".join(
+        encode_frame(p, "binary" if i % 2 else "json")
+        for i, p in enumerate(PACKETS))
+    out = []
+    for i in range(0, len(blob), 3):
+        out.extend(dec.feed(blob[i:i + 3]))
+    assert out == PACKETS
+
+
+def test_binary_peek_header_reads_kind_src_msgid_without_body():
+    pkt = {"src": "c9", "dest": "n1",
+           "body": {"type": "txn", "msg_id": 41, "txn": [["r", 1, None]]}}
+    payload = wcodec.encode_packet(pkt, "binary")
+    assert wcodec.peek_header(payload) == (wcodec.KIND_TXN, "c9", 41)
+    # JSON frames have no cheap header: peek declines, full decode path
+    assert wcodec.peek_header(wcodec.encode_packet(pkt, "json")) is None
+    # no msg_id -> None in the prelude
+    p2 = wcodec.encode_packet(
+        {"src": "n1", "dest": "n2", "body": {"type": "accord_batch",
+                                             "msgs": []}}, "binary")
+    assert wcodec.peek_header(p2) == (wcodec.KIND_BATCH, "n1", None)
+
+
+def test_binary_unsupported_version_rejected():
+    pkt = {"src": "a", "dest": "b", "body": {"type": "ping", "msg_id": 1}}
+    payload = bytearray(wcodec.encode_packet(pkt, "binary"))
+    payload[1] = 99   # a future format this build does not speak
+    with pytest.raises(wcodec.CodecError):
+        wcodec.decode_payload(bytes(payload))
+    # ...and the frame decoder surfaces it as a stream error, not a hang
+    dec = FrameDecoder()
+    import struct
+    with pytest.raises(ValueError):
+        dec.feed(struct.pack(">I", len(payload)) + bytes(payload))
+
+
+def test_binary_bigint_falls_back_to_json_per_frame():
+    """An integer beyond msgpack's 64-bit range (arbitrary-precision
+    timestamp words can exceed it in principle) must not fail the frame:
+    the encoder falls back to JSON for THAT packet and the sniffing
+    decoder takes it in stride."""
+    pkt = {"src": "n1", "dest": "n2",
+           "body": {"type": "accord_req", "msg_id": 1,
+                    "payload": {"v": 1 << 80}}}
+    payload = wcodec.encode_packet(pkt, "binary")
+    assert not wcodec.is_binary(payload)   # JSON carried it
+    assert wcodec.decode_payload(payload) == pkt
+
+
+# The golden pins: hex bytes of the v1 binary encoding for a corpus
+# covering all four datum kinds, a txn reply, a protocol request, a batch
+# envelope, the control verbs and the codec_hello handshake.  An encoder
+# change that alters ANY of these bytes without a version bump fails here
+# (bump VERSION, keep decoding every older pin, and add new pins for the
+# new version); a decoder change that mis-reads them fails the identity
+# assertions.  Pins per version accumulate — that is the cross-version
+# compatibility gate.
+BINARY_PINS_V1 = [
+    ("b10101026331026e31000000000000000383a474797065a374786ea66d73675f696403a374786e9493a6617070656e6401a2733093a6617070656e6402cf000000020000000593a6617070656e6403cb400400000000000093a6617070656e640481a4686173684d",
+     {"src": "c1", "dest": "n1",
+      "body": {"type": "txn", "msg_id": 3,
+               "txn": [["append", 1, "s0"], ["append", 2, 8589934597],
+                       ["append", 3, 2.5], ["append", 4, {"hash": 77}]]}}),
+    ("b10100026e31026331000000000000000984a474797065a674786e5f6f6ba66d73675f696409ab696e5f7265706c795f746f03a374786e9193a172079301a27330cb4004000000000000",
+     {"src": "n1", "dest": "c1",
+      "body": {"type": "txn_ok", "msg_id": 9, "in_reply_to": 3,
+               "txn": [["r", 7, [1, "s0", 2.5]]]}}),
+    ("b10102026e31026e32000000000000001183a474797065aa6163636f72645f726571a66d73675f696411a77061796c6f616484a25f74a9507265416363657074a674786e5f696482a25f74a3544944a17693ce00010000ce0010001001a96d61785f65706f636801a96d696e5f65706f636801",
+     {"src": "n1", "dest": "n2",
+      "body": {"type": "accord_req", "msg_id": 17,
+               "payload": {"_t": "PreAccept",
+                           "txn_id": {"_t": "TID",
+                                      "v": [65536, 1048592, 1]},
+                           "max_epoch": 1, "min_epoch": 1}}}),
+    ("b10105026e31026e32800000000000000082a474797065ac6163636f72645f6261746368a46d7367739283a474797065aa6163636f72645f726571a66d73675f696412a77061796c6f616482a25f74a25453a1769301020384a474797065aa6163636f72645f727370a66d73675f696413ab696e5f7265706c795f746f04a77061796c6f616482a25f74a342414ca17693050607",
+     {"src": "n1", "dest": "n2",
+      "body": {"type": "accord_batch",
+               "msgs": [{"type": "accord_req", "msg_id": 18,
+                         "payload": {"_t": "TS", "v": [1, 2, 3]}},
+                        {"type": "accord_rsp", "msg_id": 19,
+                         "in_reply_to": 4,
+                         "payload": {"_t": "BAL", "v": [5, 6, 7]}}]}}),
+    ("b10106026331026e31000000000000000182a474797065a470696e67a66d73675f696401",
+     {"src": "c1", "dest": "n1", "body": {"type": "ping", "msg_id": 1}}),
+    ("b10106026331026e31000000000000000282a474797065a57374617473a66d73675f696402",
+     {"src": "c1", "dest": "n1", "body": {"type": "stats", "msg_id": 2}}),
+    ("b10106026e3100800000000000000084a474797065ab636f6465635f68656c6c6fa466726f6da26e31a5636f646563a662696e617279a776657273696f6e01",
+     {"src": "n1", "dest": "",
+      "body": {"type": "codec_hello", "from": "n1", "codec": "binary",
+               "version": 1}}),
+    ("b101010363c3a9026e31fffffffffffffffb83a474797065a374786ea66d73675f6964fba374786e9193a172a4636cc3a9c0",
+     {"src": "cé", "dest": "n1",
+      "body": {"type": "txn", "msg_id": -5, "txn": [["r", "clé", None]]}}),
+]
+
+ALL_BINARY_PINS = {1: BINARY_PINS_V1}
+
+
+def test_binary_codec_golden_pins_freeze_the_format():
+    assert set(ALL_BINARY_PINS) == set(wcodec.SUPPORTED_VERSIONS), \
+        "every supported codec version must carry pins (and vice versa)"
+    for version, pins in ALL_BINARY_PINS.items():
+        for hex_bytes, pkt in pins:
+            pinned = bytes.fromhex(hex_bytes)
+            assert pinned[1] == version
+            # decoder compatibility: every pinned frame of every
+            # supported version decodes to the exact packet, forever
+            assert wcodec.decode_payload(pinned) == pkt, \
+                f"v{version} pin no longer decodes: {pkt}"
+            # cross-codec identity: the JSON debug codec agrees
+            assert wcodec.decode_payload(
+                wcodec.encode_packet(pkt, "json")) == pkt
+    # encoder freeze: the CURRENT version's pins are what the encoder
+    # emits today — any byte change here is an unversioned format change
+    for hex_bytes, pkt in ALL_BINARY_PINS[wcodec.VERSION]:
+        assert wcodec.encode_packet(pkt, "binary").hex() == hex_bytes, \
+            (f"binary encoding changed for {pkt} — bump codec.VERSION, "
+             f"keep the old pins decoding, and pin the new bytes")
 
 
 # ---------------------------------------------------------------------------
@@ -334,7 +479,7 @@ def test_socket_fault_unknown_kind_rejected():
 # kernel under partial reads and coalesced writes
 # ---------------------------------------------------------------------------
 
-def _loopback_roundtrip(frames, write_plan):
+def _loopback_roundtrip(frames, write_plan, codec="json"):
     """Echo ``frames`` (encoded bytes) through a real asyncio TCP loopback
     server using ``write_plan(blob) -> [chunk, ...]`` to segment the
     client->server stream; returns the decoded packets the server saw and
@@ -353,7 +498,7 @@ def _loopback_roundtrip(frames, write_plan):
                     break
                 for pkt in dec.feed(chunk):
                     seen.append(pkt)
-                    writer.write(encode_frame(pkt))   # echo re-encoded
+                    writer.write(encode_frame(pkt, codec))  # echo re-encoded
                     await writer.drain()
             writer.close()
 
@@ -417,18 +562,29 @@ def _golden_packets():
         pkts.append({"src": f"n{src}", "dest": f"n{dst}",
                      "body": {"type": "accord_req", "msg_id": 1000 + n,
                               "payload": wire.encode(req)}})
+    # r16: batch envelopes (real protocol payloads riding one frame) and
+    # the codec_hello handshake join the corpus — the acceptance requires
+    # envelopes round-tripping byte-identical over a real socket
+    bodies = [p["body"] for p in pkts[-6:]]
+    pkts.append({"src": "n1", "dest": "n2",
+                 "body": {"type": "accord_batch", "msgs": bodies}})
+    from accord_tpu.net.codec import hello_body
+    pkts.append({"src": "n1", "dest": "n2",
+                 "body": hello_body("n1", "binary")})
     return pkts
 
 
-def test_golden_frames_roundtrip_loopback_byte_identical():
-    """Every golden wire frame crosses a real kernel socket and comes back
-    BYTE-IDENTICAL, under three segmentations: one-shot coalesced write,
-    per-frame writes, and a deterministic shredder (partial frames across
-    write boundaries).  The server decodes with 7-byte reads (forced
-    partial reads) and re-encodes — so byte-identity also proves
-    decode -> re-encode is the identity on every frame."""
+@pytest.mark.parametrize("codec", ["json", "binary"])
+def test_golden_frames_roundtrip_loopback_byte_identical(codec):
+    """Every golden wire frame (incl. batch envelopes + codec_hello)
+    crosses a real kernel socket and comes back BYTE-IDENTICAL under BOTH
+    codecs, under three segmentations: one-shot coalesced write, per-frame
+    writes, and a deterministic shredder (partial frames across write
+    boundaries).  The server decodes with 7-byte reads (forced partial
+    reads) and re-encodes — so byte-identity also proves decode ->
+    re-encode is the identity on every frame."""
     pkts = _golden_packets()
-    frames = [encode_frame(p) for p in pkts]
+    frames = [encode_frame(p, codec) for p in pkts]
     want = b"".join(frames)
 
     def coalesced(blob):
@@ -447,9 +603,253 @@ def test_golden_frames_roundtrip_loopback_byte_identical():
         return out
 
     for plan in (coalesced, per_frame, shredded):
-        seen, got = _loopback_roundtrip(frames, plan)
+        seen, got = _loopback_roundtrip(frames, plan, codec)
         assert seen == pkts, f"decode mismatch under {plan.__name__}"
         assert got == want, f"byte mismatch under {plan.__name__}"
+
+
+# ---------------------------------------------------------------------------
+# cross-request fused fan-out (r16): the batch envelope is protocol-
+# invisible, the server batches per peer per tick, the link coalesces
+# writes, and sheds decide pre-decode
+# ---------------------------------------------------------------------------
+
+def test_batch_envelope_protocol_invisible():
+    """N bodies delivered in one accord_batch envelope must drive the
+    EXACT same per-op protocol path as N separate frames: same emitted
+    packets, same order, same replies."""
+    from accord_tpu import api
+    from accord_tpu.maelstrom.node import MaelstromProcess
+
+    class Scheduler(api.Scheduler):
+        def __init__(self):
+            self.q = []
+
+        def now(self, run):
+            self.q.append(run)
+
+        def once(self, delay, run):
+            class S(api.Scheduled):
+                cancelled = False
+
+                def cancel(self):
+                    self.cancelled = True
+
+                def is_cancelled(self):
+                    return self.cancelled
+            return S()
+
+        def recurring(self, interval, run):
+            return self.once(interval, run)
+
+        def drain(self):
+            while self.q:
+                self.q.pop(0)()
+
+    def mk():
+        sent = []
+        sched = Scheduler()
+        proc = MaelstromProcess(
+            emit=lambda dest, body: sent.append((dest, body)),
+            scheduler=sched, now_micros=lambda: 0,
+            num_stores=2, device_mode=False, durability=False)
+        proc.handle({"src": "boot", "dest": "n1",
+                     "body": {"type": "init", "msg_id": 0, "node_id": "n1",
+                              "node_ids": ["n1", "n2", "n3"]}})
+        sched.drain()
+        del sent[:]   # drop init_ok
+        return proc, sched, sent
+
+    txns = [{"type": "txn", "msg_id": 10 + i,
+             "txn": [["append", 7 + i, i], ["r", 7 + i, None]]}
+            for i in range(4)]
+    solo_proc, solo_sched, solo_sent = mk()
+    for body in txns:
+        solo_proc.handle({"src": "c1", "dest": "n1", "body": body})
+        solo_sched.drain()
+    batch_proc, batch_sched, batch_sent = mk()
+    batch_proc.handle({"src": "c1", "dest": "n1",
+                       "body": {"type": "accord_batch", "msgs": txns}})
+    batch_sched.drain()
+    assert solo_sent == batch_sent, \
+        "the envelope changed what the protocol emitted"
+    assert len(batch_sent) > 0   # PreAccepts actually fanned out
+
+
+def test_server_batches_peer_fanout_per_tick():
+    """Bodies emitted to one peer within one event-loop tick leave as ONE
+    accord_batch frame; a lone body stays a plain frame (no envelope
+    overhead when there is nothing to share)."""
+    from accord_tpu.net.server import NodeServer
+
+    class FakeLink:
+        def __init__(self):
+            self.frames = []
+
+        def send(self, frame):
+            self.frames.append(frame)
+
+    async def run():
+        server = NodeServer("n1", "127.0.0.1", 0, {"n2": ("h", 1)})
+        server.loop = asyncio.get_event_loop()
+        link = FakeLink()
+        server.links = {"n2": link}
+        for i in range(3):
+            server._emit("n2", {"type": "accord_req", "msg_id": i,
+                                "payload": i})
+        await asyncio.sleep(0)   # let the call_soon flush run
+        server._emit("n2", {"type": "accord_req", "msg_id": 9,
+                            "payload": 9})
+        await asyncio.sleep(0)
+        return server, link
+
+    server, link = asyncio.run(run())
+    assert len(link.frames) == 2
+    dec = FrameDecoder()
+    first, second = dec.feed(b"".join(link.frames))
+    assert first["body"]["type"] == "accord_batch"
+    assert [m["msg_id"] for m in first["body"]["msgs"]] == [0, 1, 2]
+    assert second["body"]["msg_id"] == 9   # lone body: no envelope
+    assert server.n_batched_fanouts == 1
+    assert server.n_batched_ops == 3
+    assert server.batch_sizes == {3: 1, 1: 1}
+    assert server.batch_occupancy_p50() in (1, 3)
+
+
+def test_fast_shed_decides_before_body_decode():
+    """Under overload a binary txn frame is shed from its fixed-offset
+    header alone.  Proof: the frame's BODY bytes are deliberately invalid
+    msgpack — any attempt to decode them would raise — yet the shed reply
+    still goes out, Overloaded, correlated to the right msg_id."""
+    from accord_tpu.net.server import NodeServer
+
+    class Gate:
+        def __init__(self):
+            self.inflight = 8
+            self.sheds = 0
+
+        def effective_budget(self):
+            return 8
+
+        def try_admit(self):
+            self.sheds += 1
+            return False, "inflight", 50
+
+    class Proc:
+        journal = None
+
+        def __init__(self, server):
+            self.server = server
+            self._client_msg_id = 0
+
+        def _reply_client(self, dest, in_reply_to, body):
+            self._client_msg_id += 1
+            body = dict(body)
+            body["msg_id"] = self._client_msg_id
+            body["in_reply_to"] = in_reply_to
+            self.server._emit(dest, body)
+
+    class W:
+        class transport:
+            @staticmethod
+            def get_write_buffer_size():
+                return 0
+
+        written = []
+
+        def write(self, data):
+            W.written.append(data)
+
+    async def run():
+        server = NodeServer("n1", "127.0.0.1", 0, {})
+        server.loop = asyncio.get_event_loop()
+        server.gate = Gate()
+        server.proc = Proc(server)
+        # valid v1 prelude for a txn from c7 msg_id 33, then garbage that
+        # no msgpack decoder would accept
+        good = wcodec.encode_packet(
+            {"src": "c7", "dest": "n1",
+             "body": {"type": "txn", "msg_id": 33, "txn": []}}, "binary")
+        # prelude = magic+ver+kind, len+src, len+dest, 8-byte msg_id
+        body_off = 3 + 1 + len(b"c7") + 1 + len(b"n1") + 8
+        poisoned = good[:body_off] + b"\xc1\xc1\xc1\xc1"   # 0xc1: never
+        #                                                    valid msgpack
+        w = W()
+        server._on_payload(poisoned, w)
+        await asyncio.sleep(0)   # tick flush for the client write
+        return server, w
+
+    server, w = asyncio.run(run())
+    assert server.n_fast_sheds == 1
+    assert server.gate.sheds == 1
+    assert len(W.written) == 1
+    reply = FrameDecoder().feed(W.written[0])[0]
+    assert reply["body"]["overloaded"] is True
+    assert reply["body"]["in_reply_to"] == 33
+    assert reply["dest"] == "c7"
+
+
+def test_peer_link_coalesces_queued_frames_into_one_write():
+    """Frames queued on a PeerLink while it dials leave in ONE joined
+    write once connected — and every frame arrives intact."""
+    from accord_tpu.net.transport import PeerLink
+
+    async def run():
+        reads = []
+        got = asyncio.Event()
+
+        async def handle(reader, writer):
+            while True:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    break
+                reads.append(chunk)
+                if sum(len(c) for c in reads) >= want:
+                    got.set()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        frames = [encode_frame({"src": "a", "dest": "b",
+                                "body": {"type": "accord_req", "msg_id": i,
+                                         "payload": "x" * 50}}, "binary")
+                  for i in range(6)]
+        want = sum(len(f) for f in frames)
+        link = PeerLink("a", "b", "127.0.0.1", port, RandomSource(3),
+                        linger_micros=0)
+        for f in frames:
+            link.send(f)   # queued BEFORE the link ever connects
+        link.start()
+        await asyncio.wait_for(got.wait(), 10)
+        await link.close()
+        server.close()
+        await server.wait_closed()
+        return frames, reads, link
+
+    frames, reads, link = asyncio.run(run())
+    dec = FrameDecoder()
+    out = []
+    for chunk in reads:
+        out.extend(dec.feed(chunk))
+    assert [p["body"]["msg_id"] for p in out] == list(range(6))
+    assert link.n_sent == 6
+    assert link.n_writes < 6, "no write coalescing happened"
+    assert link.n_frames_coalesced == 6 - link.n_writes
+    assert link.bytes_tx == sum(len(f) for f in frames)
+
+
+def test_coalesce_window_priced_not_thresholded():
+    from accord_tpu.net.transport import (COALESCE_MAX_MICROS,
+                                          coalesce_window_micros,
+                                          probe_write_micros)
+    w = coalesce_window_micros()
+    assert 0 <= w <= COALESCE_MAX_MICROS
+    assert probe_write_micros() >= 1
+    import os
+    os.environ["ACCORD_TPU_COALESCE_US"] = "123"
+    try:
+        assert coalesce_window_micros() == 123
+    finally:
+        del os.environ["ACCORD_TPU_COALESCE_US"]
 
 
 # ---------------------------------------------------------------------------
@@ -458,12 +858,30 @@ def test_golden_frames_roundtrip_loopback_byte_identical():
 # ---------------------------------------------------------------------------
 
 def test_tcp_cluster_smoke_two_nodes():
-    """Tier-1: 2 OS processes on loopback TCP, 100 client txns with
-    retry-with-backoff, tight sink timeouts.  Full success, zero duplicate
-    client replies, both nodes alive at the end."""
+    """Tier-1: 2 OS processes on loopback TCP (binary codec default), 100
+    client txns with retry-with-backoff, tight sink timeouts.  Full
+    success, zero duplicate client replies, both nodes alive, and the r16
+    serving counters live (wire bytes counted; fan-out batching active
+    under concurrency)."""
     from accord_tpu.net.harness import run_smoke
     result = run_smoke(n_txns=100, n_nodes=2)
     assert result["ok"] == 100
+    assert result["duplicate_replies"] == 0
+    assert all(result["alive"].values())
+    net = result["net"]
+    assert net["wire_bytes_tx"] > 0 and net["wire_bytes_rx"] > 0
+    assert net["batched_fanouts"] > 0, \
+        "concurrent txns never shared a fan-out envelope"
+    assert net["frames_coalesced"] > 0, \
+        "no two frames ever shared a link write"
+
+
+def test_tcp_cluster_smoke_json_debug_codec():
+    """The JSON debug codec stays a first-class citizen: same smoke, same
+    contract, --wire-codec json end to end."""
+    from accord_tpu.net.harness import run_smoke
+    result = run_smoke(n_txns=40, n_nodes=2, wire_codec="json")
+    assert result["ok"] == 40
     assert result["duplicate_replies"] == 0
     assert all(result["alive"].values())
 
@@ -581,12 +999,14 @@ def test_malformed_txns_do_not_leak_admission_slots():
 
 
 def test_kill9_restart_with_journal_recovers_state():
-    """The r13 durability contract end to end: kill -9 a node mid-load,
-    restart it with the same --journal-dir — it recovers its pre-crash
-    command state (WAL replay), answers a duplicate of an
-    already-answered request from the journaled at-most-once table
-    (same reply, no re-coordination, the append lands exactly once),
-    and zero duplicate client replies are ever observed."""
+    """The r13 durability contract end to end, now under r16 batching:
+    kill -9 a node mid-load — mid-coalesced-batch, since concurrent txns
+    share fan-out envelopes and link writes by construction — restart it
+    with the same --journal-dir: it recovers its pre-crash command state
+    (WAL replay), answers a duplicate of an already-answered request from
+    the journaled at-most-once table (the SAME reply, no re-coordination,
+    the append lands exactly once), and zero duplicate client replies are
+    ever observed."""
     import random
     import tempfile
 
@@ -598,20 +1018,35 @@ def test_kill9_restart_with_journal_recovers_state():
     cluster.spawn_all()
     try:
         async def scenario():
-            client = ClusterClient(cluster.addrs, timeout=8.0)
+            client = ClusterClient(cluster.addrs, timeout=8.0,
+                                   codec="binary")
             try:
                 await wait_ready(cluster, client)
                 rng = random.Random(5)
                 counter = [0]
 
-                async def burst(n, nodes):
-                    for i in range(n):
-                        await client.submit_retry(
-                            _mk_ops(rng, counter, 16), retries=12,
-                            timeout=6.0, node=nodes[i % len(nodes)])
+                async def burst(n, nodes, width=4):
+                    # CONCURRENT submits: same-tick txns share fan-out
+                    # envelopes and coalesced writes, so the kill below
+                    # lands mid-batch, not between lone frames
+                    sem = asyncio.Semaphore(width)
+
+                    async def one(i):
+                        async with sem:
+                            await client.submit_retry(
+                                _mk_ops(rng, counter, 16), retries=12,
+                                timeout=6.0, node=nodes[i % len(nodes)])
+                    await asyncio.gather(*(one(i) for i in range(n)))
 
                 # phase 1: journaled load through every node
                 await burst(10, cluster.names)
+                # the batching machinery is demonstrably active on the
+                # node about to die (its journaled replies ride
+                # coalesced writes)
+                s = await client.stats("n2")
+                assert s["wire_codec"] == "binary"
+                assert s["batching"]["batched_fanouts"] > 0 \
+                    or s["frames_coalesced"] > 0, s["batching"]
                 # one append with a pinned msg_id so the SAME request can
                 # be replayed across the death
                 ops = [["append", 7, 424242], ["r", 7, None]]
@@ -863,15 +1298,20 @@ def test_overload_sheds_instead_of_collapsing():
 
 @pytest.mark.slow
 @pytest.mark.faults
+@pytest.mark.parametrize("codec", ["json", "binary"])
 @pytest.mark.parametrize("spec", ["conn_reset:0.04:5", "stalled_peer:0.03:5",
                                   "slow_link:0.25:5"])
-def test_smoke_under_socket_faults(spec):
-    """Each socket-fault class, armed in every node process: the cluster
-    recovers every txn (sink timeouts + reconnect backoff own recovery)
-    with zero duplicate client replies.  tools/run_fault_matrix.sh runs
-    the same legs with post-mortem dumps."""
+def test_smoke_under_socket_faults(spec, codec):
+    """Each socket-fault class x each wire codec, armed in every node
+    process: the cluster recovers every txn (sink timeouts + reconnect
+    backoff own recovery) with zero duplicate client replies — under
+    conn_reset that includes a half-written coalesced batch dying on the
+    wire: the at-most-once contract means the lost ops time out and
+    retry, never replay.  tools/run_fault_matrix.sh runs the same legs
+    with post-mortem dumps."""
     from accord_tpu.net.harness import run_smoke
-    result = run_smoke(n_txns=60, n_nodes=2, net_faults=spec)
+    result = run_smoke(n_txns=60, n_nodes=2, net_faults=spec,
+                       wire_codec=codec)
     assert result["ok"] == 60
     assert result["duplicate_replies"] == 0
     assert all(result["alive"].values())

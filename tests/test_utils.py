@@ -128,3 +128,68 @@ def test_searchable_range_list_matches_bruteforce():
             got = sorted(p for _s, _e, p in idx.overlapping(lo, hi))
             want = sorted(p for s, e, p in entries if s < hi and e > lo)
             assert got == want, (trial, lo, hi, got, want)
+
+
+def test_range_map_splice_add_matches_merge_add():
+    """r16: ``ReducingRangeMap.add`` splices single ranges in O(log N +
+    touched) instead of the full merge rebuild (one add per commit on the
+    serving hot path).  The splice must produce the IDENTICAL canonical
+    compacted form the merge path produces — boundaries AND values — for
+    every reduce function, including reducers that equalize neighbouring
+    gaps (max above both) and non-commutative ones."""
+    import random
+
+    def merge_add(m, ranges, value, fn):
+        out = m
+        for r in ranges:
+            out = out.merge(ReducingRangeMap.of_ranges([r], value), fn)
+        return out
+
+    fns = [lambda a, b: a if a >= b else b,   # max: the watermark shape
+           lambda a, b: a + b,                # accumulating
+           lambda a, b: min(a, b),
+           lambda a, b: b]                    # last-writer (non-commut.)
+    rng = random.Random(11)
+    for trial in range(400):
+        fn = rng.choice(fns)
+        m_new = ReducingRangeMap.empty()
+        m_old = ReducingRangeMap.empty()
+        for _step in range(rng.randint(1, 12)):
+            n = rng.randint(1, 3)
+            pts = sorted(rng.sample(range(0, 64), 2 * n))
+            ranges = [Range(pts[2 * i], pts[2 * i + 1]) for i in range(n)
+                      if pts[2 * i] < pts[2 * i + 1]]
+            if not ranges:
+                continue
+            val = rng.randint(0, 5)
+            m_new = m_new.add(ranges, val, fn)
+            m_old = merge_add(m_old, ranges, val, fn)
+            assert m_new.boundaries == m_old.boundaries, (trial, m_new, m_old)
+            assert m_new.values == m_old.values, (trial, m_new, m_old)
+        # the results keep answering point queries identically
+        for t in range(-2, 66):
+            assert m_new.get(t) == m_old.get(t)
+
+
+def test_range_map_splice_add_edges():
+    """Splice edge shapes: exact-boundary hits, containment, adjacency,
+    empty map, full overwrite."""
+    fmax = lambda a, b: a if a >= b else b   # noqa: E731
+    m = ReducingRangeMap.empty().add([Range(10, 20)], 5, fmax)
+    assert (m.boundaries, m.values) == ((10, 20), (None, 5, None))
+    # same range, smaller value: unchanged (max), still compacted
+    m2 = m.add([Range(10, 20)], 3, fmax)
+    assert (m2.boundaries, m2.values) == ((10, 20), (None, 5, None))
+    # interior sub-range with larger value splits
+    m3 = m.add([Range(12, 15)], 9, fmax)
+    assert (m3.boundaries, m3.values) == ((10, 12, 15, 20),
+                                          (None, 5, 9, 5, None))
+    # covering range with a larger value swallows the splits back
+    m4 = m3.add([Range(0, 30)], 9, fmax)
+    assert (m4.boundaries, m4.values) == ((0, 30), (None, 9, None))
+    # adjacency: [20, 30) with the same value extends without a seam
+    m5 = m.add([Range(20, 30)], 5, fmax)
+    assert (m5.boundaries, m5.values) == ((10, 30), (None, 5, None))
+    # exact left-edge overwrite
+    m6 = m.add([Range(10, 12)], 7, fmax)
+    assert (m6.boundaries, m6.values) == ((10, 12, 20), (None, 7, 5, None))

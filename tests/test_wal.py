@@ -17,6 +17,7 @@ import shutil
 import pytest
 
 from accord_tpu.journal import DurableJournal, JournaledKVDataStore
+from accord_tpu.journal import record as rec_mod
 from accord_tpu.journal import segment as seg_mod
 from accord_tpu.journal import snapshot as snap_mod
 from accord_tpu.journal.commit import GroupCommit
@@ -149,7 +150,7 @@ def test_wal_stale_recycled_segment_content_dropped(tmp_path):
     drop the stale bytes — never rewind tail_seq below the real tail and
     silently skip later appends as 'already snapshotted'."""
     w = WriteAheadLog(str(tmp_path / "j"), segment_bytes=512)
-    for i in range(40):
+    for i in range(100):
         w.append({"k": "hlc", "b": i})
     w.sync()
     w.close()
@@ -207,6 +208,161 @@ def test_frame_rejects_garbage_length(tmp_path):
     p.write_bytes(b"\xff\xff\xff\xff GET / HTTP/1.1\r\n")
     header, payloads, valid_end, _size = seg_mod.scan(str(p))
     assert header is None and payloads == [] and valid_end == 0
+
+
+# ---------------------------------------------------------------------------
+# versioned binary record codec (r16): the WAL-side twin of the wire
+# codec's golden-frame gate.  The pins freeze the v1 bytes — an encoder
+# change without a version bump fails here, and every SUPPORTED version's
+# pins must keep decoding forever (journals on disk outlive processes).
+# ---------------------------------------------------------------------------
+
+WAL_RECORD_PINS_V1 = [
+    ("b20184a16ba36d7367a16602a17084a25f74a9507265416363657074a674786e5f"
+     "696482a25f74a3544944a17693ce00010000ce0010001001a96d61785f65706f63"
+     "6801a96d696e5f65706f636801a17307",
+     {"k": "msg", "f": 2,
+      "p": {"_t": "PreAccept",
+            "txn_id": {"_t": "TID", "v": [65536, 1048592, 1]},
+            "max_epoch": 1, "min_epoch": 1}, "s": 7}),
+    ("b20189a16ba3726567a373696400a17482a25f74a3544944a17693ce0001000010"
+     "01a2737382a25f74a25353a1760da2657882a25f74a25453a17693ce0001000020"
+     "02a2707282a25f74a342414ca17693000000a26163c0a2647582a25f74a3445552"
+     "a17600a17308",
+     {"k": "reg", "sid": 0, "t": {"_t": "TID", "v": [65536, 16, 1]},
+      "ss": {"_t": "SS", "v": 13}, "ex": {"_t": "TS", "v": [65536, 32, 2]},
+      "pr": {"_t": "BAL", "v": [0, 0, 0]}, "ac": None,
+      "du": {"_t": "DUR", "v": 0}, "s": 8}),
+    ("b20185a16ba57265706c79a3737263a26331a16d03a16284a474797065a674786e"
+     "5f6f6ba66d73675f696409ab696e5f7265706c795f746f03a374786e9193a17207"
+     "9301a27330cb4004000000000000a17309",
+     {"k": "reply", "src": "c1", "m": 3,
+      "b": {"type": "txn_ok", "msg_id": 9, "in_reply_to": 3,
+            "txn": [["r", 7, [1, "s0", 2.5]]]}, "s": 9}),
+    ("b20186a16ba56170706c79a3746f6bcd3039a1769301a27330cb40040000000000"
+     "00a2617482a25f74a25453a17693ce000100003003a17482a25f74a3544944a176"
+     "93ce000100001001a1730a",
+     {"k": "apply", "tok": 12345, "v": [1, "s0", 2.5],
+      "at": {"_t": "TS", "v": [65536, 48, 3]},
+      "t": {"_t": "TID", "v": [65536, 16, 1]}, "s": 10}),
+    # the columnar v2 reg row — what _drain_pending_registers actually
+    # writes (over half of all WAL records); the keyed pin above is the
+    # r13 legacy shape kept for decode-forever.  One plain executeAt,
+    # one with the 4th-element TxnId tag (the fast path): reordering the
+    # 'c' list or dropping the tag must fail here, not on replay.
+    ("b20183a16ba3726567a163970393ce000100003001a74170706c69656493ce0001"
+     "0000400293000000c0a84d616a6f72697479a17302",
+     {"k": "reg", "c": [3, [65536, 48, 1], "Applied", [65536, 64, 2],
+                        [0, 0, 0], None, "Majority"], "s": 2}),
+    ("b20183a16ba3726567a163970093ce000100001001ab50726541636365707465"
+     "6494ce00010000100101c0c0aa4e6f7444757261626c65a1730d",
+     {"k": "reg", "c": [0, [65536, 16, 1], "PreAccepted",
+                        [65536, 16, 1, 1], None, None, "NotDurable"],
+      "s": 13}),
+    ("b20183a16ba3686c63a162ce00100000a1730b",
+     {"k": "hlc", "b": 1048576, "s": 11}),
+    ("b20185a16ba2776da373696401a16491920064a17291920032a1730c",
+     {"k": "wm", "sid": 1, "d": [[0, 100]], "r": [[0, 50]], "s": 12}),
+]
+ALL_WAL_RECORD_PINS = {1: WAL_RECORD_PINS_V1}
+
+
+def test_wal_record_golden_pins_v1():
+    assert rec_mod.VERSION in ALL_WAL_RECORD_PINS, \
+        "a format bump must pin its new bytes here"
+    for hexpin, doc in ALL_WAL_RECORD_PINS[rec_mod.VERSION]:
+        assert rec_mod.encode_record(doc, "binary").hex() == hexpin, \
+            f"encoder drift without a version bump (doc {doc['k']!r})"
+
+
+def test_wal_record_all_versions_decode_forever():
+    for ver, pins in ALL_WAL_RECORD_PINS.items():
+        assert ver in rec_mod.SUPPORTED_VERSIONS
+        for hexpin, doc in pins:
+            assert rec_mod.decode_record(bytes.fromhex(hexpin)) == doc
+            # the debug codec must carry the identical doc
+            assert rec_mod.decode_record(
+                rec_mod.encode_record(doc, "json")) == doc
+
+
+def test_wal_record_big_int_falls_back_to_json():
+    doc = {"k": "hlc", "b": 1 << 70, "s": 1}
+    payload = rec_mod.encode_record(doc, "binary")
+    assert payload[:1] == b"{", "out-of-range int must ride JSON"
+    assert rec_mod.decode_record(payload) == doc
+
+
+def test_wal_mixed_codec_journals_replay_identically(tmp_path):
+    docs = [d for _h, d in WAL_RECORD_PINS_V1]
+    states = {}
+    for codec in ("json", "binary"):
+        w = WriteAheadLog(str(tmp_path / codec), record_codec=codec)
+        for d in docs:
+            w.append({k: v for k, v in d.items() if k != "s"})
+        w.sync()
+        w.close()
+        r = WriteAheadLog(str(tmp_path / codec))
+        states[codec] = json.dumps(r.recovered, sort_keys=True)
+        r.close()
+    assert states["json"] == states["binary"]
+    # one journal may MIX codecs (per-record fallback): reopen the binary
+    # journal and append under json — the sniffing decode sees all
+    w = WriteAheadLog(str(tmp_path / "binary"), record_codec="json")
+    w.append({"k": "hlc", "b": 777})
+    w.sync()
+    w.close()
+    r = WriteAheadLog(str(tmp_path / "binary"))
+    assert len(r.recovered) == len(docs) + 1
+    assert r.recovered[-1]["b"] == 777
+    r.close()
+
+
+def test_reg_record_r13_keyed_shape_still_replays(tmp_path):
+    """Journals on disk outlive code: the pre-r16 wire-encoded reg row
+    shape must keep installing registers forever, alongside the columnar
+    v2 rows current code writes."""
+    from accord_tpu.local.status import Durability, SaveStatus
+    j = _mk_journal(tmp_path / "j")
+    j._replaying = True
+    j.apply_record({"k": "reg", "sid": 3,
+                    "t": {"_t": "TID", "v": [65536, 16, 1]},
+                    "ss": {"_t": "SaveStatus", "n": "Stable"},
+                    "ex": {"_t": "TS", "v": [65536, 32, 2]},
+                    "pr": {"_t": "BAL", "v": [0, 0, 0]},
+                    "ac": None,
+                    "du": {"_t": "Durability", "n": "NotDurable"},
+                    "s": 1})
+    j.apply_record({"k": "reg", "c": [
+        3, [65536, 48, 1], "Applied", [65536, 64, 2],
+        [0, 0, 0], None, "Majority"], "s": 2})
+    j._replaying = False
+    regs = j._registers[3]
+    assert len(regs) == 2
+    old, new = sorted(regs.items(), key=lambda kv: kv[0])
+    assert old[1].save_status is SaveStatus.Stable
+    assert old[1].accepted is None
+    assert new[1].save_status is SaveStatus.Applied
+    assert new[1].durability is Durability.Majority
+    assert new[1].execute_at.lsb == 64
+    j.close()
+
+
+def test_wal_unknown_record_version_fails_open(tmp_path):
+    from accord_tpu.journal.record import MAGIC, RecordError
+    w = WriteAheadLog(str(tmp_path / "j"))
+    w.append({"k": "hlc", "b": 1})
+    w.sync()
+    w.close()
+    path = sorted(p for p in os.listdir(tmp_path / "j")
+                  if p.startswith("wal-"))[0]
+    seg = tmp_path / "j" / path
+    import struct
+    import zlib
+    payload = bytes((MAGIC, 0x7F)) + b"\x80"
+    fr = struct.pack(">II", len(payload), zlib.crc32(payload)) + payload
+    seg.write_bytes(seg.read_bytes() + fr)
+    with pytest.raises(RecordError):
+        WriteAheadLog(str(tmp_path / "j"))
 
 
 # ---------------------------------------------------------------------------
@@ -313,6 +469,46 @@ def test_durable_journal_snapshot_bounds_replay(tmp_path):
 # ---------------------------------------------------------------------------
 # reply dedupe table (satellite: at-most-once across death)
 # ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not hasattr(os, "fork"), reason="POSIX only")
+def test_fork_snapshot_offloads_capture_and_recovers(tmp_path):
+    """The serving path's BGSAVE-shaped snapshot: with a loop + worker
+    wired the capture forks; the parent's floor advances on reap and a
+    fresh open recovers from the child-written file."""
+    import asyncio
+
+    async def run():
+        loop = asyncio.get_running_loop()
+
+        def _async_exec(work, done):
+            fut = loop.run_in_executor(None, work)
+            fut.add_done_callback(lambda f: done(f.exception()))
+
+        j = DurableJournal(str(tmp_path / "j"),
+                           defer=lambda s, fn: loop.call_later(s, fn),
+                           window_micros=100, async_exec=_async_exec)
+        j.reserve_hlc(50)          # real state: snapshot must carry it
+        for i in range(50):
+            j._append({"k": "wm", "sid": 0, "d": [[0, i]], "r": []})
+        j.commit.flush(sync=True)
+        tail = j.wal.tail_seq
+        assert j.maybe_snapshot(force=True), "fork snapshot must launch"
+        assert j._snap_inflight, "capture rides the child, not this tick"
+        for _ in range(200):
+            if not j._snap_inflight:
+                break
+            await asyncio.sleep(0.05)
+        assert not j._snap_inflight, "snapshot child never reaped"
+        assert j._snap_floor == tail
+        j.close()
+
+    asyncio.run(run())
+    j2 = _mk_journal(tmp_path / "j")
+    assert j2.replay_stats["snapshot_loaded"]
+    assert j2.hlc_reserved == 50, \
+        "state must come back from the child-written snapshot"
+    j2.close()
+
 
 def test_reply_table_recovers_and_bounds(tmp_path):
     j = _mk_journal(tmp_path / "j")

@@ -54,21 +54,27 @@ import sys
 
 
 def parse_index_counters(text):
-    """{counter: int} from the bench's ``# index: k=v ...`` line (empty
-    when the artifact predates a counter or the line)."""
+    """{counter: int} from the bench's ``# index: k=v ...`` lines (empty
+    when the artifact predates a counter or the line).  r16 artifacts
+    carry a SECOND line with the serving counters (emitted after the
+    serving sweep runs); all lines merge, first occurrence of a key wins
+    — byte-identical behavior for every single-line artifact."""
+    out = {}
+    found = False
     for line in text.splitlines():
         line = line.strip()
         if line.startswith("# index:"):
-            out = {}
+            found = True
             for tok in line[len("# index:"):].split():
                 if "=" in tok:
                     key, _, val = tok.partition("=")
+                    if key in out:
+                        continue
                     try:
                         out[key] = int(val)
                     except ValueError:
                         pass
-            return out
-    return {}
+    return out if found else {}
 
 
 def parse_artifact(path, strict=True):
@@ -195,6 +201,19 @@ def main(argv=None):
                               old_idx["download_bytes"],
                               new_idx["download_bytes"],
                               args.threshold, lower_is_better=True))
+    # the r16 serving counters (per-txn normalized on the # index: line):
+    # bytes gate lower-is-better, batching depth higher-is-better — all
+    # at the wall-clock latency threshold, since the serving sweep rides
+    # the same oscillating box as every platform row
+    for key, lower in (("wire_bytes_tx", True), ("wire_bytes_rx", True),
+                       ("frames_coalesced", False),
+                       ("batched_fanouts", False),
+                       ("batch_occupancy_p50", False)):
+        if (old_idx.get(key) is not None
+                and new_idx.get(key) is not None):
+            failures.append(check(f"index.{key}", old_idx[key],
+                                  new_idx[key], args.latency_threshold,
+                                  lower_is_better=lower))
 
     common = [m for m in old_cfg if m in new_cfg]
     print(f"config rows ({len(common)} common, "

@@ -53,6 +53,19 @@ ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 # >2x move is a signal at all (same rationale as bench_compare's 2x gate)
 PHASE_THRESHOLD = 0.5
 
+# gated ``# index:`` counters and their good direction; everything else
+# on the line stays info-only (drift_notes).  r16 adds the serving
+# counters (per-txn normalized in bench.py, so they trend comparably
+# across rounds despite the box's wall-clock oscillation).
+INDEX_GATED = {
+    "download_bytes": "down",
+    "wire_bytes_tx": "down",
+    "wire_bytes_rx": "down",
+    "frames_coalesced": "up",
+    "batched_fanouts": "up",
+    "batch_occupancy_p50": "up",
+}
+
 
 def discover(dirpath):
     """[(round, path)] for every BENCH_r*.json under dirpath, round order."""
@@ -88,8 +101,7 @@ def load_series(rounds):
                 add(f"{m}.phase[{ph}].p50_ms", rnd, pd.get("p50_ms"), "down")
                 add(f"{m}.phase[{ph}].p99_ms", rnd, pd.get("p99_ms"), "down")
         for k, v in idx.items():
-            add(f"index.{k}", rnd,
-                v, "down" if k == "download_bytes" else None)
+            add(f"index.{k}", rnd, v, INDEX_GATED.get(k))
     return series
 
 

@@ -127,15 +127,22 @@ fi
 run_net_leg() {
     echo ""
     echo "== network-boundary socket-fault legs (2-process TCP smoke) =="
+    # r16: every fault class runs under BOTH wire codecs — a conn_reset
+    # tearing a half-written coalesced binary batch must behave exactly
+    # like the json debug codec's (protocol outcomes identical, zero
+    # duplicate replies; the harness asserts both)
     local rc=0
-    for spec in "conn_reset:0.04:5" "stalled_peer:0.03:5" "slow_link:0.25:5"; do
-        echo "-- leg: $spec"
-        if ! env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
-            python -m accord_tpu.net.harness --smoke --txns 60 --nodes 2 \
-            --net-faults "$spec" --out "${FAULT_MATRIX_OUT:-/tmp}"; then
-            echo "   LEG FAILED: $spec (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
-            rc=1
-        fi
+    for codec in binary json; do
+        for spec in "conn_reset:0.04:5" "stalled_peer:0.03:5" "slow_link:0.25:5"; do
+            echo "-- leg: $spec codec=$codec"
+            if ! env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+                python -m accord_tpu.net.harness --smoke --txns 60 --nodes 2 \
+                --net-faults "$spec" --wire-codec "$codec" \
+                --out "${FAULT_MATRIX_OUT:-/tmp}"; then
+                echo "   LEG FAILED: $spec codec=$codec (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
+                rc=1
+            fi
+        done
     done
     return $rc
 }
