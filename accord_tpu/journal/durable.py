@@ -147,7 +147,8 @@ class DurableJournal(Journal):
 
     def has_restored_state(self) -> bool:
         return bool(self._registers or self._bodies or self._restored_data
-                    or self.replied or self.hlc_reserved or self.max_hlc)
+                    or self.replied or self.hlc_reserved or self.max_hlc
+                    or self._topologies)
 
     def gate_protocol_replies(self) -> bool:
         return self.sync_policy == "all"
@@ -263,6 +264,19 @@ class DurableJournal(Journal):
                           "rg": wire.encode(ranges), "ep": epoch})
         super().record_bootstrap_done(store_id, ranges, epoch)
 
+    def record_topology(self, doc: dict) -> None:
+        """One topology epoch ingested or proposed (r17, elastic serving):
+        a WAL fact, so a node killed -9 mid-reconfiguration — proposer
+        mid-propose included — recovers holding the exact epoch ledger it
+        had.  The doc is already a plain JSON/msgpack payload
+        (net.reconfig.topology_to_doc), so it rides the record codec
+        as-is."""
+        if not self._replaying \
+                and not any(d.get("epoch") == doc.get("epoch")
+                            for d in self._topologies):
+            self._append({"k": "topo", "d": doc})
+        super().record_topology(doc)
+
     def reserve_hlc(self, bound: int) -> None:
         if bound <= self.hlc_reserved:
             return
@@ -368,6 +382,8 @@ class DurableJournal(Journal):
             super().record_bootstrap_done(doc["sid"],
                                           wire.decode(doc["rg"]),
                                           doc["ep"])
+        elif k == "topo":
+            super().record_topology(doc["d"])
         elif k == "hlc":
             super().reserve_hlc(doc["b"])
         elif k == "reply":
@@ -450,6 +466,11 @@ class DurableJournal(Journal):
             "hlc_reserved": self.hlc_reserved,
             "replied": [[src, m, self.replied[(src, m)]]
                         for src, m in self._replied_order],
+            # topology epoch ledger (r17): plain docs, snapshot-carried so
+            # a recovery whose WAL floor passed the topo records still
+            # restores the epoch history (absent in pre-r17 snapshots —
+            # install_state tolerates the missing key forever)
+            "topologies": list(self._topologies),
             "data": [[token, [[enc(v), enc(at), enc(t)]
                               for v, at, t in entries]]
                      for token, entries in sorted(data.items())],
@@ -489,6 +510,8 @@ class DurableJournal(Journal):
         for token, entries in state["data"]:
             self._restored_data[token] = [
                 (tuple(dec(v)), dec(at), dec(t)) for v, at, t in entries]
+        for doc in state.get("topologies", ()):   # absent pre-r17
+            self.record_topology(doc)
 
     def canonical_state_json(self,
                              data_store: Optional[KVDataStore] = None) -> str:

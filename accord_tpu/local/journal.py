@@ -107,6 +107,23 @@ class Journal:
         # diagnostics: reconstructions that had to degrade status for lack
         # of a message body (should stay 0 in healthy runs)
         self.degraded = 0
+        # topology epoch ledger (r17, elastic serving): plain epoch docs
+        # (net.reconfig.topology_to_doc shape), ascending, deduped by
+        # epoch — a restarted node recovers the epoch history it had
+        # ingested, including a proposal journaled but never broadcast
+        self._topologies: List[dict] = []
+
+    def record_topology(self, doc: dict) -> None:
+        """One ingested/proposed topology epoch (latest contiguous ledger;
+        a duplicate epoch is a no-op — ingest is idempotent)."""
+        epoch = doc.get("epoch")
+        if any(d.get("epoch") == epoch for d in self._topologies):
+            return
+        self._topologies.append(doc)
+        self._topologies.sort(key=lambda d: d.get("epoch", 0))
+
+    def topologies(self) -> List[dict]:
+        return list(self._topologies)
 
     # -- recording -----------------------------------------------------------
     def record_message(self, request, from_id: int) -> None:

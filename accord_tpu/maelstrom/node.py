@@ -333,6 +333,14 @@ class MaelstromProcess:
         # admission gate in front of coordinate (accord_tpu.net.admission;
         # None = admit everything — the sim runner and Maelstrom harness)
         self.admission = None
+        # elastic-serving reconfiguration manager (accord_tpu.net.reconfig;
+        # None = the static single-epoch Maelstrom behaviour).  When set,
+        # the node runs on its NetConfigService: epochs propagate over the
+        # wire, membership is dynamic, stores bootstrap via FetchSnapshot.
+        self.reconfig = None
+        # where unknown (non-protocol) bodies go — the TCP server routes
+        # them back into its control plane (batch-envelope riders)
+        self.control_fallback = None
         self.name: Optional[str] = None
         self.node: Optional[Node] = None
         self.sink: Optional[MaelstromSink] = None
@@ -349,6 +357,12 @@ class MaelstromProcess:
                 or j.commit.failed:
             return None
         return j
+
+    def note_peer(self, name: str) -> None:
+        """Register a peer name->id mapping learned AFTER init (a node
+        that joined via reconfiguration): outbound protocol packets to
+        its id route to its name."""
+        self._names_by_id[node_name_to_id(name)] = name
 
     # -- outbound -----------------------------------------------------------
     def emit_packet(self, to, body: dict) -> None:
@@ -398,7 +412,7 @@ class MaelstromProcess:
         self._emit_raw(dest, body)
 
     # -- inbound ------------------------------------------------------------
-    def handle(self, packet: dict) -> None:
+    def handle(self, packet: dict, _from_envelope: bool = False) -> None:
         """Process one Maelstrom packet {src, dest, body}."""
         body = packet.get("body", {})
         typ = body.get("type")
@@ -422,7 +436,7 @@ class MaelstromProcess:
             for sub in body.get("msgs") or ():
                 try:
                     self.handle({"src": src, "dest": packet.get("dest"),
-                                 "body": sub})
+                                 "body": sub}, _from_envelope=True)
                 except Exception as exc:   # one poisoned sub-body must
                     # not drop the rest of the batch on the floor
                     print(f"batch sub-handler error on "
@@ -440,7 +454,18 @@ class MaelstromProcess:
                 pass   # slotted/exotic request: journal re-encodes
             self.node.receive(request, node_name_to_id(src), body["msg_id"])
         elif typ == "accord_rsp":
-            reply = wire.decode(body["payload"])
+            payload = body["payload"]
+            if _from_envelope and self.reconfig is not None \
+                    and isinstance(payload, dict) \
+                    and payload.get("_t") == "FetchSnapshotOk":
+                # bootstrap byte accounting for the one delivery shape
+                # the frame layer cannot weigh: an ENVELOPE rider.  Such
+                # replies are small by construction (large payloads
+                # always leave as direct or chunked frames, counted for
+                # free at the server), so the re-encode here is cheap
+                # and rare.
+                self.reconfig.note_snapshot_reply(body)
+            reply = wire.decode(payload)
             self.sink.on_response(node_name_to_id(src), body["in_reply_to"],
                                   reply)
         elif typ == "accord_fail":
@@ -448,6 +473,14 @@ class MaelstromProcess:
                                           body["in_reply_to"], body["error"])
         elif typ == "txn":
             self._handle_txn(src, body)
+        elif self.control_fallback is not None:
+            # serving-surface control bodies (topo_new / epoch_sync /
+            # topo_fetch / codec_hello / accord_chunk) that rode a peer
+            # accord_batch envelope: hand them back to the server's
+            # control router — without this, any reconfiguration gossip
+            # sharing a tick with protocol traffic would be silently
+            # dropped at the unbatcher
+            self.control_fallback(packet)
 
     def _handle_init(self, src: str, body: dict) -> None:
         self.name = body["node_id"]
@@ -458,6 +491,10 @@ class MaelstromProcess:
             self._names_by_id[nid] = n
             ids.append(nid)
         my_id = node_name_to_id(self.name)
+        # self-mapping even when we are NOT an epoch-1 member (a joining
+        # node's init carries the EXISTING cluster as node_ids): loop-back
+        # and self-send detection key on it
+        self._names_by_id[my_id] = self.name
         topology = build_maelstrom_topology(ids, shards=self.shards)
         # timeout jitter on a dedicated deterministic stream seeded from
         # the node id — the protocol RandomSource below is untouched
@@ -470,9 +507,19 @@ class MaelstromProcess:
             data_store = JournaledKVDataStore(my_id, self.journal)
         else:
             data_store = KVDataStore(my_id)
+        if self.reconfig is not None:
+            # elastic serving: the node runs on the wire-backed epoch
+            # ledger; the initial history is epoch 1 (static member list)
+            # plus every journaled successor — a node killed -9
+            # mid-reconfiguration recovers into the right epoch
+            config_service = self.reconfig.config_service
+            topologies = self.reconfig.bootstrap_topologies(topology)
+        else:
+            config_service = StaticConfigService(topology)
+            topologies = [topology]
         self.node = Node(
             node_id=my_id, message_sink=self.sink,
-            config_service=StaticConfigService(topology),
+            config_service=config_service,
             scheduler=self.scheduler,
             data_store=data_store,
             agent=MaelstromAgent(self),
@@ -483,15 +530,18 @@ class MaelstromProcess:
             journal=self.journal)
         self.node.obs = self.obs
         if self.journal is not None and self.journal.has_restored_state():
-            # kill -9 recovery: re-ingest the (static) topology WITHOUT
+            # kill -9 recovery: re-ingest the epoch history WITHOUT
             # re-bootstrapping, seed the fresh data store with the
             # recovered value logs, then rebuild every store's commands
             # through the SAME restore path the sim's restart tests pin
-            self.node.restore_topologies([topology])
+            self.node.restore_topologies(topologies)
             self.journal.install_data(data_store)
             self.journal.restore(self.node)
         else:
-            self.node.on_topology_update(topology)
+            for t in topologies:
+                self.node.on_topology_update(t)
+        if self.reconfig is not None:
+            self.reconfig.attach_node(self.node)
         self._sweeper = self.scheduler.recurring(SWEEP_INTERVAL_MICROS,
                                                  self.sink.sweep)
         # background durability rounds -> watermarks -> truncation

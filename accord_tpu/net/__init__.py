@@ -33,6 +33,13 @@ Three layers (ISSUE r12):
 :mod:`accord_tpu.net.client` and :mod:`accord_tpu.net.harness` are the
 client sink (surfaces ``Overloaded`` for retry-with-backoff) and the
 open-loop (Poisson-arrival) load harness ``tools/serve_bench.py`` drives.
+
+r17 adds the elastic-serving control plane: :mod:`accord_tpu.net.reconfig`
+(live epoch reconfiguration — operator ``reconfigure`` verb, ``topo_new``
+propagation with member addresses, ``epoch_sync`` sync-quorum gossip,
+epoch retirement, dynamic peer-link lifecycle, journal-durable epoch
+ledger) and :mod:`accord_tpu.net.bootstrap` (chunk-streamed snapshot-fed
+bootstrap — ``accord_chunk`` frames through the coalescing links).
 """
 
 from .admission import AdmissionGate, Overloaded

@@ -265,6 +265,40 @@ class AdmissionGate:
         }
 
 
+def rebalance_health_of(node) -> float:
+    """Admission factor while a reconfiguration rebalance is in flight
+    (r17, elastic serving): a store bootstrapping newly-adopted ranges is
+    doing snapshot installs + fence coordination on the same single
+    thread that serves traffic, so the budget takes a PRICED cut scaled
+    to how much of the node's ownership is still migrating — the load
+    spike of a join/leave is absorbed as explicit sheds at a reduced
+    depth, never as a queue collapse.  Floored at 0.5: a rebalance slows
+    admission, it never starves it."""
+    stores = getattr(getattr(node, "command_stores", None), "stores", None)
+    if not stores:
+        return 1.0
+    try:
+        # fast path — the steady state: nothing migrating, no arithmetic
+        # (this runs on every admission check, including the per-frame
+        # fast-shed peek)
+        if all(s.bootstrapping.is_empty() for s in stores):
+            return 1.0
+    except Exception:
+        return 1.0
+    owned = boot = 0
+    for store in stores:
+        try:
+            for r in store.ranges_for_epoch.current():
+                owned += r.end - r.start
+            for r in store.bootstrapping:
+                boot += r.end - r.start
+        except Exception:
+            continue
+    if not boot or not owned:
+        return 1.0
+    return max(0.5, 1.0 - 0.5 * min(1.0, boot / owned))
+
+
 def device_health_of(node) -> float:
     """Fraction of the node's command stores whose device routes are
     healthy (not quarantined, not OOM-degraded) — the r07 ladder read the

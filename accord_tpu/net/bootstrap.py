@@ -1,0 +1,189 @@
+"""Snapshot-fed bootstrap streaming for the serving surface.
+
+The protocol data plane of a join is the reference's
+``FetchSnapshot``/``FetchSnapshotOk`` exchange (messages/fetch_snapshot.py
+— the donor defers until the ExclusiveSyncPoint fence has applied
+locally, then ships its DataStore content for the adopted ranges).  Over
+the sim's object delivery a snapshot of any size is one "message"; over
+TCP it is one FRAME, and a warm store's snapshot can outgrow both the
+coalescing sweet spot and ``MAX_FRAME`` outright.  This module is the
+transport-side answer, deliberately BELOW the protocol: any oversized
+peer body — today that is FetchSnapshotOk, tomorrow anything — is split
+into ``accord_chunk`` frames that stream through the normal coalescing
+:class:`~accord_tpu.net.transport.PeerLink` writes and are reassembled at
+the receiving server BEFORE the protocol handler sees a packet, so the
+protocol machinery stays byte-for-byte the sim's.
+
+The chunk payload is the ALREADY-ENCODED inner frame payload (either
+codec: the first reassembled byte sniffs binary-vs-JSON exactly like a
+socket read would), carried as msgpack ``bytes`` under the binary codec
+and base64 text under the JSON debug codec — one representation the
+golden pins freeze per codec.
+
+The journal connection (r13): a donor's snapshot content IS the ``data``
+section of its journal snapshot files — ``DurableJournal.encode_state``
+and ``KVDataStore.snapshot`` serialize the same token->entries log, so a
+joining node that later replays its own WAL tail across the epoch
+boundary reconstructs exactly the state the stream installed plus its
+own post-join writes (pinned by the WAL epoch-boundary tests).
+
+Reassembly is bounded: per-source partial streams are capped
+(``MAX_PENDING_BYTES``, drop-oldest) so a malicious or wedged peer
+cannot grow the receiver's memory; an aborted stream simply times out at
+the requester (the sink's callback timeout owns bootstrap retry — the
+next donor is asked, the same ladder as the sim).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .framing import encode_frame
+
+# bodies whose single-frame encoding exceeds this stream as chunks: well
+# under MAX_FRAME (16MB) and sized so a chunk write still coalesces sanely
+CHUNK_THRESHOLD = 1 << 20          # 1 MiB
+CHUNK_PART_BYTES = 256 * 1024      # per-chunk payload slice
+# reassembly memory bound per server (all sources): beyond it the OLDEST
+# partial stream is dropped — at-most-once delivery already covers loss
+MAX_PENDING_BYTES = 64 * 1024 * 1024
+# a partial stream untouched this long is an aborted transfer (donor died
+# mid-stream; its restarted incarnation uses a fresh pid-scoped cid) —
+# swept so dead partials never crowd the budget and evict live streams
+STREAM_TTL_SECONDS = 60.0
+
+_next_stream_id = [0]
+
+
+def _stream_id(me: str) -> str:
+    # pid-scoped: a restarted sender's streams can never collide with a
+    # dead incarnation's partials lingering at the receiver
+    _next_stream_id[0] += 1
+    return f"{me}#{os.getpid()}#{_next_stream_id[0]}"
+
+
+def chunk_payload_frames(src: str, dest: str, payload: bytes,
+                         codec: str) -> List[bytes]:
+    """Split one oversized (already-encoded) inner frame payload into
+    ready-to-send chunk FRAMES (length prefix included).  The inner
+    payload is encoded ONCE by the caller; each chunk carries a slice."""
+    cid = _stream_id(src)
+    parts = [payload[at:at + CHUNK_PART_BYTES]
+             for at in range(0, len(payload), CHUNK_PART_BYTES)]
+    frames = []
+    for seq, part in enumerate(parts):
+        body = {"type": "accord_chunk", "cid": cid, "seq": seq,
+                "n": len(parts),
+                "part": (part if codec == "binary"
+                         else base64.b64encode(part).decode("ascii"))}
+        frames.append(encode_frame(
+            {"src": src, "dest": dest, "body": body}, codec))
+    return frames
+
+
+def _part_bytes(part) -> bytes:
+    if isinstance(part, (bytes, bytearray)):
+        return bytes(part)
+    return base64.b64decode(part)
+
+
+class ChunkReassembler:
+    """Server-side stream reassembly: ``feed(body)`` returns the complete
+    inner payload bytes once the last chunk of a stream arrives, else
+    None.  Streams interleave freely (cid-keyed); memory is bounded."""
+
+    def __init__(self, max_pending: int = MAX_PENDING_BYTES,
+                 ttl_seconds: float = STREAM_TTL_SECONDS):
+        self.max_pending = max_pending
+        self.ttl_seconds = ttl_seconds
+        self._streams: Dict[str, Dict[int, bytes]] = {}
+        self._sizes: Dict[str, int] = {}
+        self._totals: Dict[str, int] = {}
+        self._touched: Dict[str, float] = {}
+        self._order: List[str] = []
+        self.n_chunks_rx = 0
+        self.n_streams_done = 0
+        self.n_streams_dropped = 0
+        self.bytes_rx = 0
+
+    def pending_bytes(self) -> int:
+        return sum(self._sizes.values())
+
+    def feed(self, body: dict) -> Optional[bytes]:
+        try:
+            cid = body["cid"]
+            seq = int(body["seq"])
+            total = int(body["n"])
+            part = _part_bytes(body["part"])
+        except (KeyError, TypeError, ValueError) as exc:
+            print(f"[chunk] malformed chunk dropped: {exc!r}",
+                  file=sys.stderr)
+            return None
+        self.n_chunks_rx += 1
+        self.bytes_rx += len(part)
+        if total <= 0 or not (0 <= seq < total):
+            return None
+        # sweep aborted transfers: a partial untouched past the TTL is a
+        # dead donor's orphan (its successor streams under a fresh cid)
+        now = time.monotonic()
+        for stale in [c for c, t in self._touched.items()
+                      if now - t > self.ttl_seconds and c != cid]:
+            self._drop(stale)
+            self.n_streams_dropped += 1
+        if cid in self._streams and self._totals.get(cid) != total:
+            # same cid, different declared length: a stale partial from
+            # a dead sender incarnation (stream ids are pid-scoped, so
+            # this is defense in depth) — restart the stream cleanly
+            self._drop(cid)
+            self.n_streams_dropped += 1
+        if cid not in self._streams:
+            self._streams[cid] = {}
+            self._sizes[cid] = 0
+            self._totals[cid] = total
+            self._order.append(cid)
+        self._streams[cid][seq] = part
+        self._sizes[cid] += len(part)
+        self._touched[cid] = now
+        while self.pending_bytes() > self.max_pending and self._order:
+            # drop the OLDEST other partial stream first; if THIS stream
+            # alone exceeds the whole budget, it goes too — one hostile
+            # cid must not hold unbounded memory (the sender's retry /
+            # the requester's timeout own recovery, as for any loss)
+            victim = next((c for c in self._order if c != cid), None)
+            if victim is None:
+                self._drop(cid)
+                self.n_streams_dropped += 1
+                return None
+            self._drop(victim)
+            self.n_streams_dropped += 1
+        stream = self._streams.get(cid)
+        if stream is None or len(stream) < total:
+            return None
+        parts = [stream.get(i) for i in range(total)]
+        self._drop(cid)
+        if any(p is None for p in parts):   # defensive: mixed partials
+            self.n_streams_dropped += 1
+            return None
+        self.n_streams_done += 1
+        return b"".join(parts)
+
+    def _drop(self, cid: str) -> None:
+        self._streams.pop(cid, None)
+        self._sizes.pop(cid, None)
+        self._totals.pop(cid, None)
+        self._touched.pop(cid, None)
+        try:
+            self._order.remove(cid)
+        except ValueError:
+            pass
+
+    def stats(self) -> dict:
+        return {"chunks_rx": self.n_chunks_rx,
+                "streams_done": self.n_streams_done,
+                "streams_dropped": self.n_streams_dropped,
+                "pending_bytes": self.pending_bytes(),
+                "bytes_rx": self.bytes_rx}

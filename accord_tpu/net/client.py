@@ -100,11 +100,17 @@ class NodeConnection:
                     self._on_reply(packet.get("body") or {})
         except (ConnectionError, OSError, asyncio.IncompleteReadError):
             pass
-        # connection gone: fail everything still pending on it
-        for fut in self._pending.values():
-            if not fut.done():
-                fut.set_exception(ConnectionError(f"{self.name} closed"))
-        self._pending.clear()
+        finally:
+            # connection gone OR the loop task was cancelled (reconnect /
+            # remove_node): fail everything still pending on it.  This
+            # must be a ``finally`` — cancellation used to skip it, so a
+            # caller mid-request on a re-dialed or departed node hung for
+            # its full client timeout instead of failing over immediately
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        ConnectionError(f"{self.name} closed"))
+            self._pending.clear()
 
     def _on_reply(self, body: dict) -> None:
         irt = body.get("in_reply_to")
@@ -170,6 +176,10 @@ class ClusterClient:
         self._msg_id = 0
         self._rr = 0
         self._backoff = RandomSource(retry_seed)
+        # duplicate census carried across departed nodes (r17 elastic
+        # serving: remove_node closes a conn but its observations stay —
+        # duplicates are a cluster property the kill/leave tests assert)
+        self._departed_duplicates = 0
         self.n_ok = 0
         self.n_overloaded = 0
         self.n_failed = 0
@@ -192,7 +202,33 @@ class ClusterClient:
             await conn.close()
 
     def duplicate_replies(self) -> int:
-        return sum(c.duplicate_replies for c in self.conns.values())
+        return (self._departed_duplicates
+                + sum(c.duplicate_replies for c in self.conns.values()))
+
+    # -- dynamic membership (r17, elastic serving) ----------------------------
+    async def add_node(self, name: str, host: str, port: int) -> None:
+        """Start talking to a node that joined the cluster after this
+        client connected (round-robin includes it from now on).  The
+        addr-book entry lands only after a successful dial — a raising
+        connect must not leave a half-registered name behind."""
+        if name not in self.conns:
+            conn = NodeConnection(name, host, port, self.src,
+                                  codec=self.codec)
+            await conn.connect()
+            self.conns[name] = conn
+        if not any(a[0] == name for a in self.addrs):
+            self.addrs.append((name, host, port))
+
+    async def remove_node(self, name: str) -> None:
+        """Stop talking to a node that left the cluster: close its
+        connection (pending requests on it fail over to retries on other
+        nodes) and drop it from rotation.  Its duplicate census is
+        carried — duplicates are a cluster property."""
+        conn = self.conns.pop(name, None)
+        if conn is not None:
+            self._departed_duplicates += conn.duplicate_replies
+            await conn.close()
+        self.addrs[:] = [a for a in self.addrs if a[0] != name]
 
     def _pick(self, node: Optional[str]) -> NodeConnection:
         if node is not None:
@@ -249,6 +285,17 @@ class ClusterClient:
             delay_ms = min(delay_ms * 2, 2000.0)
             node = None   # spread retries across the cluster
         raise TxnFailed({"text": f"exhausted {retries} retries"})
+
+    async def reconfigure(self, via: str, op: str,
+                          timeout: float = 10.0, **fields) -> dict:
+        """Propose epoch N+1 through node ``via``'s ``reconfigure``
+        control verb: op="add" (node=, addr=), "remove" (node=), "move"
+        (token=, node=).  Returns the reply body (reconfigure_ok /
+        error)."""
+        body = {"type": "reconfigure", "op": op}
+        body.update(fields)
+        return await self.conns[via].request(body, self.next_msg_id(),
+                                             timeout)
 
     async def ping(self, node: str, timeout: float = 5.0) -> dict:
         return await self.conns[node].request(

@@ -88,6 +88,12 @@ _KIND_OF = {
     "stats": KIND_CONTROL,
     "dump": KIND_CONTROL,
     "codec_hello": KIND_CONTROL,
+    # elastic serving (reconfig) control verbs: kind-hinted so a future
+    # pre-decode dispatch can prioritize them; bodies stay self-contained
+    "reconfigure": KIND_CONTROL,
+    "topo_new": KIND_CONTROL,
+    "epoch_sync": KIND_CONTROL,
+    "topo_fetch": KIND_CONTROL,
 }
 
 _I64 = struct.Struct(">q")
@@ -179,10 +185,20 @@ def peek_header(payload) -> Optional[Tuple[int, str, Optional[int]]]:
         return None
 
 
-def hello_body(me: str, codec: str) -> dict:
+def hello_body(me: str, codec: str, epoch: Optional[int] = None) -> dict:
     """The link-handshake announcement: first frame a PeerLink sends after
     every (re)connect.  Carries the codec name and the format version the
     link will speak so the receiving node can validate support ONCE and
-    report a mismatch in its stats instead of per-frame decode errors."""
-    return {"type": "codec_hello", "from": me, "codec": codec,
+    report a mismatch in its stats instead of per-frame decode errors.
+
+    ``epoch`` (r17, elastic serving) announces the sender's current
+    topology epoch when known: a receiver behind the announced epoch
+    fetches the gap the moment the link forms — the catch-up trigger for
+    nodes that slept through a reconfiguration.  Omitted when None, so
+    pre-r17 hellos (and their golden pins) are unchanged bytes; mixed-
+    epoch and epochless hellos interoperate on one stream."""
+    body = {"type": "codec_hello", "from": me, "codec": codec,
             "version": VERSION if codec == "binary" else 0}
+    if epoch is not None:
+        body["epoch"] = epoch
+    return body

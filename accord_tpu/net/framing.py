@@ -38,7 +38,12 @@ def encode_frame(packet: dict, codec: str = "json") -> bytes:
     ("json" default — the debug codec — or "binary").  Under either,
     key order is preserved, so decode -> re-encode reproduces the exact
     bytes (the golden-frame contract)."""
-    payload = encode_packet(packet, codec)
+    return prefix_payload(encode_packet(packet, codec))
+
+
+def prefix_payload(payload: bytes) -> bytes:
+    """Length-prefix an ALREADY-encoded frame payload (the server's
+    encode-once send path; chunk streaming re-slices the same bytes)."""
     if len(payload) > MAX_FRAME:
         raise FrameError(f"frame of {len(payload)} bytes exceeds MAX_FRAME")
     return _LEN.pack(len(payload)) + payload

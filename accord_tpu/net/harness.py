@@ -68,6 +68,11 @@ class ServeCluster:
         self.addrs: List[Tuple[str, str, int]] = [
             (name, "127.0.0.1", port)
             for name, port in zip(self.names, ports)]
+        # epoch-1 membership is frozen at construction: nodes added later
+        # (add_node) spawn with --members = this list so every node's
+        # epoch-1 topology byte-matches; membership then changes only
+        # through proposed epochs (the elastic serving path)
+        self.initial_members = list(self.names)
         self.stores = stores
         self.admit_max = admit_max
         self.target_p99_ms = target_p99_ms
@@ -86,11 +91,17 @@ class ServeCluster:
     def _peers_arg(self) -> str:
         return ",".join(f"{n}={h}:{p}" for n, h, p in self.addrs)
 
-    def spawn(self, name: str) -> subprocess.Popen:
+    def spawn(self, name: str,
+              env_extra: Optional[Dict[str, str]] = None
+              ) -> subprocess.Popen:
         """(Re)start one node process (used for initial spawn AND the
-        kill-9 rejoin leg — same name, same port, fresh state)."""
+        kill-9 rejoin leg — same name, same port, fresh state).
+        ``env_extra`` arms per-node knobs (e.g. the deterministic
+        mid-propose crash point)."""
         _, host, port = next(a for a in self.addrs if a[0] == name)
         env = dict(os.environ)
+        if env_extra:
+            env.update(env_extra)
         env["JAX_PLATFORMS"] = "cpu"
         env["JAX_ENABLE_X64"] = "true"
         env.setdefault("ACCORD_TPU_DEVICE", "0")   # host route: fast start
@@ -99,6 +110,7 @@ class ServeCluster:
         cmd = [sys.executable, "-m", "accord_tpu.net.server",
                "--name", name, "--listen", f"{host}:{port}",
                "--peers", self._peers_arg(),
+               "--members", ",".join(self.initial_members),
                "--stores", str(self.stores),
                "--admit-max", str(self.admit_max),
                "--target-p99-ms", str(self.target_p99_ms),
@@ -127,6 +139,40 @@ class ServeCluster:
 
     def alive(self) -> Dict[str, bool]:
         return {n: (p.poll() is None) for n, p in self.procs.items()}
+
+    # -- dynamic membership (r17, elastic serving) ----------------------------
+    def add_node(self, name: Optional[str] = None) -> str:
+        """Spawn one EXTRA node as a non-member observer (--members = the
+        frozen epoch-1 list): it dials the cluster and waits for the
+        epoch that admits it (client.reconfigure(op="add")).  Mutates
+        ``addrs`` in place so clients sharing the list see the new
+        node."""
+        if name is None:
+            taken = {int(n[1:]) for n in self.names if n[1:].isdigit()}
+            name = f"n{max(taken) + 1 if taken else 1}"
+        port = free_ports(1)[0]
+        self.names.append(name)
+        self.addrs.append((name, "127.0.0.1", port))
+        self.spawn(name)
+        return name
+
+    def node_addr(self, name: str) -> Tuple[str, int]:
+        _, host, port = next(a for a in self.addrs if a[0] == name)
+        return host, port
+
+    def remove_node(self, name: str, kill: bool = True) -> None:
+        """Forget one node (after the epoch removing it settled): the
+        process is terminated (the operator's final step of a drain) and
+        the addr book entry removed in place."""
+        proc = self.procs.pop(name, None)
+        if proc is not None and kill and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.names = [n for n in self.names if n != name]
+        self.addrs[:] = [a for a in self.addrs if a[0] != name]
 
     def kill9(self, name: str) -> None:
         self.procs[name].send_signal(signal.SIGKILL)
@@ -384,6 +430,229 @@ async def cluster_net_stats(client: ClusterClient,
 
 
 # ---------------------------------------------------------------------------
+# elastic serving helpers (r17): epoch convergence + the reconfig smoke
+# ---------------------------------------------------------------------------
+
+async def await_epoch(client: ClusterClient, names: List[str], epoch: int,
+                      timeout: float = 60.0,
+                      settled: bool = True) -> Dict[str, dict]:
+    """Poll until every named node reports ``epoch_current >= epoch``
+    (and, with ``settled``, the epoch synced + no bootstrap in flight).
+    Returns the final per-node reconfig stats blocks; raises on
+    deadline with the stragglers' state."""
+    deadline = time.time() + timeout
+    last: Dict[str, dict] = {}
+    while True:
+        pending = []
+        for name in names:
+            try:
+                s = await client.stats(name, timeout=3.0)
+            except Exception as exc:
+                pending.append((name, repr(exc)))
+                continue
+            rc = s.get("reconfig") or {}
+            last[name] = rc
+            if rc.get("epoch_current", 0) < epoch:
+                pending.append((name, f"epoch={rc.get('epoch_current')}"))
+            elif settled and rc.get("epoch_current", 0) == epoch \
+                    and not rc.get("epoch_synced"):
+                pending.append((name, "unsynced"))
+            elif settled and rc.get("bootstrapping_now"):
+                pending.append((name, "bootstrapping"))
+        if not pending:
+            return last
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"epoch {epoch} never settled within {timeout}s: {pending}")
+        await asyncio.sleep(0.25)
+
+
+async def propose_with_retry(client: ClusterClient, via: str, op: str,
+                             timeout: float = 30.0, **fields) -> dict:
+    """Propose, retrying the verb's transient rejections (the
+    no-stacking guard requires EVERY member's ack for the current epoch
+    and no local rebalance — both settle within seconds)."""
+    deadline = time.time() + timeout
+    while True:
+        rep = await client.reconfigure(via, op, **fields)
+        if rep.get("type") == "reconfigure_ok":
+            return rep
+        text = rep.get("text", "")
+        if rep.get("code") == 11 and ("syncing" in text
+                                      or "rebalance" in text) \
+                and time.time() < deadline:
+            await asyncio.sleep(0.5)
+            continue
+        return rep
+
+
+async def _reconfig_scenario(cluster: ServeCluster, n_txns: int,
+                             kill_joiner: bool, kill_proposer: bool,
+                             note) -> dict:
+    client = ClusterClient(cluster.addrs, timeout=8.0,
+                           codec=cluster.wire_codec)
+    rng = random.Random(11)
+    counter = [0]
+    ok = [0]
+    try:
+        await wait_ready(cluster, client)
+
+        async def burst(n, nodes):
+            for i in range(n):
+                await client.submit_retry(_mk_ops(rng, counter, 32),
+                                          retries=16, timeout=6.0,
+                                          node=nodes[i % len(nodes)])
+                ok[0] += 1
+
+        base = list(cluster.names)
+        await burst(n_txns, base)
+        # -- join: spawn the observer, propose the admitting epoch ------
+        joiner = cluster.add_node()
+        jhost, jport = cluster.node_addr(joiner)
+        # cluster.addrs is shared with the client (mutated in place), so
+        # wait_ready dials the joiner with startup retries included
+        await wait_ready(cluster, client)
+        if kill_proposer:
+            # TRUE mid-propose crash: re-arm the proposer with the
+            # deterministic crash point (ACCORD_TPU_RECONFIG_CRASH) — it
+            # journals epoch N+1 durable and _exits BEFORE ingesting or
+            # broadcasting it, so it dies holding an epoch NO peer has
+            # ever seen.  Recovery must re-ingest the journaled doc and
+            # the hello-epoch gossip must propagate it cluster-wide, or
+            # the epoch is lost — the exact window the
+            # durable-before-broadcast write exists for.
+            note(f"arming mid-propose crash on {base[0]}")
+            cluster.kill9(base[0])
+            cluster.spawn(base[0], env_extra={
+                "ACCORD_TPU_RECONFIG_CRASH": "after-flush"})
+            await wait_ready(cluster, client)
+            epoch = 2
+            crashed = False
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    rep = await client.reconfigure(base[0], "add",
+                                                   node=joiner,
+                                                   addr=f"{jhost}:{jport}",
+                                                   timeout=8.0)
+                except (ConnectionError, asyncio.TimeoutError):
+                    crashed = True   # died before replying: the armed
+                    break            # crash point fired after the flush
+                if rep.get("type") == "reconfigure_ok":
+                    raise AssertionError("proposer survived the armed "
+                                         "mid-propose crash")
+                # transient no-stacking rejection (acks still arriving
+                # at the freshly-respawned proposer): retry
+                await asyncio.sleep(0.5)
+            assert crashed, "armed mid-propose crash never fired"
+            note(f"proposer {base[0]} died mid-propose holding "
+                 f"journaled epoch {epoch}; respawning clean")
+            deadline = time.time() + 10
+            while cluster.procs[base[0]].poll() is None \
+                    and time.time() < deadline:
+                await asyncio.sleep(0.1)
+            assert cluster.procs[base[0]].poll() is not None, \
+                "armed proposer never exited"
+            cluster.spawn(base[0])
+            await wait_ready(cluster, client)
+        else:
+            rep = await propose_with_retry(client, base[0], "add",
+                                           node=joiner,
+                                           addr=f"{jhost}:{jport}")
+            assert rep.get("type") == "reconfigure_ok", rep
+            epoch = rep["epoch"]
+        if kill_joiner:
+            # kill -9 the JOINING node mid-bootstrap: its fence/snapshot
+            # fetch dies with it; the respawned incarnation recovers its
+            # epoch ledger (journal) or refetches it (hello-epoch gossip)
+            # and re-runs the bootstrap to completion
+            note(f"kill -9 joiner {joiner} mid-bootstrap")
+            cluster.kill9(joiner)
+            await burst(max(4, n_txns // 4), base)   # survivors serve on
+            cluster.spawn(joiner)
+            await wait_ready(cluster, client)
+        await await_epoch(client, cluster.names, epoch, timeout=90.0)
+        await burst(n_txns, cluster.names)
+        # -- leave: retire one original member ---------------------------
+        leaver = base[-1]
+        via = base[0]
+        rep = await propose_with_retry(client, via, "remove", node=leaver)
+        assert rep.get("type") == "reconfigure_ok", rep
+        survivors = [n for n in cluster.names if n != leaver]
+        await await_epoch(client, survivors, rep["epoch"], timeout=90.0)
+        # stop routing to the leaver, then terminate it (operator drain)
+        await client.remove_node(leaver)
+        cluster.remove_node(leaver)
+        await burst(n_txns, survivors)
+        # epoch lifecycle TAIL: the oldest epoch retires once the whole
+        # prefix is sync-complete cluster-wide (the ack re-gossip's
+        # grace window + duplicate-ack replies close any straggler)
+        deadline = time.time() + 25.0
+        while time.time() < deadline:
+            stats = await cluster_net_stats(client, survivors)
+            retired = [((stats["per_node"].get(n) or {})
+                        .get("reconfig") or {}).get("epochs_retired", 0)
+                       for n in survivors]
+            if all(r >= 1 for r in retired):
+                break
+            await asyncio.sleep(0.5)
+        stats = await cluster_net_stats(client, survivors)
+        recon = {n: (stats["per_node"].get(n) or {}).get("reconfig")
+                 for n in survivors}
+        return {"ok": ok[0], "expected": ok[0],
+                "duplicate_replies": client.duplicate_replies(),
+                "alive": cluster.alive(), "joiner": joiner,
+                "left": leaver, "reconfig": recon, "net": stats}
+    finally:
+        await client.close()
+
+
+def run_reconfig_smoke(n_txns: int = 12, kill_joiner: bool = False,
+                       kill_proposer: bool = False,
+                       out_dir: Optional[str] = None,
+                       wire_codec: str = "binary") -> dict:
+    """The fault-matrix reconfig leg: a 3-node journaled cluster runs a
+    join AND a leave under load — optionally killing -9 the joining node
+    mid-bootstrap or the epoch proposer mid-propose — and must converge
+    into one consistent epoch with every client op succeeding and zero
+    duplicate replies."""
+    def note(msg):
+        print(f"  [reconfig-smoke] {msg}", flush=True)
+
+    cluster = ServeCluster(n_nodes=3, request_timeout_ms=1000,
+                           journal_root=tempfile.mkdtemp(
+                               prefix="accord_reconf_jr_"),
+                           wire_codec=wire_codec)
+    cluster.spawn_all()
+    try:
+        result = asyncio.run(_reconfig_scenario(
+            cluster, n_txns, kill_joiner, kill_proposer, note))
+        problems = []
+        if result["duplicate_replies"]:
+            problems.append(
+                f"{result['duplicate_replies']} duplicate client replies")
+        if not all(result["alive"].values()):
+            problems.append(f"dead nodes: {result['alive']}")
+        epochs = {n: (rc or {}).get("epoch_current")
+                  for n, rc in result["reconfig"].items()}
+        if len(set(epochs.values())) != 1:
+            problems.append(f"divergent final epochs: {epochs}")
+        if problems:
+            tag = ("reconfig"
+                   + ("_killjoiner" if kill_joiner else "")
+                   + ("_killproposer" if kill_proposer else ""))
+            path = None
+            if out_dir:
+                path = asyncio.run(_dump_postmortems(cluster, out_dir, tag))
+            raise AssertionError(
+                f"reconfig smoke failed ({'; '.join(problems)})"
+                + (f" [post-mortem: {path}]" if path else ""))
+        return result
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # the 2-process smoke (tier-1 + the fault-matrix socket legs)
 # ---------------------------------------------------------------------------
 
@@ -489,6 +758,13 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="serving-cluster smoke harness (fault-matrix legs)")
     p.add_argument("--smoke", action="store_true")
+    p.add_argument("--reconfig-smoke", action="store_true",
+                   help="elastic-serving leg: join + leave under load on "
+                        "a journaled 3-node cluster")
+    p.add_argument("--kill-joiner", action="store_true",
+                   help="(reconfig) kill -9 the joining node mid-bootstrap")
+    p.add_argument("--kill-proposer", action="store_true",
+                   help="(reconfig) kill -9 the epoch proposer mid-propose")
     p.add_argument("--txns", type=int, default=100)
     p.add_argument("--nodes", type=int, default=2)
     p.add_argument("--net-faults", default=None,
@@ -500,8 +776,24 @@ def main(argv=None) -> int:
     p.add_argument("--out", default=os.environ.get("FAULT_MATRIX_OUT",
                                                    "/tmp"))
     args = p.parse_args(argv)
+    if args.reconfig_smoke:
+        t0 = time.time()
+        result = run_reconfig_smoke(n_txns=max(8, args.txns // 8),
+                                    kill_joiner=args.kill_joiner,
+                                    kill_proposer=args.kill_proposer,
+                                    out_dir=args.out,
+                                    wire_codec=args.wire_codec)
+        epochs = {n: (rc or {}).get("epoch_current")
+                  for n, rc in result["reconfig"].items()}
+        print(f"reconfig smoke ok: {result['ok']} txns, joined "
+              f"{result['joiner']}, removed {result['left']}, epochs "
+              f"{epochs} kill_joiner={args.kill_joiner} "
+              f"kill_proposer={args.kill_proposer} "
+              f"dup_replies={result['duplicate_replies']} in "
+              f"{time.time() - t0:.1f}s")
+        return 0
     if not args.smoke:
-        p.error("--smoke is the only mode")
+        p.error("--smoke or --reconfig-smoke required")
     t0 = time.time()
     result = run_smoke(n_txns=args.txns, n_nodes=args.nodes,
                        net_faults=args.net_faults, out_dir=args.out,

@@ -35,12 +35,15 @@ import sys
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from typing import List
+
 from .. import api
 from ..utils import faults
 from ..utils.random_source import RandomSource
+from . import bootstrap as net_bootstrap
 from . import codec as wire_codec
-from .admission import AdmissionGate, device_health_of
-from .framing import FrameError, encode_frame
+from .admission import AdmissionGate, device_health_of, rebalance_health_of
+from .framing import FrameError, encode_frame, prefix_payload
 from .codec import decode_payload
 from .transport import FrameServer, PeerLink, coalesce_window_micros
 
@@ -117,11 +120,20 @@ class NodeServer:
                  journal_snapshot_every: Optional[int] = None,
                  journal_segment_bytes: Optional[int] = None,
                  journal_sync: Optional[str] = None,
-                 wire_codec_name: str = "binary"):
+                 wire_codec_name: str = "binary",
+                 members: Optional[List[str]] = None):
         self.name = name
         self.host = host
         self.port = port
         self.peers = {n: a for n, a in peers.items() if n != name}
+        # epoch-1 membership (r17, elastic serving): the names the static
+        # initial topology is built from.  Defaults to peers ∪ self (the
+        # r12 behaviour); a node JOINING a live cluster spawns with the
+        # EXISTING members only (--join / --members), so its epoch-1
+        # topology byte-matches the cluster's and it becomes a member
+        # only when an operator proposes the epoch that admits it.
+        self.members = sorted(members, key=lambda n: (len(n), n)) \
+            if members else None
         self.stores = stores
         self.shards = shards
         self.device_mode = device_mode
@@ -155,6 +167,14 @@ class NodeServer:
         self.journal = None
         self.gate: Optional[AdmissionGate] = None
         self.frame_server: Optional[FrameServer] = None
+        # elastic serving (r17): the reconfiguration manager + the chunk
+        # reassembler for snapshot-fed bootstrap streams
+        self.reconfig = None
+        self._chunks = net_bootstrap.ChunkReassembler()
+        self._hello_frame: Optional[bytes] = None
+        self._hello_epoch: Optional[int] = None
+        self.n_chunk_streams_tx = 0
+        self.n_chunk_frames_tx = 0
         self.n_client_replies = 0
         self.n_unroutable = 0
         self.n_reply_drops = 0
@@ -230,23 +250,11 @@ class NodeServer:
                     n = len(chunk)
                     self.batch_sizes[n] = self.batch_sizes.get(n, 0) + 1
                     try:
-                        self.links[dest].send(encode_frame(
-                            {"src": self.name, "dest": dest, "body": body},
-                            self.wire_codec))
-                    except FrameError:
-                        # the op-count cap bounds the envelope, not its
-                        # bytes: a chunk of giant bodies can still top
-                        # MAX_FRAME.  Fall back to per-op frames so one
-                        # oversized rider fails alone instead of taking
-                        # up to MAX_BATCH_OPS messages with it.
-                        for sub in chunk:
-                            try:
-                                self.links[dest].send(encode_frame(
-                                    {"src": self.name, "dest": dest,
-                                     "body": sub}, self.wire_codec))
-                            except Exception as exc:
-                                print(f"[{self.name}] frame to {dest} "
-                                      f"failed: {exc!r}", file=sys.stderr)
+                        # no byte-overflow fallback needed here anymore:
+                        # _send_peer_body chunk-streams ANY payload over
+                        # CHUNK_THRESHOLD (1 MiB), so an envelope can
+                        # never approach MAX_FRAME whole
+                        self._send_peer_body(dest, body)
                     except Exception as exc:   # one peer's bad frame must
                         # not drop every OTHER peer's batch this tick
                         print(f"[{self.name}] batch encode to {dest} "
@@ -257,6 +265,26 @@ class NodeServer:
                 self._write_bounded(
                     dest, writer,
                     frames[0] if len(frames) == 1 else b"".join(frames))
+
+    def _send_peer_body(self, dest: str, body: dict) -> None:
+        """Encode-once peer send: a body whose payload outgrows
+        CHUNK_THRESHOLD leaves as an ``accord_chunk`` stream through the
+        same coalescing link (the snapshot-fed bootstrap data plane —
+        FetchSnapshotOk payloads scale with the donor's store); anything
+        else is one length-prefixed frame exactly as before."""
+        payload = wire_codec.encode_packet(
+            {"src": self.name, "dest": dest, "body": body},
+            self.wire_codec)
+        link = self.links[dest]
+        if len(payload) > net_bootstrap.CHUNK_THRESHOLD:
+            frames = net_bootstrap.chunk_payload_frames(
+                self.name, dest, payload, self.wire_codec)
+            for f in frames:
+                link.send(f)
+            self.n_chunk_streams_tx += 1
+            self.n_chunk_frames_tx += len(frames)
+            return
+        link.send(prefix_payload(payload))
 
     def _send_client(self, dest: str, writer, frame: bytes) -> None:
         """Queue one client-bound frame for the end-of-tick joined write
@@ -346,10 +374,11 @@ class NodeServer:
         except ValueError:
             raise   # FrameServer counts + drops this connection
         self._on_packet(packet, writer,
-                        binary=payload[0] == wire_codec.MAGIC)
+                        binary=payload[0] == wire_codec.MAGIC,
+                        nbytes=len(payload))
 
     def _on_packet(self, packet: dict, writer: asyncio.StreamWriter,
-                   binary: bool = False) -> None:
+                   binary: bool = False, nbytes: int = 0) -> None:
         body = packet.get("body") or {}
         typ = body.get("type")
         src = packet.get("src", "")
@@ -357,15 +386,27 @@ class NodeServer:
             # link-handshake codec announcement (first frame after every
             # peer (re)connect): record it; an unsupported version is
             # surfaced loudly here AND in stats, instead of one silent
-            # CodecError per frame
+            # CodecError per frame.  r17: the hello may carry the peer's
+            # current EPOCH — the reconfig manager uses it as the
+            # catch-up/gossip trigger (epochless pre-r17 hellos and
+            # mixed-epoch streams interoperate: the field is optional)
             self._peer_hello[src] = body
             v = body.get("version", 0)
             if v and v not in wire_codec.SUPPORTED_VERSIONS:
                 print(f"[{self.name}] peer {src} announced unsupported "
                       f"wire codec version {v} (supported: "
                       f"{wire_codec.SUPPORTED_VERSIONS})", file=sys.stderr)
+            if self.reconfig is not None:
+                try:
+                    self.reconfig.on_peer_hello(src, body)
+                except Exception as exc:
+                    print(f"[{self.name}] hello handler error: {exc!r}",
+                          file=sys.stderr)
             return
-        if typ in ("ping", "stats", "dump"):
+        if typ in ("topo_new", "epoch_sync", "topo_fetch", "accord_chunk"):
+            self._on_reconfig_verb(typ, src, body, writer)
+            return
+        if typ in ("ping", "stats", "dump", "reconfigure"):
             self._client_codec[src] = "binary" if binary else "json"
             self._control(typ, src, body, writer)
             return
@@ -377,11 +418,69 @@ class NodeServer:
             self._client_codec[src] = "binary" if binary else "json"
         elif typ == "accord_batch":
             self.n_unbatched_envelopes += 1
+        elif typ == "accord_rsp" and self.reconfig is not None:
+            payload_doc = body.get("payload")
+            if isinstance(payload_doc, dict) \
+                    and payload_doc.get("_t") == "FetchSnapshotOk":
+                # bootstrap data-plane accounting at the layer that
+                # already KNOWS the byte length (direct frames and
+                # reassembled chunk streams — the shapes a real snapshot
+                # takes; a small one sharing an envelope goes uncounted
+                # rather than paying a re-encode just to be weighed)
+                self.reconfig.bootstrap_bytes_rx += nbytes
         try:
             self.proc.handle(packet)
         except Exception as exc:   # a poisoned packet must not kill the node
             print(f"[{self.name}] handler error on {typ}: {exc!r}",
                   file=sys.stderr)
+
+    def _on_reconfig_verb(self, typ: str, src: str, body: dict,
+                          writer: Optional[asyncio.StreamWriter]) -> None:
+        """The reconfiguration gossip plane (peer-to-peer control):
+        never touches the protocol path, never admission-gated.  Reached
+        both from raw inbound frames and — via the process's
+        control_fallback — from bodies that rode a peer accord_batch
+        envelope."""
+        try:
+            if typ == "topo_new" and self.reconfig is not None:
+                self.reconfig.on_topo_new(body.get("topology") or {},
+                                          from_src=src)
+            elif typ == "epoch_sync" and self.reconfig is not None:
+                self.reconfig.on_epoch_sync(body.get("node") or src,
+                                            int(body.get("epoch", 0)))
+            elif typ == "topo_fetch" and self.reconfig is not None:
+                self.reconfig.on_topo_fetch(body.get("node") or src,
+                                            int(body.get("epoch", 0)))
+            elif typ == "accord_chunk":
+                # snapshot-fed bootstrap stream: reassemble; a completed
+                # stream is one ordinary inner frame payload (either
+                # codec), re-entering the normal dispatch
+                inner = self._chunks.feed(body)
+                if inner is not None:
+                    try:
+                        packet2 = decode_payload(inner)
+                    except ValueError as exc:
+                        print(f"[{self.name}] chunked payload "
+                              f"undecodable: {exc!r}", file=sys.stderr)
+                        return
+                    self._on_packet(packet2, writer,
+                                    binary=inner[0] == wire_codec.MAGIC,
+                                    nbytes=len(inner))
+        except Exception as exc:
+            print(f"[{self.name}] reconfig handler error on {typ}: "
+                  f"{exc!r}", file=sys.stderr)
+
+    def _control_fallback(self, packet: dict) -> None:
+        """Unknown bodies surfacing at the protocol unbatcher (reconfig
+        gossip that shared an envelope with protocol traffic)."""
+        body = packet.get("body") or {}
+        typ = body.get("type")
+        src = packet.get("src", "")
+        if typ == "codec_hello":
+            self._on_packet(packet, None)
+        elif typ in ("topo_new", "epoch_sync", "topo_fetch",
+                     "accord_chunk"):
+            self._on_reconfig_verb(typ, src, body, None)
 
     def _control(self, typ: str, src: str, body: dict,
                  writer: asyncio.StreamWriter) -> None:
@@ -392,6 +491,21 @@ class NodeServer:
         elif typ == "stats":
             reply = {"type": "stats_ok", "in_reply_to": msg_id,
                      "stats": self.stats()}
+        elif typ == "reconfigure":
+            # the operator verb (tools/reconfig.py): propose epoch N+1 —
+            # add node / remove node / move a range.  The manager owns
+            # validation, the durable-before-broadcast journal write and
+            # the propagation; this path just correlates the reply.
+            if self.reconfig is None:
+                reply = {"type": "error", "code": 10,
+                         "text": "reconfiguration disabled on this node"}
+            else:
+                try:
+                    reply = self.reconfig.propose(body)
+                except Exception as exc:
+                    reply = {"type": "error", "code": 11, "text": repr(exc)}
+            reply = dict(reply)
+            reply["in_reply_to"] = msg_id
         else:   # dump: the flight-recorder post-mortems + metrics snapshot
             obs = self.proc.obs if self.proc is not None else None
             reply = {"type": "dump_ok", "in_reply_to": msg_id,
@@ -403,6 +517,59 @@ class NodeServer:
         self._send_client(src, writer, encode_frame(
             {"src": self.name, "dest": src, "body": reply},
             self._client_codec.get(src, "json")))
+
+    # -- dynamic peer links (r17, elastic serving) ---------------------------
+    def _mk_link(self, peer: str, host: str, port: int) -> PeerLink:
+        import zlib
+        # stable per-(me, peer) seed: hash() is salted per process,
+        # crc32 is not — the backoff schedule must be reproducible
+        jitter = RandomSource(
+            0x7C9 ^ zlib.crc32(f"{self.name}->{peer}".encode()))
+        return PeerLink(self.name, peer, host, port, jitter,
+                        hello_frame=self._hello_frame)
+
+    def ensure_link(self, peer: str, host: str, port: int) -> bool:
+        """Dial-on-join: create (and start, when the loop is live) an
+        outbound link to a peer learned from a topology doc.  Returns
+        True when a NEW link was created."""
+        if peer == self.name or peer in self.links:
+            return False
+        link = self._mk_link(peer, host, port)
+        self.links[peer] = link
+        self.peers[peer] = (host, port)
+        if self.loop is not None:
+            link.start()
+        return True
+
+    def drop_link(self, peer: str) -> None:
+        """Drain-on-leave: close and forget the outbound link to a peer
+        that is a member of no retained epoch.  Pending sink callbacks to
+        it time out through the ordinary sweeper (the r13 tombstone heap
+        compacts them); its inbound connection dies with its process."""
+        link = self.links.pop(peer, None)
+        self._peer_pend.pop(peer, None)
+        if link is not None and self.loop is not None:
+            self.loop.create_task(link.close())
+
+    def refresh_hello(self) -> None:
+        """Rebuild the codec_hello handshake frame with the node's
+        CURRENT epoch and push it: future (re)connects announce it, and
+        live links send it immediately as an ordinary idempotent frame —
+        peers that slept through a reconfiguration see the epoch jump and
+        fetch the gap (mixed-epoch interop: receivers accept hellos with
+        or without the field)."""
+        node = getattr(self.proc, "node", None) if self.proc else None
+        epoch = node.topology_manager.epoch() if node is not None else None
+        if epoch == self._hello_epoch and self._hello_frame is not None:
+            return
+        self._hello_epoch = epoch
+        self._hello_frame = encode_frame(
+            {"src": self.name, "dest": "", "body":
+             wire_codec.hello_body(self.name, self.wire_codec,
+                                   epoch=epoch)},
+            self.wire_codec)
+        for link in self.links.values():
+            link.set_hello(self._hello_frame, announce=self.loop is not None)
 
     def batch_occupancy_p50(self) -> int:
         """Weighted median outbound per-peer batch size (1 = no sharing;
@@ -456,6 +623,13 @@ class NodeServer:
             "socket_faults": faults.active_socket_faults(),
             "journal": (self.journal.stats()
                         if self.journal is not None else None),
+            # elastic serving (r17): the epoch lifecycle + bootstrap
+            # stream surface the serve_bench rebalance rows read
+            "reconfig": (self.reconfig.stats()
+                         if self.reconfig is not None else None),
+            "chunks": dict(self._chunks.stats(),
+                           streams_tx=self.n_chunk_streams_tx,
+                           chunk_frames_tx=self.n_chunk_frames_tx),
         }
 
     # -- lifecycle ------------------------------------------------------------
@@ -499,6 +673,16 @@ class NodeServer:
                 metrics=obs.metrics,
                 async_exec=_async_exec,
                 sync_policy=self.journal_sync)
+        # elastic serving (r17): the reconfiguration manager owns the
+        # epoch ledger, the topology gossip and the dynamic link
+        # lifecycle; it recovers any journaled epoch history FIRST so a
+        # node killed -9 mid-reconfiguration boots into the right epoch
+        from .reconfig import ReconfigManager
+        self.reconfig = ReconfigManager(self)
+        self.reconfig.note_member(self.name, self.host, self.port)
+        for peer, (host, port) in sorted(self.peers.items()):
+            self.reconfig.note_member(peer, host, port)
+        self.reconfig.load_journal_epochs(self.journal)
         self.proc = MaelstromProcess(
             emit=self._emit, scheduler=scheduler,
             now_micros=self.now_micros,
@@ -506,12 +690,17 @@ class NodeServer:
             device_mode=self.device_mode,
             durability=self.durability, obs=obs,
             journal=self.journal)
+        self.proc.reconfig = self.reconfig
+        self.proc.control_fallback = self._control_fallback
         if self.request_timeout_ms is not None:
             self.proc.request_timeout_micros = self.request_timeout_ms * 1000
         # admission gate in front of coordinate, composed with the r07
-        # device ladder (quarantine lowers the budget); when the r09
-        # span trees are live their per-phase p99 drives the AIMD signal
-        # (root-span fallback keeps ACCORD_TPU_OBS=off working)
+        # device ladder (quarantine lowers the budget) AND the r17
+        # rebalance factor (a store mid-bootstrap prices the budget DOWN
+        # — the join/leave load spike is absorbed as a cut, never a
+        # collapse); when the r09 span trees are live their per-phase
+        # p99 drives the AIMD signal (root-span fallback keeps
+        # ACCORD_TPU_OBS=off working)
         from .admission import SpanPhaseP99
         phase_feed = (SpanPhaseP99(obs.metrics).read
                       if obs.spans is not None else None)
@@ -519,39 +708,40 @@ class NodeServer:
             max_inflight=self.admit_max,
             target_p99_micros=self.target_p99_ms * 1000,
             min_budget=self.min_budget,
-            device_health=lambda: device_health_of(self.proc.node),
+            device_health=lambda: (device_health_of(self.proc.node)
+                                   * rebalance_health_of(self.proc.node)),
             metrics=obs.metrics,
             phase_p99=phase_feed)
         self.proc.admission = self.gate
         # outbound links (deterministic per-(me, peer) jitter streams);
-        # each link announces its wire codec + format version as the
-        # first frame after every (re)connect, and coalesces same-window
-        # frames into one write priced off the write micro-probe
-        import zlib
-        hello = encode_frame(
+        # each link announces its wire codec + format version (+ current
+        # epoch once the node is up — refresh_hello) as the first frame
+        # after every (re)connect, and coalesces same-window frames into
+        # one write priced off the write micro-probe
+        self._hello_frame = encode_frame(
             {"src": self.name, "dest": "", "body":
              wire_codec.hello_body(self.name, self.wire_codec)},
             self.wire_codec)
         for peer, (host, port) in sorted(self.peers.items()):
-            # stable per-(me, peer) seed: hash() is salted per process,
-            # crc32 is not — the backoff schedule must be reproducible
-            jitter = RandomSource(
-                0x7C9 ^ zlib.crc32(f"{self.name}->{peer}".encode()))
-            self.links[peer] = PeerLink(self.name, peer, host, port, jitter,
-                                        hello_frame=hello)
-        self.frame_server = FrameServer(self.host, self.port,
-                                        on_close=self._client_gone,
-                                        on_payload=self._on_payload)
-        await self.frame_server.start()
+            self.links[peer] = self._mk_link(peer, host, port)
         for link in self.links.values():
             link.start()
-        # self-init: same init body the Maelstrom harness would send
-        names = sorted(set(self.peers) | {self.name},
-                       key=lambda n: (len(n), n))
+        # self-init BEFORE the frame server accepts: an inbound topo_new
+        # racing a not-yet-initialized node would be dropped on the floor
+        # (epoch-1 membership is self.members when set — a JOINING node
+        # boots with the existing cluster's member list, itself excluded,
+        # so every node's epoch 1 is byte-identical)
+        names = self.members or sorted(set(self.peers) | {self.name},
+                                       key=lambda n: (len(n), n))
         self.proc.handle({"src": "boot", "dest": self.name,
                           "body": {"type": "init", "msg_id": 0,
                                    "node_id": self.name,
                                    "node_ids": names}})
+        self.refresh_hello()
+        self.frame_server = FrameServer(self.host, self.port,
+                                        on_close=self._client_gone,
+                                        on_payload=self._on_payload)
+        await self.frame_server.start()
         if self.journal is not None:
             # periodic snapshot check: bounds replay length and recycles
             # fully-snapshotted segments (the floor advance is the knob,
@@ -650,12 +840,30 @@ def main(argv=None) -> int:
                         "json (the debug codec — human-greppable "
                         "captures).  Frames are self-describing, so "
                         "mixed-codec clusters and clients interoperate")
+    p.add_argument("--members", default=None,
+                   help="epoch-1 member names, comma-separated (default: "
+                        "every --peers name incl. self).  A node joining "
+                        "a LIVE cluster must pass the existing members "
+                        "(itself excluded) so its epoch-1 topology "
+                        "byte-matches the cluster's; it becomes a member "
+                        "when an operator proposes the admitting epoch "
+                        "(tools/reconfig.py add)")
+    p.add_argument("--join", action="store_true",
+                   help="shorthand for --members = every --peers name "
+                        "EXCEPT this node: boot as a non-member observer "
+                        "awaiting the epoch that admits it")
     args = p.parse_args(argv)
 
     host, port = parse_addr(args.listen)
     device_mode = {"auto": None, "on": True, "off": False}[args.device_mode]
+    peers = parse_peers(args.peers)
+    members = None
+    if args.members:
+        members = [n.strip() for n in args.members.split(",") if n.strip()]
+    elif args.join:
+        members = [n for n in peers if n != args.name]
     server = NodeServer(
-        args.name, host, port, parse_peers(args.peers),
+        args.name, host, port, peers,
         stores=args.stores, shards=args.shards, device_mode=device_mode,
         durability=not args.no_durability,
         admit_max=args.admit_max, target_p99_ms=args.target_p99_ms,
@@ -666,7 +874,8 @@ def main(argv=None) -> int:
         journal_snapshot_every=args.journal_snapshot_every,
         journal_segment_bytes=args.journal_segment_bytes,
         journal_sync=args.journal_sync,
-        wire_codec_name=args.wire_codec)
+        wire_codec_name=args.wire_codec,
+        members=members)
 
     # ACCORD_TPU_NODE_PROFILE=<dir>: cProfile the whole node lifetime and
     # dump <dir>/<name>.pstats at clean shutdown (SIGTERM).  The serving

@@ -165,6 +165,18 @@ class PeerLink:
     def start(self) -> None:
         self._task = asyncio.get_event_loop().create_task(self._run())
 
+    def set_hello(self, frame: Optional[bytes],
+                  announce: bool = False) -> None:
+        """Replace the handshake frame used on future (re)connects —
+        the elastic-serving path refreshes it whenever the node's epoch
+        moves.  ``announce`` additionally sends the fresh hello down the
+        LIVE link as an ordinary frame (receivers treat codec_hello as
+        idempotent state), so peers learn the new epoch without waiting
+        for a reconnect."""
+        self._hello = frame
+        if announce and frame is not None:
+            self.send(frame)
+
     async def close(self) -> None:
         if self._task is not None:
             self._task.cancel()

@@ -73,6 +73,11 @@ class BurnResult:
         # into ``stats`` so the same-seed determinism gates compare them
         self.recoveries: Dict[str, int] = {}
         self.nemesis: Dict[str, int] = {}
+        # r17 serving-shaped churn: per-planner fire counts (add /
+        # remove / move via net.reconfig's plan functions — the exact
+        # operations the TCP reconfigure verb proposes), mirrored into
+        # ``stats`` like the nemesis legs
+        self.reconfig_churn: Dict[str, int] = {}
 
     def __repr__(self):
         return (f"BurnResult(ok={self.ops_ok}, failed={self.ops_failed}, "
@@ -89,7 +94,8 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
              boundary_churn_only: bool = False,
              device_faults: Optional[str] = None,
              device_fault_p: float = 0.05,
-             recovery_nemesis: bool = False) -> BurnResult:
+             recovery_nemesis: bool = False,
+             reconfig_churn: bool = False) -> BurnResult:
     if device_faults is not None:
         # DEVICE-FAULT NEMESIS: arm the accelerator-boundary fault
         # registry (utils.faults) for the whole run — one fault class, or
@@ -115,7 +121,8 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
                             drain_micros=drain_micros, probe=probe,
                             probe_micros=probe_micros,
                             boundary_churn_only=boundary_churn_only,
-                            recovery_nemesis=recovery_nemesis)
+                            recovery_nemesis=recovery_nemesis,
+                            reconfig_churn=reconfig_churn)
         finally:
             faults.PARANOIA = prior_paranoia
             for k in kinds:
@@ -465,6 +472,54 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
     if recovery_nemesis:
         cluster.queue.add(3_000_000 + nem.next_int(1_000_000), nemesis_tick)
 
+    # SERVING-SHAPED EPOCH CHURN (r17, elastic serving): drive the EXACT
+    # reconfiguration operations the TCP ``reconfigure`` verb proposes —
+    # net.reconfig.plan_join / plan_leave / plan_move, pure functions of
+    # the current topology — through the sim's deterministic delivery,
+    # composed with the recovery nemesis and device faults (membership
+    # change racing recovery racing kill -9: the Jepsen scenario class).
+    # The stream is a dedicated fork appended after EVERY existing fork
+    # (wl, net, top, drift, dur, rst, nem), so arming it perturbs no
+    # other stream and a churn-off run is byte-identical to r16.
+    rcf = rs.fork()
+
+    def reconfig_tick():
+        if cluster.queue.now > workload_micros:
+            return
+        # the operator no-stacking guard (the TCP verb rejects the same
+        # way): never propose while a rebalance is migrating data
+        if any(not s.bootstrapping.is_empty()
+               for node in cluster.nodes.values()
+               for s in node.command_stores.unsafe_all_stores()):
+            cluster.queue.add(cluster.queue.now + 2_000_000, reconfig_tick)
+            return
+        from ..net.reconfig import plan_join, plan_leave, plan_move
+        current = cluster.topologies[-1]
+        members = sorted(current.nodes())
+        absent = [n for n in node_ids if n not in members]
+        roll = rcf.next_int(3)
+        if roll == 0 and absent:
+            leg, topo = "add", plan_join(current, rcf.pick(absent),
+                                         current.epoch + 1)
+        elif roll == 1 and len(members) > max(3, rf):
+            leg, topo = "remove", plan_leave(current, rcf.pick(members),
+                                             current.epoch + 1)
+        else:
+            shard = current.shards[rcf.next_int(len(current.shards))]
+            leg, topo = "move", plan_move(current, shard.range.start,
+                                          members[rcf.next_int(
+                                              len(members))],
+                                          current.epoch + 1)
+        cluster.add_topology(topo)
+        result.epochs += 1
+        result.reconfig_churn[leg] = result.reconfig_churn.get(leg, 0) + 1
+        cluster.queue.add(cluster.queue.now + 5_000_000
+                          + rcf.next_int(3_000_000), reconfig_tick)
+
+    if reconfig_churn:
+        cluster.queue.add(4_500_000 + rcf.next_int(1_500_000),
+                          reconfig_tick)
+
     # run the workload window + drain until every op resolves
     cluster.run_for(workload_micros)
     cluster.heal()
@@ -574,6 +629,8 @@ def run_burn(seed: int, n_ops: int = 100, n_keys: int = 20,
         result.stats[f"Recovery.{ev}"] = n
     for leg, n in sorted(result.nemesis.items()):
         result.stats[f"RecoveryNemesis.{leg}"] = n
+    for leg, n in sorted(result.reconfig_churn.items()):
+        result.stats[f"ReconfigChurn.{leg}"] = n
     return result
 
 
@@ -597,6 +654,12 @@ def main(argv=None):
                    help="aim chaos at live recoveries: coordinator kill "
                         "mid-recovery, partition/heal around the recovery "
                         "quorum, concurrent-recoverer ballot races")
+    p.add_argument("--reconfig-churn", action="store_true",
+                   help="serving-shaped epoch churn: add/remove/move "
+                        "epochs via the SAME net.reconfig planners the "
+                        "TCP reconfigure verb proposes (dedicated RNG "
+                        "fork appended last; composes with "
+                        "--recovery-nemesis and --device-faults)")
     args = p.parse_args(argv)
 
     if args.loop_seed is not None:
@@ -607,7 +670,8 @@ def main(argv=None):
                          restarts=not args.no_restarts,
                          device_faults=args.device_faults,
                          device_fault_p=args.device_fault_p,
-                         recovery_nemesis=args.recovery_nemesis)
+                         recovery_nemesis=args.recovery_nemesis,
+                         reconfig_churn=args.reconfig_churn)
             print(f"seed {seed}: {r}")
             seed += 1
     start = args.seed if args.seed is not None else 0
@@ -616,7 +680,8 @@ def main(argv=None):
                      churn=not args.no_churn, restarts=not args.no_restarts,
                      device_faults=args.device_faults,
                      device_fault_p=args.device_fault_p,
-                     recovery_nemesis=args.recovery_nemesis)
+                     recovery_nemesis=args.recovery_nemesis,
+                     reconfig_churn=args.reconfig_churn)
         print(f"seed {seed}: {r}")
 
 

@@ -30,10 +30,12 @@ class _EpochState:
 
     def record_sync(self, node: int) -> bool:
         """Record a node's sync-complete; returns True if the epoch just
-        became fully synced (per-shard quorums of acks)."""
+        became fully synced (per-shard quorums of acks).  The ack is
+        recorded even once quorum-synced: ``all_members_synced`` (the
+        serving no-stacking guard) needs the laggards' acks too."""
+        self.synced_nodes.add(node)
         if self.sync_complete:
             return False
-        self.synced_nodes.add(node)
         for shard in self.topology.shards:
             acked = sum(1 for n in shard.nodes if n in self.synced_nodes)
             if acked < shard.slow_path_quorum_size:
@@ -145,6 +147,35 @@ class TopologyManager:
     def is_sync_complete(self, epoch: int) -> bool:
         s = self._state(epoch)
         return s is not None and s.sync_complete
+
+    def all_members_synced(self, epoch: int) -> bool:
+        """Every MEMBER of the epoch has acked it (stronger than
+        ``is_sync_complete``'s per-shard quorum — the serving reconfig
+        verb's no-stacking guard needs the laggards too)."""
+        s = self._state(epoch)
+        if s is None:
+            return False
+        return s.sync_complete and all(
+            n in s.synced_nodes for n in s.topology.nodes())
+
+    def retire_below(self, epoch: int) -> int:
+        """Retire (drop) epoch states strictly below ``epoch`` — the
+        serving cluster's epoch-lifecycle tail (ref: TopologyManager's
+        truncation of epochs below ``minEpoch``).  Only SYNC-COMPLETE
+        epochs retire (an unsynced epoch still anchors dual-quorum
+        windows), the newest epoch always survives, and the caller owns
+        the policy of how far back is safe (the serving manager keeps the
+        newest prefix-synced epoch plus a donor-catalogue lag).  Returns
+        the number retired."""
+        n = 0
+        while (len(self._epochs) > 1
+               and self._epochs[0].topology.epoch < epoch
+               and self._epochs[0].sync_complete):
+            self._epochs.pop(0)
+            n += 1
+        if n:
+            self._min_epoch = self._epochs[0].topology.epoch
+        return n
 
     # -- coordination topology selection ------------------------------------
     @staticmethod

@@ -1079,7 +1079,7 @@ def main(em: Emitter):
             [sys.executable,
              os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "serve_bench.py"), "--bench"],
-            env=env, capture_output=True, text=True, timeout=600)
+            env=env, capture_output=True, text=True, timeout=900)
         serve_rows = []
         for line in serve.stdout.splitlines():
             if line.strip().startswith("{"):
@@ -1111,6 +1111,24 @@ def main(em: Emitter):
                     "# serving index counters are per-committed-txn "
                     "(bytes) / per-1k-txn (frames, fanouts) over the "
                     "whole config-6 sweep")
+        # r17: the elastic-serving counters join the # index: line from
+        # the config-9 rebalance row (int-parseable; wall-clock counters
+        # are info-only in the trend map — the oscillating box makes
+        # them drift rows, not gates)
+        ela_row = next((r for r in serve_rows
+                        if "rebalance_wall_ms" in r.get("metric", "")), None)
+        if ela_row is not None:
+            em.note("# index: "
+                    f"epoch_current={ela_row.get('epoch_current', 0)} "
+                    f"epochs_retired={ela_row.get('epochs_retired', 0)} "
+                    "bootstrap_bytes_rx="
+                    f"{ela_row.get('bootstrap_bytes_rx', 0)} "
+                    "bootstrap_wall_ms="
+                    f"{ela_row.get('bootstrap_wall_ms', 0)} "
+                    f"handoff_ranges={ela_row.get('handoff_ranges', 0)}\n"
+                    "# elastic index counters come from the config-9 "
+                    "join+leave leg (one node joined, one left, "
+                    "mid-load)")
     except Exception as e:
         em.note(f"# CONFIG 6/7 (serving) failed: {e!r}")
 

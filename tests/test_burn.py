@@ -261,3 +261,25 @@ def test_post_chaos_quiescence_gate(seed):
     assert result.quiet_recovery_msgs < 60, (
         f"seed {seed}: recovery traffic has not quiesced: "
         f"{result.quiet_recovery_msgs} recovery messages in the silent window")
+
+
+def test_burn_reconfig_churn_composes_and_is_deterministic():
+    """r17 serving-shaped epoch churn: the SAME add/remove/move planners
+    the TCP reconfigure verb proposes, driven through the sim, composed
+    with the recovery nemesis — byte-deterministic across a double run
+    (stats + span + flight exports), every op resolved, churn fired.
+    The churn stream is a fork appended after every existing one, so
+    churn-off runs stay byte-identical to prior rounds by construction."""
+    from accord_tpu.sim.burn import run_burn
+    a = run_burn(5, n_ops=40, reconfig_churn=True, recovery_nemesis=True)
+    b = run_burn(5, n_ops=40, reconfig_churn=True, recovery_nemesis=True)
+    assert a.ops_unresolved == 0
+    assert sum(a.reconfig_churn.values()) > 0, "churn never fired"
+    assert a.epochs > 1
+    diff = {k for k in set(a.stats) | set(b.stats)
+            if a.stats.get(k) != b.stats.get(k)}
+    assert not diff, f"nondeterministic under reconfig churn: {sorted(diff)[:6]}"
+    assert a.span_export == b.span_export
+    assert a.flight_export == b.flight_export
+    # the churn legs ride stats for exactly this comparison
+    assert any(k.startswith("ReconfigChurn.") for k in a.stats)

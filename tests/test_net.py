@@ -234,6 +234,40 @@ BINARY_PINS_V1 = [
     ("b101010363c3a9026e31fffffffffffffffb83a474797065a374786ea66d73675f6964fba374786e9193a172a4636cc3a9c0",
      {"src": "cé", "dest": "n1",
       "body": {"type": "txn", "msg_id": -5, "txn": [["r", "clé", None]]}}),
+    # r17 elastic-serving frames: the operator verb, topology
+    # propagation, sync-quorum gossip, the fetch side of the gossip,
+    # one snapshot-stream chunk (pinned with the codec-agnostic base64
+    # part representation — the binary codec may ALSO carry raw bytes,
+    # covered by the chunk round-trip test below), and the epoch-bearing
+    # codec_hello (the mixed-epoch interop handshake)
+    ("b10106026331026e31000000000000000585a474797065ab7265636f6e666967757265a66d73675f696405a26f70a3616464a46e6f6465a26e34a461646472ae3132372e302e302e313a37303034",
+     {"src": "c1", "dest": "n1",
+      "body": {"type": "reconfigure", "msg_id": 5, "op": "add",
+               "node": "n4", "addr": "127.0.0.1:7004"}}),
+    ("b10106026e31026e32800000000000000082a474797065a8746f706f5f6e6577a8746f706f6c6f677984a565706f636802a6736861726473929400cd01f492020392020394cd01f4cd03e892030590a56e6f64657383a13293a26e31a93132372e302e302e31cd1b59a13393a26e32a93132372e302e302e31cd1b5aa13593a26e34a93132372e302e302e31cd1b5ca870726f706f736572a26e31",
+     {"src": "n1", "dest": "n2",
+      "body": {"type": "topo_new",
+               "topology": {"epoch": 2,
+                            "shards": [[0, 500, [2, 3], [2, 3]],
+                                       [500, 1000, [3, 5], []]],
+                            "nodes": {"2": ["n1", "127.0.0.1", 7001],
+                                      "3": ["n2", "127.0.0.1", 7002],
+                                      "5": ["n4", "127.0.0.1", 7004]},
+                            "proposer": "n1"}}}),
+    ("b10106026e32026e31800000000000000083a474797065aa65706f63685f73796e63a46e6f6465a26e32a565706f636802",
+     {"src": "n2", "dest": "n1",
+      "body": {"type": "epoch_sync", "node": "n2", "epoch": 2}}),
+    ("b10106026e34026e31800000000000000083a474797065aa746f706f5f6665746368a46e6f6465a26e34a565706f636802",
+     {"src": "n4", "dest": "n1",
+      "body": {"type": "topo_fetch", "node": "n4", "epoch": 2}}),
+    ("b10100026e31026e34800000000000000085a474797065ac6163636f72645f6368756e6ba3636964a46e312337a373657101a16e03a470617274b46332356863484e6f62335174596e6c305a584d3d",
+     {"src": "n1", "dest": "n4",
+      "body": {"type": "accord_chunk", "cid": "n1#7", "seq": 1, "n": 3,
+               "part": "c25hcHNob3QtYnl0ZXM="}}),
+    ("b10106026e3100800000000000000085a474797065ab636f6465635f68656c6c6fa466726f6da26e31a5636f646563a662696e617279a776657273696f6e01a565706f636803",
+     {"src": "n1", "dest": "",
+      "body": {"type": "codec_hello", "from": "n1", "codec": "binary",
+               "version": 1, "epoch": 3}}),
 ]
 
 ALL_BINARY_PINS = {1: BINARY_PINS_V1}

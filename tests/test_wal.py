@@ -836,3 +836,126 @@ def test_admission_gate_without_feed_is_r12_behaviour():
         g.release(50_000)
     assert g.n_latency_cuts >= 1
     assert g.stats()["p99_source"] == "root"
+
+
+# ---------------------------------------------------------------------------
+# topology epoch records (r17, elastic serving)
+# ---------------------------------------------------------------------------
+
+def _topo_doc(epoch):
+    from accord_tpu.net.reconfig import plan_join, topology_to_doc
+    from accord_tpu.sim.topology_factory import build_topology
+    t = build_topology(1, (2, 3, 4), 3, 4)
+    for e in range(2, epoch + 1):
+        t = plan_join(t, 4 + e)
+    info = {n: (f"n{n - 1}", "127.0.0.1", 7000 + n) for n in t.nodes()}
+    return topology_to_doc(t, info, proposer="n1")
+
+
+def test_topology_records_recover_across_restart(tmp_path):
+    """The epoch ledger is a journal fact: a node killed -9
+    mid-reconfiguration — a proposal journaled but never broadcast
+    included — recovers holding the exact ledger it had."""
+    j = _mk_journal(tmp_path / "j")
+    d2, d3 = _topo_doc(2), _topo_doc(3)
+    j.record_topology(d2)
+    j.record_topology(d2)          # idempotent re-ingest: one record
+    j.record_topology(d3)
+    j.commit.flush(sync=True)
+    j.close()
+    r = _mk_journal(tmp_path / "j")
+    assert r.has_restored_state()
+    assert [d["epoch"] for d in r.topologies()] == [2, 3]
+    assert r.topologies()[0] == d2 and r.topologies()[1] == d3
+    r.close()
+
+
+def test_topology_records_survive_snapshot_floor(tmp_path):
+    """A snapshot whose floor passes the topo records still restores the
+    epoch history (the ledger rides encode_state/install_state)."""
+    j = _mk_journal(tmp_path / "j")
+    j.record_topology(_topo_doc(2))
+    j.record_reply("c1", 1, {"type": "txn_ok", "txn": []})
+    j.commit.flush(sync=True)
+    assert j.maybe_snapshot(force=True)
+    # drop every WAL segment below the floor, then recover: only the
+    # snapshot carries the ledger now
+    j.close()
+    r = _mk_journal(tmp_path / "j")
+    assert r.replay_stats["snapshot_loaded"]
+    assert [d["epoch"] for d in r.topologies()] == [2]
+    assert r.replied_body("c1", 1) is not None
+    r.close()
+
+
+def test_mid_reconfiguration_crash_point_sweep(tmp_path):
+    """Recovery == replay of the surviving prefix WITH topology/epoch +
+    bootstrap records in the stream: a byte-level truncation anywhere in
+    a mid-reconfiguration WAL (epoch doc, bootstrap started, fence mark,
+    next epoch, bootstrap done) recovers byte-identically to the replay
+    of exactly the surviving records."""
+    from accord_tpu.primitives.keys import Range, Ranges
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    src = tmp_path / "j"
+    j = _mk_journal(src, debug_capture=True)
+    ranges = Ranges([Range(0, 500)])
+    fence = TxnId.create(2, 77, TxnKind.ExclusiveSyncPoint,
+                         Domain.Range, 2)
+    j.record_topology(_topo_doc(2))
+    j.record_bootstrap(0, ranges, 2)
+    j.record_bootstrapped_at(0, ranges, fence)
+    j.reserve_hlc(1 << 20)
+    j.record_topology(_topo_doc(3))
+    j.record_bootstrap_done(0, ranges, 2)
+    j.record_reply("c1", 5, {"type": "txn_ok", "txn": []})
+    j.commit.flush(sync=True)
+    docs = list(j.debug_records)
+    j.close()
+    seg_names = sorted(p for p in os.listdir(src) if p.startswith("wal-"))
+    blobs = {p: (src / p).read_bytes() for p in seg_names}
+    total = sum(len(b) for b in blobs.values())
+    rs = RandomSource(0x7070)
+    for case_i in range(40):
+        cut = rs.next_int(total) + 1
+        case = tmp_path / "case"
+        shutil.rmtree(case, ignore_errors=True)
+        os.makedirs(case)
+        left = cut
+        for p in seg_names:
+            take = min(left, len(blobs[p]))
+            left -= take
+            if take > 0:
+                (case / p).write_bytes(blobs[p][:take])
+        r = _mk_journal(case)
+        tail = r.wal.tail_seq
+        got = r.canonical_state_json()
+        r.close()
+        assert got == _reference_state(docs, tail, tmp_path), \
+            f"case {case_i} cut={cut}: mid-reconfiguration truncation " \
+            f"did not recover to the surviving prefix (seq<={tail})"
+
+
+def test_pre_epoch_record_journals_replay_forever(tmp_path):
+    """Journals (and snapshots) written BEFORE the topology ledger
+    existed keep replaying: no topo records, no 'topologies' state key —
+    recovery tolerates both, forever."""
+    j = _mk_journal(tmp_path / "j")
+    j.record_reply("c1", 1, {"type": "txn_ok", "txn": []})
+    j.reserve_hlc(4096)
+    j.commit.flush(sync=True)
+    j.close()
+    r = _mk_journal(tmp_path / "j")
+    assert r.topologies() == []
+    assert r.replied_body("c1", 1) is not None
+    # a pre-r17 snapshot state dict (no 'topologies' key) installs clean
+    state = r.encode_state()
+    state.pop("topologies")
+    fresh = _mk_journal(tmp_path / "j2")
+    fresh._replaying = True
+    fresh.install_state(state)
+    fresh._replaying = False
+    assert fresh.topologies() == []
+    assert fresh.replied_body("c1", 1) is not None
+    fresh.close()
+    r.close()

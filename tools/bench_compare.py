@@ -214,6 +214,17 @@ def main(argv=None):
             failures.append(check(f"index.{key}", old_idx[key],
                                   new_idx[key], args.latency_threshold,
                                   lower_is_better=lower))
+    # r17 elastic-serving counters: printed for the reviewer, not gated
+    # (wall clocks ride the box oscillation; byte/range counts scale with
+    # the leg's data volume — bench_trend carries them as drift notes)
+    ela = [(k, old_idx.get(k), new_idx.get(k))
+           for k in ("epoch_current", "epochs_retired",
+                     "bootstrap_bytes_rx", "bootstrap_wall_ms",
+                     "handoff_ranges")
+           if old_idx.get(k) is not None or new_idx.get(k) is not None]
+    if ela:
+        print("  elastic (info-only): "
+              + "  ".join(f"{k}: {o} -> {n}" for k, o, n in ela))
 
     common = [m for m in old_cfg if m in new_cfg]
     print(f"config rows ({len(common)} common, "
@@ -221,7 +232,18 @@ def main(argv=None):
           f"{len(old_cfg) - len(common)} old-only):")
     for m in common:
         o, n = old_cfg[m], new_cfg[m]
-        latency = o.get("unit") == "sim_ms"
+        if o.get("gated") is False or n.get("gated") is False:
+            # rows that opt out of value gating IN-ROW (r17: the
+            # rebalance wall clocks — 500ms-tick-quantized wall numbers
+            # on the oscillating box; their note names the comparable
+            # signals).  Printed, never failed.
+            print(f"  {m:60s} {o.get('value')} -> {n.get('value')} "
+                  f"(info-only: gated=false in-row)")
+            continue
+        # sim_ms (sim-time latencies) and ms (wall-clock durations) both
+        # gate lower-is-better — a row measured in time that "goes up"
+        # is a regression, never a win
+        latency = o.get("unit") in ("sim_ms", "ms")
         failures.append(check(
             m, o.get("value"), n.get("value"),
             args.latency_threshold if latency else args.threshold,

@@ -64,6 +64,16 @@ INDEX_GATED = {
     "frames_coalesced": "up",
     "batched_fanouts": "up",
     "batch_occupancy_p50": "up",
+    # r17 elastic-serving counters: deliberately INFO-ONLY (None) — the
+    # rebalance/bootstrap wall clocks ride the oscillating box's 2-4x
+    # swing and the byte/range counts scale with the leg's data volume,
+    # so a hard gate would manufacture waivers; drift_notes still
+    # surfaces any big move with its history
+    "epoch_current": None,
+    "epochs_retired": None,
+    "bootstrap_bytes_rx": None,
+    "bootstrap_wall_ms": None,
+    "handoff_ranges": None,
 }
 
 
@@ -86,6 +96,11 @@ def load_series(rounds):
         if val is None:
             return
         s = series.setdefault(key, {"dir": direction, "points": []})
+        if direction is None:
+            # opt-out wins for the WHOLE series: once any round marks a
+            # row info-only ("gated": false), earlier rounds that predate
+            # the marker must not re-gate it
+            s["dir"] = None
         s["points"].append((rnd, val))
 
     for rnd, path in rounds:
@@ -93,8 +108,14 @@ def load_series(rounds):
         if head is not None:
             add(f"headline.{head['metric']}", rnd, head.get("value"), "up")
         for m, row in cfg.items():
-            latency = row.get("unit") == "sim_ms"
-            add(m, rnd, row.get("value"), "down" if latency else "up")
+            # sim_ms AND wall-clock ms rows gate lower-is-better (the
+            # r17 rebalance wall is a duration: up = worse); a row may
+            # opt out of value gating entirely with "gated": false
+            # (tracked info-only, like ungated index counters)
+            latency = row.get("unit") in ("sim_ms", "ms")
+            direction = (None if row.get("gated") is False
+                         else "down" if latency else "up")
+            add(m, rnd, row.get("value"), direction)
             add(f"{m}.vs_baseline", rnd, row.get("vs_baseline"), "up")
             add(f"{m}.fast_path_rate", rnd, row.get("fast_path_rate"), "up")
             for ph, pd in (row.get("phases_ms") or {}).items():

@@ -1,0 +1,158 @@
+"""Operator CLI for live epoch reconfiguration on a serving cluster.
+
+    python tools/reconfig.py --node 127.0.0.1:7001 status
+    python tools/reconfig.py --node 127.0.0.1:7001 add n4=127.0.0.1:7004
+    python tools/reconfig.py --node 127.0.0.1:7001 remove n3
+    python tools/reconfig.py --node 127.0.0.1:7001 move 536870912 n2
+    python tools/reconfig.py --node 127.0.0.1:7001 watch --epoch 2
+
+``add``/``remove``/``move`` send the ``reconfigure`` control verb to the
+named node (the proposer): it journals the epoch doc durably, ingests it,
+and broadcasts ``topo_new`` to every old and new member.  ``status``
+prints the node's reconfig stats block (current epoch, sync state,
+bootstrap progress, retirement).  ``watch`` polls until the given epoch
+(default: the newest the node knows) reports synced with no bootstrap in
+flight — the operator's "rebalance done" signal.
+
+Typical join runbook:
+
+1. start the new node with ``--join`` (it boots as a non-member observer
+   with the EXISTING cluster as its epoch-1 member list);
+2. ``reconfig.py add n4=host:port`` against any member;
+3. ``reconfig.py watch`` until settled — the joiner has bootstrapped its
+   ranges from donor snapshots over the wire and acked the sync quorum.
+
+Leave runbook: ``remove n3``, ``watch``, then stop the n3 process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accord_tpu.net.client import NodeConnection           # noqa: E402
+
+
+def parse_addr(s: str):
+    host, _, port = s.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+async def _request(addr, body: dict, timeout: float = 15.0) -> dict:
+    host, port = parse_addr(addr)
+    conn = NodeConnection("node", host, port, src=f"reconfig-cli-{os.getpid()}",
+                          codec="json")
+    await conn.connect()
+    try:
+        return await conn.request(body, 1, timeout)
+    finally:
+        await conn.close()
+
+
+async def _stats(addr) -> dict:
+    body = await _request(addr, {"type": "stats"})
+    return (body.get("stats") or {})
+
+
+def cmd_status(args) -> int:
+    stats = asyncio.run(_stats(args.node))
+    out = {"name": stats.get("name"),
+           "reconfig": stats.get("reconfig"),
+           "chunks": stats.get("chunks"),
+           "links": sorted((stats.get("links") or {}))}
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_reconfigure(args, body: dict, watch_addr: str = None) -> int:
+    body["type"] = "reconfigure"
+    reply = asyncio.run(_request(args.node, body))
+    print(json.dumps({k: v for k, v in reply.items()
+                      if k != "topology"}, indent=1, sort_keys=True))
+    if reply.get("type") != "reconfigure_ok":
+        return 1
+    if args.wait:
+        args.epoch = reply["epoch"]
+        if watch_addr:
+            # for a JOIN, watch the JOINER: epoch_synced closes on a
+            # quorum that need not include it, and bootstrapping_now is
+            # per-node — the joiner's own stats are the signal that its
+            # snapshot fetch finished.  (A cluster-wide settle check is
+            # what net.harness.await_epoch / serve_bench run; for a
+            # REMOVE, re-run `watch` against each adopter before
+            # stopping the removed node — it may still be serving
+            # donor snapshots.)
+            args.node = watch_addr
+        return cmd_watch(args)
+    return 0
+
+
+def cmd_watch(args) -> int:
+    deadline = time.time() + args.timeout
+    while True:
+        rc = asyncio.run(_stats(args.node)).get("reconfig") or {}
+        epoch = args.epoch or rc.get("epoch_current", 0)
+        settled = (rc.get("epoch_current", 0) >= epoch
+                   and rc.get("epoch_synced")
+                   and not rc.get("bootstrapping_now"))
+        print(f"epoch={rc.get('epoch_current')} "
+              f"synced={rc.get('epoch_synced')} "
+              f"bootstrapping={rc.get('bootstrapping_now')} "
+              f"retired={rc.get('epochs_retired')} "
+              f"handoff_ranges={rc.get('handoff_ranges')} "
+              f"bootstrap_bytes_rx={rc.get('bootstrap_bytes_rx')}",
+              flush=True)
+        if settled:
+            print("settled")
+            return 0
+        if time.time() > deadline:
+            print("TIMEOUT waiting for the epoch to settle",
+                  file=sys.stderr)
+            return 1
+        time.sleep(1.0)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="live epoch reconfiguration")
+    p.add_argument("--node", required=True, help="host:port of any member")
+    p.add_argument("--wait", action="store_true",
+                   help="after a proposal, watch until it settles")
+    p.add_argument("--timeout", type=float, default=120.0)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status")
+    sp = sub.add_parser("add")
+    sp.add_argument("spec", help="name=host:port of the joining node")
+    sp = sub.add_parser("remove")
+    sp.add_argument("name")
+    sp = sub.add_parser("move")
+    sp.add_argument("token", type=int)
+    sp.add_argument("name")
+    sp = sub.add_parser("watch")
+    sp.add_argument("--epoch", type=int, default=None)
+    args = p.parse_args(argv)
+    if args.cmd == "status":
+        return cmd_status(args)
+    if args.cmd == "watch":
+        if not hasattr(args, "epoch"):
+            args.epoch = None
+        return cmd_watch(args)
+    if args.cmd == "add":
+        name, _, addr = args.spec.partition("=")
+        return cmd_reconfigure(args, {"op": "add", "node": name,
+                                      "addr": addr}, watch_addr=addr)
+    if args.cmd == "remove":
+        return cmd_reconfigure(args, {"op": "remove", "node": args.name})
+    if args.cmd == "move":
+        return cmd_reconfigure(args, {"op": "move", "token": args.token,
+                                      "node": args.name})
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

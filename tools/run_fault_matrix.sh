@@ -29,6 +29,13 @@
 # byte-identically (stats + span + flight exports), and the composed
 # nemesis+device-fault run must keep the degradation ladder
 # protocol-invisible.  ACCORD_TPU_FAULT_MATRIX=recovery runs it alone.
+# r17 adds the reconfiguration leg: (a) the burn's serving-shaped epoch
+# churn (net.reconfig planners: add/remove/move) COMPOSED with the
+# recovery nemesis x 3 seeds, double-run byte-deterministic; (b) the TCP
+# elastic smoke killing -9 the JOINING node mid-bootstrap and the epoch
+# PROPOSER mid-propose on a journaled cluster — both must converge into
+# one consistent epoch with zero failed ops and zero duplicate replies.
+# ACCORD_TPU_FAULT_MATRIX=reconfig runs it alone.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -121,6 +128,67 @@ PY
 
 if [ "$HALF" = "recovery" ]; then
     run_recovery_leg
+    exit $?
+fi
+
+run_reconfig_leg() {
+    echo ""
+    echo "== reconfiguration legs (epoch churn burn + elastic TCP kills) =="
+    local rc=0
+    env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        python - <<'PY' || rc=1
+import sys
+
+from accord_tpu.sim.burn import run_burn
+
+SEEDS = (0, 5, 11)
+failures = []
+for seed in SEEDS:
+    a = run_burn(seed, n_ops=60, reconfig_churn=True, recovery_nemesis=True)
+    b = run_burn(seed, n_ops=60, reconfig_churn=True, recovery_nemesis=True)
+    line = (f"seed {seed} reconfig-churn: ok={a.ops_ok} "
+            f"unresolved={a.ops_unresolved} epochs={a.epochs} "
+            f"churn={dict(a.reconfig_churn)} nemesis={dict(a.nemesis)}")
+    problems = []
+    if a.stats != b.stats:
+        diff = {k for k in set(a.stats) | set(b.stats)
+                if a.stats.get(k) != b.stats.get(k)}
+        problems.append(f"NONDETERMINISTIC: {sorted(diff)[:6]}")
+    if a.span_export != b.span_export:
+        problems.append("span export diverged across the double run")
+    if a.flight_export != b.flight_export:
+        problems.append("flight export diverged across the double run")
+    if a.ops_unresolved:
+        problems.append(f"{a.ops_unresolved} ops unresolved")
+    if sum(a.reconfig_churn.values()) == 0:
+        problems.append("reconfig churn never fired")
+    if problems:
+        failures.append(f"seed {seed}: " + "; ".join(problems))
+        line += "  <-- " + "; ".join(problems)
+    print(line, flush=True)
+if failures:
+    print("\nRECONFIG CHURN LEG FAILED:")
+    for f in failures:
+        print("  " + f)
+    sys.exit(1)
+print("reconfig churn legs clean: deterministic, composed with the "
+      "recovery nemesis, every seed converged")
+PY
+    for kill in "--kill-joiner" "--kill-proposer"; do
+        echo "-- leg: elastic TCP $kill"
+        if ! env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+            python -m accord_tpu.net.harness --reconfig-smoke $kill \
+            --out "${FAULT_MATRIX_OUT:-/tmp}"; then
+            echo "   LEG FAILED: reconfig $kill (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
+            rc=1
+        fi
+    done
+    return $rc
+}
+
+if [ "$HALF" = "reconfig" ]; then
+    run_reconfig_leg
     exit $?
 fi
 
@@ -235,20 +303,22 @@ PY
 net_rc=0
 disk_rc=0
 recovery_rc=0
+reconfig_rc=0
 if [ "$HALF" != "device" ]; then
     run_net_leg || net_rc=$?
     run_disk_leg || disk_rc=$?
     run_recovery_leg || recovery_rc=$?
+    run_reconfig_leg || reconfig_rc=$?
 fi
 
-if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ] || [ "$disk_rc" -ne 0 ] || [ "$recovery_rc" -ne 0 ]; then
+if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ] || [ "$disk_rc" -ne 0 ] || [ "$recovery_rc" -ne 0 ] || [ "$reconfig_rc" -ne 0 ]; then
     echo ""
-    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc, disk rc=$disk_rc, recovery rc=$recovery_rc)"
+    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc, disk rc=$disk_rc, recovery rc=$recovery_rc, reconfig rc=$reconfig_rc)"
     exit 1
 fi
 echo ""
 if [ "$HALF" = "device" ]; then
-    echo "device fault matrix clean (network/disk/recovery legs skipped: ACCORD_TPU_FAULT_MATRIX=device)"
+    echo "device fault matrix clean (network/disk/recovery/reconfig legs skipped: ACCORD_TPU_FAULT_MATRIX=device)"
 else
-    echo "full fault matrix clean (device + network + storage + recovery)"
+    echo "full fault matrix clean (device + network + storage + recovery + reconfig)"
 fi

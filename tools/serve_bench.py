@@ -33,6 +33,7 @@ import argparse
 import asyncio
 import json
 import os
+import random
 import sys
 import tempfile
 import time
@@ -40,12 +41,202 @@ from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from accord_tpu.net.client import ClusterClient              # noqa: E402
-from accord_tpu.net.harness import (ServeCluster, cluster_net_stats,  # noqa: E402
-                                    open_loop, saturation_probe,
+from accord_tpu.net.admission import Overloaded              # noqa: E402
+from accord_tpu.net.client import ClusterClient, TxnFailed   # noqa: E402
+from accord_tpu.net.harness import (ServeCluster, await_epoch,  # noqa: E402
+                                    cluster_net_stats, open_loop,
+                                    propose_with_retry, saturation_probe,
                                     wait_ready)
 
 POINTS = ((0.5, "0.5x"), (1.0, "1x"), (3.0, "3x"))
+TOKEN_SPACE = 1 << 32
+
+
+async def elastic_sweep(cluster: ServeCluster, note,
+                        workers: int = 8, pre_s: float = 4.0,
+                        post_s: float = 5.0, n_keys: int = 24) -> dict:
+    """The r17 elastic leg (BENCH config 9): one node JOINS and one node
+    LEAVES mid-load.  Zero failed client ops is the contract (sheds and
+    retries allowed — failures not), strict serializability is CHECKED
+    (every committed op feeds the same composite verifier the burn
+    trusts), and the row records the rebalance wall clock + the goodput
+    dip while data migrated."""
+    import asyncio as aio
+
+    from accord_tpu.sim.elle import (CompositeVerifier,
+                                     ListAppendCycleChecker)
+    from accord_tpu.sim.verifier import StrictSerializabilityVerifier
+
+    client = ClusterClient(cluster.addrs, timeout=10.0,
+                           codec=cluster.wire_codec)
+    verifier = CompositeVerifier(StrictSerializabilityVerifier(),
+                                 ListAppendCycleChecker())
+    loop = aio.get_event_loop()
+    stride = TOKEN_SPACE // n_keys
+    routing: list = list(cluster.names)     # nodes workers may pick
+    stop = [False]
+    ok = [0]
+    failed = [0]
+    retries = [0]
+    completions: list = []                  # wall-clock completion stamps
+    rng = random.Random(29)
+    tag = [0]
+
+    def now_us() -> int:
+        return int(loop.time() * 1e6)
+
+    async def one_op(wrng) -> None:
+        # reads FIRST in the op list: the reply's read values are then
+        # exactly the pre-state the verifier's model expects (no own-
+        # append echo to strip)
+        keys = sorted({wrng.randrange(n_keys) * stride
+                       for _ in range(wrng.randint(1, 2))})
+        do_append = wrng.random() < 0.7
+        op_id = verifier.begin()
+        start = now_us()
+        attempt = 0
+        while True:
+            ops = [["r", k, None] for k in keys]
+            appends = {}
+            if do_append:
+                tag[0] += 1
+                for k in keys:
+                    v = f"e{op_id}a{attempt}k{k}t{tag[0]}"
+                    ops.append(["append", k, v])
+                    appends[k] = (v,)
+            node = routing[wrng.randrange(len(routing))]
+            try:
+                body = await client.submit(ops, node=node, timeout=4.0)
+            except Overloaded as exc:
+                if stop[0]:
+                    return   # harness shutdown: op unstarted, uncounted
+                retries[0] += 1
+                await aio.sleep((exc.retry_after_ms
+                                 + wrng.randrange(25)) / 1e3)
+                continue   # shed: nothing executed, same values retry
+            except (TxnFailed, aio.TimeoutError, ConnectionError,
+                    KeyError):
+                # indeterminate: the attempt may have committed — retag
+                # (the burn's discipline: the verifier only learns the
+                # attempt that REPORTED success; stray committed tags
+                # appear as unverified writes its prefix checks allow)
+                if stop[0]:
+                    return   # shutdown-time in-flight: indeterminate,
+                    #          not a failure (the burn counts the same way)
+                attempt += 1
+                retries[0] += 1
+                if attempt > 24:
+                    failed[0] += 1
+                    return
+                await aio.sleep(0.05 + wrng.random() * 0.1)
+                continue
+            reads = {k: tuple(v for v in op[2])
+                     for op, k in zip(body["txn"], keys)
+                     if op[0] == "r"}
+            verifier.on_result(op_id, start, now_us(), reads, appends)
+            ok[0] += 1
+            completions.append(loop.time())
+            return
+
+    async def worker(i: int) -> None:
+        wrng = random.Random(1000 + i)
+        while not stop[0]:
+            await one_op(wrng)
+
+    def goodput(t0: float, t1: float) -> float:
+        n = sum(1 for t in completions if t0 <= t < t1)
+        return n / max(t1 - t0, 1e-9)
+
+    out: dict = {}
+    try:
+        await wait_ready(cluster, client)
+        tasks = [loop.create_task(worker(i)) for i in range(workers)]
+        t_base = loop.time()
+        await aio.sleep(pre_s)
+        # -- JOIN: spawn the observer, propose, settle --------------------
+        t_join0 = loop.time()
+        joiner = cluster.add_node()
+        jhost, jport = cluster.node_addr(joiner)
+        await wait_ready(cluster, client)
+        rep = await propose_with_retry(client, cluster.names[0], "add",
+                                       node=joiner,
+                                       addr=f"{jhost}:{jport}")
+        if rep.get("type") != "reconfigure_ok":
+            raise RuntimeError(f"add proposal rejected: {rep}")
+        await await_epoch(client, cluster.names, rep["epoch"],
+                          timeout=120.0)
+        t_join1 = loop.time()
+        routing.append(joiner)
+        note(f"  joined {joiner}: epoch {rep['epoch']} settled in "
+             f"{t_join1 - t_join0:.2f}s")
+        # -- LEAVE: propose, settle, drain, terminate ---------------------
+        leaver = cluster.names[2]
+        t_leave0 = loop.time()
+        rep2 = await propose_with_retry(client, cluster.names[0],
+                                        "remove", node=leaver)
+        if rep2.get("type") != "reconfigure_ok":
+            raise RuntimeError(f"remove proposal rejected: {rep2}")
+        survivors = [n for n in cluster.names if n != leaver]
+        await await_epoch(client, survivors, rep2["epoch"], timeout=120.0)
+        routing[:] = [n for n in routing if n != leaver]
+        await client.remove_node(leaver)
+        cluster.remove_node(leaver)
+        t_leave1 = loop.time()
+        note(f"  removed {leaver}: epoch {rep2['epoch']} settled in "
+             f"{t_leave1 - t_leave0:.2f}s")
+        await aio.sleep(post_s)
+        stop[0] = True
+        await aio.wait(tasks, timeout=30.0)
+        for t in tasks:
+            if not t.done():
+                t.cancel()
+        # final reads pin the end state for the checker's prefix model
+        for k in range(n_keys):
+            token = k * stride
+            try:
+                body = await client.submit([["r", token, None]],
+                                           node=routing[0], timeout=8.0)
+                verifier.set_final(token, tuple(body["txn"][0][2]))
+            except Exception:
+                pass
+        strict_ok = True
+        strict_err = None
+        try:
+            verifier.verify()
+        except AssertionError as exc:
+            strict_ok = False
+            strict_err = str(exc)[:400]
+        stats = await cluster_net_stats(client, routing)
+        recon_rows = [(s or {}).get("reconfig") or {}
+                      for s in stats["per_node"].values()]
+        out = {
+            "ok": ok[0], "failed": failed[0], "retries": retries[0],
+            "duplicate_replies": client.duplicate_replies(),
+            "strict_serializable": strict_ok,
+            "strict_error": strict_err,
+            "joiner": joiner, "left": leaver,
+            "join_wall_ms": int((t_join1 - t_join0) * 1000),
+            "leave_wall_ms": int((t_leave1 - t_leave0) * 1000),
+            "goodput_before": round(goodput(t_base, t_join0), 1),
+            "goodput_during_rebalance": round(
+                goodput(t_join0, t_leave1), 1),
+            "goodput_after": round(goodput(t_leave1, loop.time()), 1),
+            "epoch_current": max((r.get("epoch_current", 0)
+                                  for r in recon_rows), default=0),
+            "epochs_retired": max((r.get("epochs_retired", 0)
+                                   for r in recon_rows), default=0),
+            "bootstrap_bytes_rx": sum(r.get("bootstrap_bytes_rx", 0)
+                                      for r in recon_rows),
+            "bootstrap_wall_ms": max((r.get("bootstrap_wall_ms", 0)
+                                      for r in recon_rows), default=0),
+            "handoff_ranges": sum(r.get("handoff_ranges", 0)
+                                  for r in recon_rows),
+            "alive": cluster.alive(),
+        }
+    finally:
+        stop[0] = True
+        await client.close()
+    return out
 
 
 async def journal_sweep(cluster: ServeCluster, duration: float,
@@ -218,6 +409,9 @@ def main(argv=None) -> int:
     p.add_argument("--no-journal-leg", action="store_true",
                    help="skip the r13 durability leg (journal-on 1x + "
                         "kill -9 recovery, BENCH config 7)")
+    p.add_argument("--no-elastic-leg", action="store_true",
+                   help="skip the r17 elastic leg (join + leave "
+                        "mid-load, BENCH config 9)")
     p.add_argument("--wire-codec", choices=("json", "binary"),
                    default="binary",
                    help="wire codec for every node AND the load "
@@ -397,6 +591,80 @@ def main(argv=None) -> int:
         note(f"durability @1x: ratio={ratio and round(ratio, 3)} "
              f"(floor 0.9) verdict={durable_ok}")
 
+    # -- the r17 elastic leg (BENCH config 9): join + leave mid-load -----
+    elastic_ok = True
+    if not args.no_elastic_leg:
+        ecluster = ServeCluster(
+            n_nodes=args.nodes, stores=args.stores,
+            admit_max=args.admit_max, target_p99_ms=args.target_p99_ms,
+            request_timeout_ms=3000, wire_codec=args.wire_codec)
+        ecluster.spawn_all()
+        note(f"elastic leg: spawned {args.nodes} nodes (one will join, "
+             f"one will leave, under load)")
+        try:
+            eres = asyncio.run(elastic_sweep(ecluster, note))
+        finally:
+            ecluster.shutdown()
+        elastic_ok = (eres.get("failed", 1) == 0
+                      and eres.get("strict_serializable", False)
+                      and eres.get("duplicate_replies", 1) == 0
+                      and all(eres.get("alive", {}).values())
+                      and eres.get("epochs_retired", 0) >= 1)
+        base_g = eres.get("goodput_before") or 0
+        dip = (round(eres["goodput_during_rebalance"] / base_g, 4)
+               if base_g else None)
+        rebalance_ms = (eres.get("join_wall_ms", 0)
+                        + eres.get("leave_wall_ms", 0))
+        rows_e = [{
+            "config": 9,
+            "metric": f"{prefix}_rebalance_wall_ms",
+            "value": rebalance_ms, "unit": "ms",
+            "gated": False,
+            "platform": "cpu", "transport": "tcp-loopback",
+            "wire_codec": args.wire_codec,
+            "join_wall_ms": eres.get("join_wall_ms"),
+            "leave_wall_ms": eres.get("leave_wall_ms"),
+            "ok": eres.get("ok"), "failed": eres.get("failed"),
+            "retries": eres.get("retries"),
+            "duplicate_replies": eres.get("duplicate_replies"),
+            "strict_serializable": eres.get("strict_serializable"),
+            "zero_failed_ops": eres.get("failed", 1) == 0,
+            "goodput_before": eres.get("goodput_before"),
+            "goodput_during_rebalance":
+                eres.get("goodput_during_rebalance"),
+            "goodput_after": eres.get("goodput_after"),
+            "goodput_dip_ratio": dip,
+            "epoch_current": eres.get("epoch_current"),
+            "epochs_retired": eres.get("epochs_retired"),
+            "bootstrap_bytes_rx": eres.get("bootstrap_bytes_rx"),
+            "bootstrap_wall_ms": eres.get("bootstrap_wall_ms"),
+            "handoff_ranges": eres.get("handoff_ranges"),
+            "elastic_verdict": elastic_ok,
+            "note": "one node joins AND one node leaves mid-load "
+                    "(client retries allowed, failures not); strict "
+                    "serializability checked by the burn's composite "
+                    "verifier over every committed op; wall-clock "
+                    "numbers on an oscillating box — the goodput dip "
+                    "ratio and counters are the comparable signals; "
+                    "bootstrap_wall_ms resolution is one 500ms tick",
+        }, {
+            "config": 9,
+            "metric": f"{prefix}_rebalance_goodput_dip_ratio",
+            "value": dip, "unit": "ratio",
+            "gated": False,
+            "platform": "cpu",
+            "note": "goodput while data migrated vs the pre-rebalance "
+                    "baseline of the same run (1.0 = no dip)",
+        }]
+        rows.extend(rows_e)
+        note(f"elastic: joined {eres.get('joiner')} removed "
+             f"{eres.get('left')} rebalance={rebalance_ms}ms "
+             f"dip={dip} failed={eres.get('failed')} "
+             f"strict={eres.get('strict_serializable')} "
+             f"verdict={elastic_ok}"
+             + (f" strict_error={eres.get('strict_error')}"
+                if eres.get("strict_error") else ""))
+
     for row in rows:
         print(json.dumps(row))
     note(f"graceful overload @3x: {verdict}")
@@ -409,6 +677,11 @@ def main(argv=None) -> int:
         note("FAIL: the durability leg violated its contract (goodput "
              ">=0.9x journal-off, replay>0, zero duplicate replies, "
              "all nodes alive)")
+        return 1
+    if not elastic_ok and not args.no_assert:
+        note("FAIL: the elastic leg violated its contract (zero failed "
+             "ops, strict serializability, zero duplicate replies, all "
+             "nodes alive, old epoch retired)")
         return 1
     return 0
 
