@@ -14,6 +14,7 @@ whose word-views feed the drain kernel (accord_tpu.ops.drain).
 
 from __future__ import annotations
 
+import bisect
 from typing import FrozenSet, List, Optional, Tuple
 
 from ..primitives.deps import PartialDeps
@@ -23,7 +24,10 @@ from ..primitives.txn import PartialTxn
 from ..primitives.writes import Writes
 from ..utils import invariants
 from ..utils.bitset import ImmutableBitSet, SimpleBitSet
+from .fastpath import proto_fastpath_enabled
 from .status import Durability, Known, SaveStatus, Status
+
+_FASTPATH = proto_fastpath_enabled()
 
 
 class WaitingOn:
@@ -54,7 +58,6 @@ class WaitingOn:
         return i >= 0 and self.waiting.get(i)
 
     def _index_of(self, txn_id: TxnId) -> int:
-        import bisect
         i = bisect.bisect_left(self.txn_ids, txn_id)
         if i < len(self.txn_ids) and self.txn_ids[i] == txn_id:
             return i
@@ -180,6 +183,16 @@ class Command:
 
     # -- evolution ----------------------------------------------------------
     def updated(self, **kwargs) -> "Command":
+        if _FASTPATH:
+            # slot-copy transition: the per-op hot loop runs this for
+            # every state change, so skip the dict rebuild + __init__
+            # re-entry; an unknown kwarg still raises (no spare slots)
+            new = Command.__new__(Command)
+            for s in Command.__slots__:
+                setattr(new, s, getattr(self, s))
+            for k, v in kwargs.items():
+                setattr(new, k, v)
+            return new
         fields = {s: getattr(self, s) for s in Command.__slots__}
         fields.update(kwargs)
         return Command(**fields)

@@ -28,8 +28,11 @@ from ..utils import async_chain, invariants
 from ..utils.interval_map import ReducingRangeMap
 from .command import Command
 from .commands_for_key import CommandsForKey, InternalStatus
+from .fastpath import proto_fastpath_enabled
 from .redundant import DurableBefore, MaxConflicts, RedundantBefore
 from .status import SaveStatus
+
+_FASTPATH = proto_fastpath_enabled()
 
 
 class PreLoadContext:
@@ -60,17 +63,31 @@ _EMPTY_CONTEXT = PreLoadContext()
 
 class RangesForEpoch:
     """Per-store epoch -> owned-ranges history
-    (ref: CommandStores.java:142-336)."""
+    (ref: CommandStores.java:142-336).
 
-    __slots__ = ("_by_epoch",)
+    ``at``/``all_between`` run once per (message, store) on the serving
+    hot path — the r18 profile showed them as a top frame — so both are
+    memoized behind the PROTO_FASTPATH knob.  ``snapshot`` is the ONLY
+    mutation point, so clearing the memo there keeps every cached answer
+    bit-identical to the straight-line recompute."""
+
+    __slots__ = ("_by_epoch", "_at_memo", "_between_memo")
 
     def __init__(self):
         self._by_epoch: Dict[int, Ranges] = {}
+        self._at_memo: Dict[int, Ranges] = {}
+        self._between_memo: Dict[Tuple[int, int], Ranges] = {}
 
     def snapshot(self, epoch: int, ranges: Ranges) -> None:
         self._by_epoch[epoch] = ranges
+        self._at_memo.clear()
+        self._between_memo.clear()
 
     def at(self, epoch: int) -> Ranges:
+        if _FASTPATH:
+            hit = self._at_memo.get(epoch)
+            if hit is not None:
+                return hit
         if not self._by_epoch:
             return Ranges.empty()
         best = None
@@ -79,7 +96,10 @@ class RangesForEpoch:
                 best = e
         if best is None:
             best = min(self._by_epoch)
-        return self._by_epoch[best]
+        out = self._by_epoch[best]
+        if _FASTPATH:
+            self._at_memo[epoch] = out
+        return out
 
     def current(self) -> Ranges:
         if not self._by_epoch:
@@ -97,10 +117,16 @@ class RangesForEpoch:
         """Union of every snapshot in effect during [min_epoch, max_epoch]:
         the snapshots declared inside the window plus the one already active
         at min_epoch."""
+        if _FASTPATH:
+            hit = self._between_memo.get((min_epoch, max_epoch))
+            if hit is not None:
+                return hit
         out = self.at(min_epoch)
         for e, r in self._by_epoch.items():
             if min_epoch <= e <= max_epoch:
                 out = out.with_(r)
+        if _FASTPATH:
+            self._between_memo[(min_epoch, max_epoch)] = out
         return out
 
     def all(self) -> Ranges:

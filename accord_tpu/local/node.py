@@ -19,6 +19,9 @@ from ..topology.manager import TopologyManager
 from ..topology.topology import Topologies, Topology
 from ..utils import async_chain, invariants
 from .command_store import CommandStores, PreLoadContext
+from .fastpath import proto_fastpath_enabled
+
+_FASTPATH = proto_fastpath_enabled()
 
 
 def _resolve_device_mode(device_mode: Optional[bool]) -> bool:
@@ -97,6 +100,8 @@ class Node:
             self._hlc_reserved = journal.hlc_reserved
         self._coordinating: Dict[TxnId, object] = {}  # active coordinations
         self._pending_topologies: Dict[int, Topology] = {}  # out-of-order epochs
+        # PROTO_FASTPATH: (topology, owned Ranges) pair for _owned_ranges
+        self._owned_memo = None
 
     # -- time (ref: Node.java:341-366) --------------------------------------
     HLC_RESERVE_BATCH = 1 << 20   # ids per journal reservation write
@@ -244,11 +249,25 @@ class Node:
         home_key = self.select_home_key(txn_id, keys)
         return Route.full(home_key, keys.to_unseekables())
 
+    def _owned_ranges(self) -> Ranges:
+        """This node's owned ranges in the CURRENT topology.  Topology is
+        immutable and ``ranges_for_node`` allocates a fresh Ranges per
+        call, so under PROTO_FASTPATH the answer is cached keyed on the
+        topology object's identity (one entry — replaced on epoch change)
+        instead of being rebuilt for every message's progress-key probe."""
+        topology = self.topology_manager.current()
+        if not _FASTPATH:
+            return topology.ranges_for_node(self.node_id)
+        cached = self._owned_memo
+        if cached is None or cached[0] is not topology:
+            cached = (topology, topology.ranges_for_node(self.node_id))
+            self._owned_memo = cached
+        return cached[1]
+
     def select_home_key(self, txn_id: TxnId, keys: Seekables) -> int:
         """Pick a home key among the txn's keys, preferring one this node
         owns (ref: Node.selectHomeKey)."""
-        topology = self.topology_manager.current()
-        owned = topology.ranges_for_node(self.node_id)
+        owned = self._owned_ranges()
         if isinstance(keys, Ranges):
             for r in keys:
                 if owned.contains_token(r.start):
@@ -261,13 +280,11 @@ class Node:
 
     def select_progress_key(self, txn_id: TxnId, route: Route) -> Optional[int]:
         """The home key if we replicate it, else None (ref: Node.java:652-673)."""
-        topology = self.topology_manager.current()
-        owned = topology.ranges_for_node(self.node_id)
+        owned = self._owned_ranges()
         return route.home_key if owned.contains_token(route.home_key) else None
 
     def is_home_shard_replica(self, txn_id: TxnId, route: Route) -> bool:
-        owned = self.topology_manager.current().ranges_for_node(self.node_id)
-        return owned.contains_token(route.home_key)
+        return self._owned_ranges().contains_token(route.home_key)
 
     # -- messaging ----------------------------------------------------------
     def send(self, to: int, request,

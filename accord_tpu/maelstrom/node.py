@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import api, wire
 from ..coordinate.errors import Timeout
+from ..local.fastpath import proto_fastpath_enabled
 from ..impl.config_service import AbstractConfigurationService
 from ..local.node import Node
 from ..primitives.datum import datum_from_json, datum_to_json
@@ -32,6 +33,8 @@ from ..sim.kvstore import KVDataStore, KVQuery, KVRead, KVUpdate
 from ..topology.shard import Shard
 from ..topology.topology import Topology
 from ..utils.random_source import RandomSource
+
+_FASTPATH = proto_fastpath_enabled()
 
 TOKEN_SPACE = 1 << 32
 # ref: Main.java uses a 1s sweeper; a cold JAX node stalls for seconds per
@@ -131,12 +134,29 @@ class MaelstromSink(api.MessageSink):
         self.process.scheduler.now(
             lambda: node.receive(request, my_id, msg_id))
 
+    def _encode_request(self, request):
+        """wire.encode with per-OBJECT doc reuse (r18): coordinators fan
+        ONE PreAccept/Accept/Apply object to every shard replica, and the
+        golden-frame gate pins decode∘encode as the identity, so the doc
+        cached at first encode (or attached at inbound decode) is
+        byte-identical for every later destination."""
+        if not _FASTPATH:
+            return wire.encode(request)
+        doc = getattr(request, "_wire_doc", None)
+        if doc is None:
+            doc = wire.encode(request)
+            try:
+                request._wire_doc = doc
+            except AttributeError:
+                pass   # slotted/exotic request: encode per send
+        return doc
+
     def send(self, to: int, request) -> None:
         if self._is_self(to):
             self._deliver_local(request, self._msg_id())
             return
         self._emit(to, {"type": "accord_req", "msg_id": self._msg_id(),
-                        "payload": wire.encode(request)})
+                        "payload": self._encode_request(request)})
 
     def send_with_callback(self, to: int, request, callback) -> None:
         msg_id = self._msg_id()
@@ -161,7 +181,7 @@ class MaelstromSink(api.MessageSink):
             self._deliver_local(request, msg_id)
             return
         self._emit(to, {"type": "accord_req", "msg_id": msg_id,
-                        "payload": wire.encode(request)})
+                        "payload": self._encode_request(request)})
 
     def _resolve(self, msg_id: int) -> Optional[_Pending]:
         """Pop a pending request and tombstone its heap entry in place

@@ -24,6 +24,15 @@ class ApplyReplyKind(enum.IntEnum):
     Insufficient = 2
 
 
+# per-store outcome -> reply kind; module-level so the hot map_fn does a
+# single dict probe instead of rebuilding the literal per op
+_APPLY_OUTCOME_KIND = {
+    commands.ApplyOutcome.Success: ApplyReplyKind.Applied,
+    commands.ApplyOutcome.Redundant: ApplyReplyKind.Redundant,
+    commands.ApplyOutcome.Insufficient: ApplyReplyKind.Insufficient,
+}
+
+
 class ApplyReply(Reply):
     type = MessageType.APPLY_RSP
 
@@ -76,10 +85,7 @@ class Apply(TxnRequest):
             outcome = commands.apply(safe, txn_id, route, self.execute_at,
                                      partial_deps, partial_txn, self.writes,
                                      self.result)
-            return {commands.ApplyOutcome.Success: ApplyReplyKind.Applied,
-                    commands.ApplyOutcome.Redundant: ApplyReplyKind.Redundant,
-                    commands.ApplyOutcome.Insufficient: ApplyReplyKind.Insufficient,
-                    }[outcome]
+            return _APPLY_OUTCOME_KIND[outcome]
 
         def reduce_fn(a, b):
             return max(a, b)

@@ -30,6 +30,13 @@ class CommitKind(enum.Enum):
     Stable = 1         # Stable: deps frozen, execution may begin
 
 
+# reduction keeps the lowest rank (worst outcome wins the quorum verdict)
+_COMMIT_RANK = {commands.CommitOutcome.Insufficient: 0,
+                commands.CommitOutcome.Rejected: 1,
+                commands.CommitOutcome.Redundant: 2,
+                commands.CommitOutcome.Success: 3}
+
+
 class CommitOk(Reply):
     type = MessageType.STABLE_FAST_PATH_REQ
 
@@ -91,11 +98,7 @@ class Commit(TxnRequest):
             return outcome
 
         def reduce_fn(a, b):
-            order = [commands.CommitOutcome.Insufficient,
-                     commands.CommitOutcome.Rejected,
-                     commands.CommitOutcome.Redundant,
-                     commands.CommitOutcome.Success]
-            return a if order.index(a) < order.index(b) else b
+            return a if _COMMIT_RANK[a] < _COMMIT_RANK[b] else b
 
         def consume(result, failure):
             if failure is not None:

@@ -1,0 +1,158 @@
+"""Serving-path profile aggregation: the pstats half of ROADMAP item 4.
+
+``ACCORD_TPU_NODE_PROFILE=<dir>`` makes every ``accord_tpu.net.server``
+process cProfile its whole serving lifetime and dump ``<dir>/<name>.pstats``
+at clean (SIGTERM) shutdown.  This module is the consumer: it spawns a
+cluster with the knob armed, drives a closed-loop saturation window, merges
+the per-node dumps and prices every frame in **ms of CPU per committed
+txn** — the ranked table ``tools/profile.py serve`` prints and the single
+scalar (``protocol_ms_per_txn``) the BENCH config-6 row carries.
+
+What counts as "protocol CPU": the summed ``tottime`` of every frame in a
+repo file (``accord_tpu/``).  That excludes the event loop's select/epoll
+waits (wall, not work), C built-ins and jax/numpy internals — it is exactly
+the pure-Python protocol+serving work the r18 hot-loop rewrites attack, and
+it is measured per committed txn so the number survives this box's 2-4x
+wall-clock oscillation.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pstats
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO_TAG = os.sep + "accord_tpu" + os.sep
+
+
+def merge_pstats(prof_dir: str, expect: int = 0,
+                 timeout: float = 20.0) -> Tuple[pstats.Stats, List[str]]:
+    """One merged Stats over every ``*.pstats`` in ``prof_dir`` (waiting up
+    to ``timeout`` for ``expect`` dumps — SIGTERM'd nodes write them on the
+    way out)."""
+    deadline = time.time() + timeout
+    while True:
+        paths = sorted(glob.glob(os.path.join(prof_dir, "*.pstats")))
+        if len(paths) >= expect or time.time() > deadline:
+            break
+        time.sleep(0.2)
+    if not paths:
+        raise FileNotFoundError(f"no .pstats dumps under {prof_dir}")
+    st = pstats.Stats(paths[0])
+    for p in paths[1:]:
+        st.add(p)
+    return st, paths
+
+
+def _is_repo_frame(fname: str) -> bool:
+    return REPO_TAG in fname or fname.endswith(os.sep + "wire.py")
+
+
+def frame_rows(stats: pstats.Stats, txns: int, top: int = 30,
+               repo_only: bool = True) -> List[dict]:
+    """The ranked per-op cost table: [{frame, calls, tottime_s, cumtime_s,
+    ms_per_txn, calls_per_txn}] sorted by tottime."""
+    n = max(1, txns)
+    rows = []
+    for (fname, lineno, func), (cc, nc, tt, ct, _callers) \
+            in stats.stats.items():
+        if repo_only and not _is_repo_frame(fname):
+            continue
+        rows.append({
+            "frame": f"{os.path.basename(fname)}:{lineno}({func})",
+            "calls": nc,
+            "tottime_s": round(tt, 3),
+            "cumtime_s": round(ct, 3),
+            "ms_per_txn": round(1e3 * tt / n, 4),
+            "calls_per_txn": round(nc / n, 2),
+        })
+    rows.sort(key=lambda r: -r["tottime_s"])
+    return rows[:top]
+
+
+def protocol_ms_per_txn(stats: pstats.Stats, txns: int) -> float:
+    """Summed repo-frame tottime across every node, per committed txn."""
+    total = sum(tt for (fname, _ln, _fn), (_cc, _nc, tt, _ct, _cal)
+                in stats.stats.items() if _is_repo_frame(fname))
+    return 1e3 * total / max(1, txns)
+
+
+def profiled_saturation_run(n_nodes: int = 3, stores: int = 2,
+                            duration: float = 6.0, workers: int = 24,
+                            admit_max: int = 16, target_p99_ms: int = 2500,
+                            wire_codec: str = "binary",
+                            prof_dir: Optional[str] = None,
+                            top: int = 30,
+                            note=None,
+                            env_extra: Optional[Dict] = None) -> Dict:
+    """Spawn a cluster with ``ACCORD_TPU_NODE_PROFILE`` armed, drive a
+    closed-loop saturation window, SIGTERM the nodes (triggering the
+    dumps), and return the merged per-op cost readout:
+
+        {saturation_txns_per_sec, txns, protocol_ms_per_txn,
+         frames: [ranked rows], prof_dir, pstats: [paths]}
+
+    ``env_extra`` joins each node's environment on top of the profile
+    arming — pass ``{"ACCORD_TPU_PROTO_FASTPATH": "off"}`` to measure
+    the cache-free protocol cost with the same tool (the in-artifact
+    A/B: two adjacent probes share the box's oscillation window far
+    better than two probes from different rounds).
+    """
+    import asyncio
+    import tempfile
+
+    from .client import ClusterClient
+    from .harness import ServeCluster, saturation_probe, wait_ready
+
+    if note is None:
+        def note(_msg):
+            pass
+    prof_dir = prof_dir or tempfile.mkdtemp(prefix="accord_nodeprof_")
+    cluster = ServeCluster(n_nodes=n_nodes, stores=stores,
+                           admit_max=admit_max,
+                           target_p99_ms=target_p99_ms,
+                           request_timeout_ms=3000,
+                           wire_codec=wire_codec)
+    node_env = {"ACCORD_TPU_NODE_PROFILE": prof_dir, **(env_extra or {})}
+    for name in cluster.names:
+        cluster.spawn(name, env_extra=node_env)
+    note(f"profile leg: {n_nodes} nodes under ACCORD_TPU_NODE_PROFILE="
+         f"{prof_dir} (logs: {cluster.log_dir})")
+
+    async def drive():
+        client = ClusterClient(cluster.addrs, timeout=10.0,
+                               codec=wire_codec)
+        try:
+            await wait_ready(cluster, client, timeout=90.0)
+            # warm the protocol path (lazy cfk/topology init) INSIDE the
+            # profile window; the denominator counts these txns too, so
+            # the readout stays conservative
+            await saturation_probe(client, workers=4, duration=1.5, seed=3)
+            probe = await saturation_probe(client, workers=workers,
+                                           duration=duration, seed=42)
+            return probe, client.n_ok
+        finally:
+            await client.close()
+
+    try:
+        probe, n_ok = asyncio.run(drive())
+    finally:
+        # SIGTERM -> each node disables its profiler and dumps pstats
+        cluster.shutdown()
+    stats, paths = merge_pstats(prof_dir, expect=n_nodes)
+    txns = max(1, n_ok)
+    ms = protocol_ms_per_txn(stats, txns)
+    note(f"profile leg: {probe['rate']:.1f} txn/s at saturation, "
+         f"{txns} txns profiled, protocol CPU {ms:.2f} ms/txn "
+         f"({len(paths)} node dumps)")
+    return {
+        "saturation_txns_per_sec": round(probe["rate"], 1),
+        "saturation_p99_ms": probe["p99_ms"],
+        "txns": txns,
+        "protocol_ms_per_txn": round(ms, 3),
+        "frames": frame_rows(stats, txns, top=top),
+        "prof_dir": prof_dir,
+        "pstats": paths,
+    }

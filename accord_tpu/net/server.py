@@ -38,7 +38,8 @@ from typing import Callable, Dict, Optional, Tuple
 from typing import List
 
 from .. import api
-from ..utils import faults
+from ..local.fastpath import proto_fastpath_enabled
+from ..utils import faults, invariants
 from ..utils.random_source import RandomSource
 from . import bootstrap as net_bootstrap
 from . import codec as wire_codec
@@ -862,6 +863,15 @@ def main(argv=None) -> int:
         members = [n.strip() for n in args.members.split(",") if n.strip()]
     elif args.join:
         members = [n for n in peers if n != args.name]
+    # serving processes stand down the deep structural checks (the
+    # documented invariants contract: "the simulator runs with full
+    # paranoia while benchmarks run without" — r18 wired it: the O(n)
+    # sortedness scans were a top-10 profile frame).  Assertions only
+    # ever raise, so behavior is identical; ACCORD_TPU_PROTO_FASTPATH=off
+    # restores them along with every other fast path.
+    if proto_fastpath_enabled():
+        invariants.PARANOID = False
+
     server = NodeServer(
         args.name, host, port, peers,
         stores=args.stores, shards=args.shards, device_mode=device_mode,

@@ -21,8 +21,11 @@ from __future__ import annotations
 import bisect
 from typing import Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
+from ..local.fastpath import proto_fastpath_enabled
 from ..utils import invariants
 from .timestamp import Domain
+
+_FASTPATH = proto_fastpath_enabled()
 
 MIN_TOKEN = -(1 << 63)
 MAX_TOKEN = (1 << 63) - 1
@@ -280,7 +283,7 @@ class Ranges:
     """Immutable sorted set of ranges, normalised to non-overlapping merged
     form (ref: accord/primitives/Ranges.java, AbstractRanges.java)."""
 
-    __slots__ = ("_ranges",)
+    __slots__ = ("_ranges", "_starts_memo")
 
     domain = Domain.Range
 
@@ -294,6 +297,17 @@ class Ranges:
     def _normalise(rs: List[Range]) -> List[Range]:
         if not rs:
             return []
+        # already-normal fast path: each start strictly past the previous
+        # end means sorted, disjoint and non-adjacent — the dominant
+        # serving-path shape (slices/unions of already-normal Ranges);
+        # the slow path below would return these same objects unchanged
+        prev_end = rs[0].end
+        for i in range(1, len(rs)):
+            if rs[i].start <= prev_end:
+                break
+            prev_end = rs[i].end
+        else:
+            return rs
         rs = sorted(rs, key=lambda r: (r.start, r.end))
         out = [rs[0]]
         for r in rs[1:]:
@@ -338,8 +352,23 @@ class Ranges:
     def _starts(self) -> List[int]:
         return [r.start for r in self._ranges]
 
+    def _sorted_starts(self):
+        """Memoized starts tuple for the bisect probes (contains_token is
+        the single most frequent Ranges call on the serving path and was
+        rebuilding this list per probe).  _ranges is init-only, so the
+        memo — gated on PROTO_FASTPATH like every r18 cache — can never
+        go stale."""
+        if not _FASTPATH:
+            return [r.start for r in self._ranges]
+        try:
+            return self._starts_memo
+        except AttributeError:
+            st = tuple(r.start for r in self._ranges)
+            self._starts_memo = st
+            return st
+
     def index_containing(self, token: int) -> int:
-        i = bisect.bisect_right([r.start for r in self._ranges], token) - 1
+        i = bisect.bisect_right(self._sorted_starts(), token) - 1
         if i >= 0 and self._ranges[i].contains_token(token):
             return i
         return -1
@@ -354,7 +383,7 @@ class Ranges:
         return all(self._covers(r) for r in other)
 
     def _covers(self, r: Range) -> bool:
-        i = bisect.bisect_right([x.start for x in self._ranges], r.start) - 1
+        i = bisect.bisect_right(self._sorted_starts(), r.start) - 1
         return i >= 0 and self._ranges[i].contains_range(r)
 
     def intersects(self, other: Union["Ranges", "Keys", "RoutingKeys"]) -> bool:

@@ -186,7 +186,7 @@ _DOMAIN_BY_ORDINAL = (Domain.Key, Domain.Range)
 class Timestamp:
     """Immutable HLC timestamp. Totally ordered by (msb, lsb, node)."""
 
-    __slots__ = ("msb", "lsb", "node")
+    __slots__ = ("msb", "lsb", "node", "_hash")
 
     def __init__(self, msb: int, lsb: int, node: int):
         self.msb = msb & _MASK64
@@ -305,7 +305,17 @@ class Timestamp:
             else NotImplemented
 
     def __hash__(self):
-        return hash((self.msb, self.lsb, self.node))
+        # the single hottest call on the serving path (every dict/set
+        # probe keyed by TxnId lands here); fields are init-only, so the
+        # tuple hash — the SAME value, preserving set iteration order and
+        # thus byte-determinism — is computed once and cached in a slot
+        # left unset until first use (no per-construction cost)
+        try:
+            return self._hash
+        except AttributeError:
+            h = hash((self.msb, self.lsb, self.node))
+            self._hash = h
+            return h
 
     def compare_to(self, o: "Timestamp") -> int:
         if self.msb != o.msb:

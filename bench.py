@@ -1111,6 +1111,17 @@ def main(em: Emitter):
                     "# serving index counters are per-committed-txn "
                     "(bytes) / per-1k-txn (frames, fanouts) over the "
                     "whole config-6 sweep")
+        # r18: the profiled protocol cost joins the index line as
+        # MICROseconds (the parsers int() every token); lower-is-better
+        # at the wall-clock latency threshold — the cProfile'd leg rides
+        # the same oscillating box as every other ms row
+        if sat_row is not None and sat_row.get(
+                "protocol_ms_per_txn") is not None:
+            em.note("# index: protocol_us_per_txn="
+                    f"{int(sat_row['protocol_ms_per_txn'] * 1000)}\n"
+                    "# protocol_us_per_txn: merged-pstats accord_tpu "
+                    "tottime per committed txn from the short "
+                    "cProfile'd config-6 leg")
         # r17: the elastic-serving counters join the # index: line from
         # the config-9 rebalance row (int-parseable; wall-clock counters
         # are info-only in the trend map — the oscillating box makes

@@ -36,6 +36,15 @@ def pytest_configure(config):
         from accord_tpu.local.dispatch import fusion_enabled
         assert not fusion_enabled(), \
             "ACCORD_TPU_FUSION=off set but dispatch.fusion_enabled() is True"
+    # ACCORD_TPU_PROTO_FASTPATH=off canary (r18, same contract as the
+    # fusion knob): with the escape hatch set every protocol fast-path
+    # cache must actually stand down and tier-1 must stay green — no
+    # hot-loop rewrite may become load-bearing for correctness.
+    if os.environ.get("ACCORD_TPU_PROTO_FASTPATH", "").lower() in (
+            "off", "0", "false", "no"):
+        from accord_tpu.local.fastpath import proto_fastpath_enabled
+        assert not proto_fastpath_enabled(), \
+            "ACCORD_TPU_PROTO_FASTPATH=off set but proto_fastpath_enabled()"
     # ACCORD_TPU_OBS=off canary (r09, same contract as the fusion knob):
     # with the escape hatch set the obs subsystem must actually stand down
     # (no span recording, no device profiler) and tier-1 must stay green —

@@ -208,7 +208,10 @@ def main(argv=None):
     for key, lower in (("wire_bytes_tx", True), ("wire_bytes_rx", True),
                        ("frames_coalesced", False),
                        ("batched_fanouts", False),
-                       ("batch_occupancy_p50", False)):
+                       ("batch_occupancy_p50", False),
+                       # r18: profiled protocol CPU per txn (us) — same
+                       # cProfile tooling every round, lower is better
+                       ("protocol_us_per_txn", True)):
         if (old_idx.get(key) is not None
                 and new_idx.get(key) is not None):
             failures.append(check(f"index.{key}", old_idx[key],

@@ -69,6 +69,10 @@ INDEX_GATED = {
     # swing and the byte/range counts scale with the leg's data volume,
     # so a hard gate would manufacture waivers; drift_notes still
     # surfaces any big move with its history
+    # r18: the profiled protocol CPU cost (microseconds/txn, from the
+    # cProfile'd config-6 leg) gates lower-is-better — same tool every
+    # round, so the profiler overhead cancels in the ratio
+    "protocol_us_per_txn": "down",
     "epoch_current": None,
     "epochs_retired": None,
     "bootstrap_bytes_rx": None,

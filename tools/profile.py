@@ -22,6 +22,14 @@ profile_hot / profile_hot2) this repo accreted across r04-r06.
         the r09 acceptance artifact that makes the r08 win visible as a
         timeline, not just a counter.
 
+    python tools/profile.py serve [--nodes 3] [--duration 6] [--top 30]
+        The r18 serving-path hunt: spawn the real TCP cluster under
+        ``ACCORD_TPU_NODE_PROFILE``, drive it to closed-loop saturation,
+        merge the per-node pstats dumps, and print the ranked per-op
+        cost table (ms of protocol CPU per committed txn, by frame) plus
+        the ``protocol_ms_per_txn`` scalar the BENCH config-6 row
+        carries.
+
 ``--trace PATH`` arms obs.devprof for the timed section and writes the
 Chrome trace there (any mode).  Counters print from the same
 obs.metrics.index_counters key list the bench ``# index:`` line uses.
@@ -321,9 +329,31 @@ def mode_launches(args):
               "coalesced timeline regardless", file=sys.stderr)
 
 
+def mode_serve(args):
+    from accord_tpu.net.profiling import profiled_saturation_run
+
+    res = profiled_saturation_run(
+        n_nodes=args.nodes, duration=args.duration, top=args.top or 30,
+        note=lambda msg: print(msg, file=sys.stderr))
+    print(f"{'ms/txn':>8s} {'calls/txn':>10s} {'tottime_s':>10s}  frame",
+          file=sys.stderr)
+    for r in res["frames"]:
+        print(f"{r['ms_per_txn']:8.3f} {r['calls_per_txn']:10.2f} "
+              f"{r['tottime_s']:10.3f}  {r['frame']}", file=sys.stderr)
+    print(f"saturation={res['saturation_txns_per_sec']} txn/s "
+          f"txns={res['txns']} "
+          f"protocol_ms_per_txn={res['protocol_ms_per_txn']}",
+          file=sys.stderr)
+    # machine-readable summary on stdout (stderr carries the table)
+    print(json.dumps({k: res[k] for k in
+                      ("saturation_txns_per_sec", "txns",
+                       "protocol_ms_per_txn", "prof_dir")}))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("mode", choices=["headline", "attr", "hot", "launches"])
+    p.add_argument("mode",
+                   choices=["headline", "attr", "hot", "launches", "serve"])
     p.add_argument("--n", type=int, default=100_000,
                    help="in-flight txns for headline/attr store")
     p.add_argument("--batch", type=int, default=2048)
@@ -337,9 +367,14 @@ def main(argv=None):
                    help="launches mode: bypass the fused-vs-solo pricing "
                         "so the trace always shows coalesced launches")
     p.add_argument("--cprofile", action="store_true")
+    p.add_argument("--nodes", type=int, default=3,
+                   help="serve mode: cluster size")
+    p.add_argument("--duration", type=float, default=6.0,
+                   help="serve mode: saturation window seconds")
     args = p.parse_args(argv)
     {"headline": mode_headline, "attr": mode_attr,
-     "hot": mode_hot, "launches": mode_launches}[args.mode](args)
+     "hot": mode_hot, "launches": mode_launches,
+     "serve": mode_serve}[args.mode](args)
 
 
 if __name__ == "__main__":

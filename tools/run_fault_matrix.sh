@@ -36,10 +36,19 @@
 # PROPOSER mid-propose on a journaled cluster — both must converge into
 # one consistent epoch with zero failed ops and zero duplicate replies.
 # ACCORD_TPU_FAULT_MATRIX=reconfig runs it alone.
+# r18: the net and recovery legs run TWICE — once with the protocol fast
+# paths on (default) and once with ACCORD_TPU_PROTO_FASTPATH=off — and
+# must be byte-deterministic under both: the r18 caches (slot-copy
+# command transitions, topology/starts memos, wire-doc reuse) may only
+# change speed, never one route or one byte of an export.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 HALF="${ACCORD_TPU_FAULT_MATRIX:-all}"
+
+# the two protocol fast-path settings every dual-run leg sweeps ("" = on:
+# the knob is default-enabled, any of off/0/false/no disables)
+FASTPATH_SETTINGS=("" "off")
 
 run_disk_leg() {
     echo ""
@@ -56,9 +65,13 @@ fi
 run_recovery_leg() {
     echo ""
     echo "== recovery-under-chaos nemesis legs (burn, 3 seeds, double-run) =="
-    env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
-        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
-        python - <<'PY'
+    local rc=0 fp
+    for fp in "${FASTPATH_SETTINGS[@]}"; do
+        echo "-- proto fastpath: ${fp:-on}"
+        env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+            ACCORD_TPU_PROTO_FASTPATH="$fp" \
+            XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+            python - <<'PY' || rc=1
 import json
 import os
 import sys
@@ -124,6 +137,8 @@ if failures:
 print("recovery nemesis legs clean: every seed converged, deterministic, "
       "exports byte-identical")
 PY
+    done
+    return $rc
 }
 
 if [ "$HALF" = "recovery" ]; then
@@ -199,18 +214,21 @@ run_net_leg() {
     # tearing a half-written coalesced binary batch must behave exactly
     # like the json debug codec's (protocol outcomes identical, zero
     # duplicate replies; the harness asserts both)
-    local rc=0
+    local rc=0 fp
+    for fp in "${FASTPATH_SETTINGS[@]}"; do
     for codec in binary json; do
         for spec in "conn_reset:0.04:5" "stalled_peer:0.03:5" "slow_link:0.25:5"; do
-            echo "-- leg: $spec codec=$codec"
+            echo "-- leg: $spec codec=$codec fastpath=${fp:-on}"
             if ! env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+                ACCORD_TPU_PROTO_FASTPATH="$fp" \
                 python -m accord_tpu.net.harness --smoke --txns 60 --nodes 2 \
                 --net-faults "$spec" --wire-codec "$codec" \
                 --out "${FAULT_MATRIX_OUT:-/tmp}"; then
-                echo "   LEG FAILED: $spec codec=$codec (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
+                echo "   LEG FAILED: $spec codec=$codec fastpath=${fp:-on} (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
                 rc=1
             fi
         done
+    done
     done
     return $rc
 }

@@ -412,6 +412,10 @@ def main(argv=None) -> int:
     p.add_argument("--no-elastic-leg", action="store_true",
                    help="skip the r17 elastic leg (join + leave "
                         "mid-load, BENCH config 9)")
+    p.add_argument("--no-profile-leg", action="store_true",
+                   help="skip the r18 profiled leg (short cProfile'd "
+                        "saturation run; protocol_ms_per_txn on the "
+                        "config-6 rows)")
     p.add_argument("--wire-codec", choices=("json", "binary"),
                    default="binary",
                    help="wire codec for every node AND the load "
@@ -664,6 +668,71 @@ def main(argv=None) -> int:
              f"verdict={elastic_ok}"
              + (f" strict_error={eres.get('strict_error')}"
                 if eres.get("strict_error") else ""))
+
+    # -- the r18 profiled leg: a SHORT saturation run with every node
+    #    under cProfile (ACCORD_TPU_NODE_PROFILE), merged into one
+    #    protocol-CPU-per-txn number.  Profiler overhead (~1us/call) and
+    #    the box's oscillation ride the absolute value — it trends at the
+    #    wall-clock latency threshold like every other ms row, and the
+    #    per-frame calls/txn (deterministic per protocol shape) travel
+    #    alongside for the reviewer --------------------------------------
+    if not args.no_profile_leg:
+        from accord_tpu.net.profiling import profiled_saturation_run
+        try:
+            prof = profiled_saturation_run(
+                n_nodes=args.nodes, stores=args.stores,
+                duration=min(duration, 6.0),
+                admit_max=args.admit_max,
+                target_p99_ms=args.target_p99_ms,
+                wire_codec=args.wire_codec, note=note)
+            # the in-artifact A/B: the SAME tool immediately re-runs with
+            # every r18 protocol cache disabled — two adjacent probes
+            # share the box's oscillation window far better than numbers
+            # from different rounds, so the ratio is the honest cut
+            off = profiled_saturation_run(
+                n_nodes=args.nodes, stores=args.stores,
+                duration=min(duration, 6.0),
+                admit_max=args.admit_max,
+                target_p99_ms=args.target_p99_ms,
+                wire_codec=args.wire_codec, note=note,
+                env_extra={"ACCORD_TPU_PROTO_FASTPATH": "off"})
+            pms = prof["protocol_ms_per_txn"]
+            pms_off = off["protocol_ms_per_txn"]
+            top = [{"frame": f["frame"],
+                    "ms_per_txn": f["ms_per_txn"],
+                    "calls_per_txn": f["calls_per_txn"]}
+                   for f in prof["frames"][:5]]
+            rows[0]["protocol_ms_per_txn"] = pms
+            rows.append({
+                "config": 6,
+                "metric": f"{prefix}_protocol_ms_per_txn",
+                "value": pms, "unit": "ms",
+                "platform": "cpu", "transport": "tcp-loopback",
+                "wire_codec": args.wire_codec,
+                "profiled_txns": prof["txns"],
+                "profiled_saturation_txns_per_sec":
+                    prof["saturation_txns_per_sec"],
+                "protocol_ms_per_txn_fastpath_off": pms_off,
+                "vs_fastpath_off": round(pms_off / pms, 4) if pms else None,
+                "fastpath_off_saturation_txns_per_sec":
+                    off["saturation_txns_per_sec"],
+                "top_frames": top,
+                "note": "sum of tottime over accord_tpu frames across "
+                        "all nodes (merged pstats), per committed txn, "
+                        "from a short cProfile'd saturation run — "
+                        "carries ~1us/call profiler overhead, so it is "
+                        "comparable round-over-round (same tool), not "
+                        "to the unprofiled rows; the _fastpath_off "
+                        "re-run (ACCORD_TPU_PROTO_FASTPATH=off, same "
+                        "tool, adjacent window) anchors vs_fastpath_off "
+                        "— the in-artifact cache-on/off cut; "
+                        "calls_per_txn is the box-independent signal",
+            })
+            note(f"profiled leg: protocol={pms}ms/txn (off={pms_off}) "
+                 f"over {prof['txns']} txns "
+                 f"({prof['saturation_txns_per_sec']} txn/s profiled)")
+        except Exception as e:          # profile leg must never sink the
+            note(f"profile leg failed: {e!r}")   # graceful-overload rows
 
     for row in rows:
         print(json.dumps(row))
