@@ -1791,6 +1791,16 @@ class DeviceState:
         self.n_compacted_slots = 0
         self.n_oom_degraded = 0
         self.n_host_ticks = 0          # drain ticks swept on host fallback
+        # r19 adaptive drain wavefront: W=1 ticks run the plain frontier
+        # sweep (byte-identical to pre-r19 behavior); W grows x2 only when
+        # a tick's ENTIRE candidate set synchronously reached Applied (the
+        # PreApplied cascade regime, where a serial chain would otherwise
+        # pay one tick per link), letting the log-depth level kernel
+        # harvest the next W executeAt antichains in one launch.  Any
+        # candidate that does not execute resets W to 1 — protocol-flow
+        # ticks never see a widened sweep.
+        self._drain_wavefront = 1
+        self.n_wavefront_ticks = 0     # ticks swept with W > 1
         # two-stage compacted downloads (r10): bytes actually transferred
         # (headers + live entry prefixes) vs what the old full padded
         # flat-buffer download would have moved — the compaction ratio on
@@ -3994,7 +4004,35 @@ class DeviceState:
                     dk.launch_check("drain")
                     state, live = self.drain.state()
                     faults.check("transfer", "drain download")
-                    if isinstance(state, drk.EllDrainState):
+                    wave = self._drain_wavefront
+                    fut = None
+                    if wave > 1 and drk.drain_logdepth_enabled():
+                        # widened sweep: the log-depth level pass prices one
+                        # launch for the next `wave` executeAt antichains.
+                        # Candidates beyond the true frontier are safe — the
+                        # per-candidate host re-validation below makes a
+                        # not-actually-ready candidate a no-op — and any
+                        # that fail to execute reset the wavefront
+                        try:
+                            if isinstance(state, drk.EllDrainState):
+                                mode = "ell-wave"
+                                lv, _r = drk.level_assign_ell(state)
+                            else:
+                                mode = "wave"
+                                lv, _r = drk.level_assign_dense(state)
+                            fut = (lv >= 1) & (lv <= wave)
+                            self.n_wavefront_ticks += 1
+                        except faults.DEVICE_EXCEPTIONS:
+                            # fail the widened launch over to the plain
+                            # frontier route, byte-identically (the W=1
+                            # candidate set); leave the outer handler to
+                            # the frontier's own faults
+                            self._drain_wavefront = wave = 1
+                            mode = None
+                            fut = None
+                    if wave > 1 and fut is not None:
+                        pass
+                    elif isinstance(state, drk.EllDrainState):
                         # large in-flight set: sparse gather sweep (no [N, N])
                         mode = "ell"
                         fut = drk.ready_frontier_ell(state)
@@ -4037,6 +4075,19 @@ class DeviceState:
                 key=_exec_order_key(safe))
             for txn_id in cands:
                 commands.refresh_waiting_and_maybe_execute(safe, txn_id)
+        # adaptive wavefront control (r19): widen only in the synchronous-
+        # cascade regime — every candidate this tick reached Applied before
+        # the tick returned (a serial chain drains in O(log depth) ticks
+        # instead of one tick per link).  Anything else (async execution,
+        # host/fused/mesh route, empty sweep, escape hatch) pins W back to
+        # 1, so protocol-flow ticks run the exact pre-r19 frontier sweep.
+        if mode in ("device", "ell", "wave", "ell-wave") \
+                and len(cand_slots) != 0 and drk.drain_logdepth_enabled() \
+                and all(int(self.drain.status[int(s)]) == dk.SLOT_APPLIED
+                        for s in cand_slots):
+            self._drain_wavefront = min(self._drain_wavefront * 2, 8192)
+        else:
+            self._drain_wavefront = 1
         if sweep_due:
             self.drain.sweep_free()
         if used_fused and self.drain.version != fused.version_for(self) \

@@ -498,8 +498,12 @@ class DeviceDispatcher:
                               partial(dev._tick, fused=f))
 
     def _prepare_fused_ticks(self, devs) -> Dict[int, FusedTick]:
+        # a widened-wavefront store (r19, _drain_wavefront > 1) is mid-
+        # cascade and runs the level kernel solo — the fused frontier sweep
+        # would shrink its candidate set back to one antichain
         cands = [d for d in devs
                  if not (d.host_pinned or d._dev_quar_flushes > 0)
+                 and getattr(d, "_drain_wavefront", 1) <= 1
                  and d.drain.active.any()]
         if len(cands) < 2:
             return {}

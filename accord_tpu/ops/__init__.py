@@ -9,12 +9,18 @@
 
 from .deps_kernel import (DepsQuery, DepsTable, build_query, build_table,
                           calculate_deps, empty_table, extract_deps)
-from .drain_kernel import DrainState, blocking_matrix, drain, ready_frontier
+from .drain_kernel import (DrainState, blocking_matrix, drain, drain_auto,
+                           drain_ell_auto, drain_ell_logdepth,
+                           drain_logdepth, drain_logdepth_enabled,
+                           level_assign_dense, level_assign_ell,
+                           ready_frontier)
 from .packing import masked_ts_max, pack_timestamps, ts_le, ts_lt
 
 __all__ = [
     "DepsQuery", "DepsTable", "build_query", "build_table", "calculate_deps",
     "empty_table", "extract_deps",
-    "DrainState", "blocking_matrix", "drain", "ready_frontier",
+    "DrainState", "blocking_matrix", "drain", "drain_auto", "drain_ell_auto",
+    "drain_ell_logdepth", "drain_logdepth", "drain_logdepth_enabled",
+    "level_assign_dense", "level_assign_ell", "ready_frontier",
     "masked_ts_max", "pack_timestamps", "ts_le", "ts_lt",
 ]

@@ -26,18 +26,37 @@ then iterate
 to fixpoint under ``lax.while_loop``.  Each sweep applies a whole antichain
 of the executeAt order, so the loop runs O(depth) times, not O(txns); the
 matvec is done in bf16 so XLA tiles it onto the MXU for large N.
+
+Log-depth form (r19, ROADMAP item 2): for a decided drain graph the blocking
+relation is STATIC, so each slot's execution round is a pure function of the
+graph — ``level[i] = 1 + max_j level[blocking deps of i]`` (0 = already
+applied, INF = blocked forever: an undecided/decided-not-stable dep, or an
+``awaits_all`` cycle).  :func:`level_assign_ell` computes it in O(log depth)
+device rounds by interleaving one Bellman relax (a single [N, D] gather) with
+a pointer jump over each row's critical-parent chain (``ptr, off <-
+ptr[ptr], off + off[ptr]`` — Wyllie list ranking generalized to DAG
+critical-path depth).  Every update is a path-witnessed lower bound, so the
+pass is sound on any graph and exact at stationarity; levels that exceed N
+are clamped to INF (a witness walk longer than N must ride a cycle, and
+blocking cycles can only arise through ``awaits_all`` edges).  The fixpoint
+kernels above remain the byte-exact oracle — ``drain_auto`` routes between
+the two by the measured cost model (never thresholds) and the
+``ACCORD_TPU_DRAIN=fixpoint`` escape hatch pins the oracle everywhere.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+import os
+from collections import OrderedDict
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import faults
 from .deps_kernel import (SLOT_APPLIED, SLOT_COMMITTED, SLOT_FREE,
-                          SLOT_INVALIDATED, SLOT_STABLE)
+                          SLOT_INVALIDATED, SLOT_STABLE, launch_check)
 from .packing import ts_lt
 
 
@@ -168,7 +187,28 @@ def ready_frontier_ell(state: EllDrainState) -> jnp.ndarray:
 # store axis; the sweep is the exact ready_frontier[_ell] trace vmapped over
 # that axis — bit-identical to the solo sweeps it replaces.
 
-_FUSED_FRONT_CACHE = {}
+# Keyed on raw per-store shape tuples, so a shape-churning workload (every
+# store growing through a different _pow2 ladder) would grow one compiled
+# program per distinct combination without bound.  LRU-bound it: steady
+# state reuses a handful of keys, and an evicted program just recompiles
+# on next use (counter surfaced on the ``# index:`` line).
+_FUSED_FRONT_CACHE_CAP = 32
+_FUSED_FRONT_CACHE = OrderedDict()
+
+
+def _fused_cache_get(key):
+    fn = _FUSED_FRONT_CACHE.get(key)
+    if fn is not None:
+        _FUSED_FRONT_CACHE.move_to_end(key)
+    return fn
+
+
+def _fused_cache_put(key, fn):
+    _FUSED_FRONT_CACHE[key] = fn
+    while len(_FUSED_FRONT_CACHE) > _FUSED_FRONT_CACHE_CAP:
+        _FUSED_FRONT_CACHE.popitem(last=False)
+        _COUNTERS["fused_front_evictions"] += 1
+    return fn
 
 
 def fused_ready_frontier(states):
@@ -179,7 +219,7 @@ def fused_ready_frontier(states):
     entries are exactly ready_frontier(states[i])."""
     shapes = tuple(st.status.shape[0] for st in states)
     key = ("dense", shapes)
-    fn = _FUSED_FRONT_CACHE.get(key)
+    fn = _fused_cache_get(key)
     if fn is None:
         n_max = max(shapes)
 
@@ -197,7 +237,7 @@ def fused_ready_frontier(states):
                                    zip(*(pad(st) for st in sts))))
             return jax.vmap(ready_frontier)(stacked)
 
-        fn = _FUSED_FRONT_CACHE[key] = jax.jit(traced)
+        fn = _fused_cache_put(key, jax.jit(traced))
     return fn(tuple(states))
 
 
@@ -207,7 +247,7 @@ def fused_ready_frontier_ell(states):
     vmaps ready_frontier_ell — bit-identical per store."""
     shapes = tuple(st.adj_idx.shape for st in states)
     key = ("ell", shapes)
-    fn = _FUSED_FRONT_CACHE.get(key)
+    fn = _fused_cache_get(key)
     if fn is None:
         n_max = max(s[0] for s in shapes)
         d_max = max(s[1] for s in shapes)
@@ -227,7 +267,7 @@ def fused_ready_frontier_ell(states):
                                       zip(*(pad(st) for st in sts))))
             return jax.vmap(ready_frontier_ell)(stacked)
 
-        fn = _FUSED_FRONT_CACHE[key] = jax.jit(traced)
+        fn = _fused_cache_put(key, jax.jit(traced))
     return fn(tuple(states))
 
 
@@ -261,3 +301,495 @@ def drain_ell(state: EllDrainState) -> Tuple[jnp.ndarray, jnp.ndarray]:
 def drain_ell_levels(state: EllDrainState):
     """Forensic variant of :func:`drain_ell`: (applied, newly, sweeps)."""
     return _drain_ell_fix(state)
+
+
+# -- log-depth drain (r19): level assignment by pointer jumping ---------------
+#
+# The fixpoint above pays one sweep per executeAt antichain — O(depth)
+# serial device launches' worth of latency folded into one while_loop, which
+# is exactly the serial-chain regime's loss (fixpoint_sweeps=4097 on the
+# 4096-deep bench chain).  The level pass below computes every slot's
+# execution round in O(log depth) rounds; the drain is then ONE masked
+# compare (``applied |= stable & level-finite`` — or ``level <= watermark``
+# for the prefix form the tick's wavefront uses).
+
+# INF must survive ``off + level`` in int32 without wrapping (2*INF < 2^31)
+# and ``level * n + j`` in int64 for the critical-parent argmax key
+LEVEL_INF = 1 << 28
+
+
+def _level_base(status, stable, applied0):
+    """Initial bounds: applied -> 0, stable -> 1 (every stable row runs at
+    round >= 1), anything else that can appear as a gating dep (undecided,
+    Committed-not-yet-Stable) -> INF: it never applies inside a static
+    drain, so rows waiting on it are blocked forever — the same gate
+    ``blocking_matrix`` / ``_ell_blocking`` already encode."""
+    return jnp.where(applied0, 0,
+                     jnp.where(stable, 1, LEVEL_INF)).astype(jnp.int32)
+
+
+def _level_loop(lv0, ptr0, off0, relax, n):
+    """The shared doubling loop: interleave one Bellman relax (``relax(lv)``
+    = 1 + max over blocking deps, representation-specific) with one pointer
+    jump along the critical-parent chain.  Both are monotone path-witnessed
+    lower bounds (a walk of ``off[i]`` blocking edges ends at ``ptr[i]``, and
+    each blocking edge adds >= 1 level), so any interleaving stays sound;
+    stationarity forces lv >= relax(lv), which pins lv to the unique DAG
+    fixpoint — the exact level.  Levels above ``n`` are clamped to INF: a
+    witness walk longer than the slot count must traverse a cycle (possible
+    only via awaits_all edges), and every row on or upstream of a blocking
+    cycle is blocked forever.  Returns (levels, rounds); rounds is bounded
+    by depth+2 in the worst case and ~2*log2(depth)+c when the jump chain
+    tracks the critical path (chains: always — the tie-break picks the
+    latest-executing parent)."""
+
+    def body(carry):
+        lv, ptr, off, _ch, r = carry
+        new = jnp.where(lv < LEVEL_INF, jnp.minimum(relax(lv), LEVEL_INF),
+                        lv)
+        new = jnp.maximum(new, lv)
+        # jump: level(i) >= off(i) + level(ptr(i)) along the witness walk
+        jumped = jnp.minimum(off + new[ptr], LEVEL_INF)
+        new = jnp.where(off > 0, jnp.maximum(new, jumped), new)
+        new = jnp.where(new > n, LEVEL_INF, new)
+        # double the walk: i -> ptr(i) -> ptr(ptr(i))
+        off = jnp.minimum(off + off[ptr], LEVEL_INF)
+        ptr = ptr[ptr]
+        return new, ptr, off, jnp.any(new != lv), r + 1
+
+    lv, _p, _o, _c, rounds = lax.while_loop(
+        lambda c: c[3] & (c[4] < jnp.int32(n + 3)), body,
+        (lv0, ptr0, off0, jnp.bool_(True), jnp.int32(0)))
+    return lv, rounds
+
+
+def _critical_ptr(lv0, blocking, j, stable, n):
+    """Each stable row's starting jump pointer: the blocking dep with the
+    highest (level, slot) key — the latest-executing parent, the chain
+    regime's critical parent.  Rows with no blocking dep (or not stable)
+    point at themselves with off=0, so their jumps are no-ops."""
+    rows = jnp.arange(n, dtype=jnp.int32)
+    key = jnp.where(blocking, lv0[j].astype(jnp.int64) * n + j, jnp.int64(-1))
+    best = jnp.argmax(key, axis=1)
+    pj = jnp.take_along_axis(j, best[:, None], axis=1)[:, 0].astype(jnp.int32)
+    has = jnp.any(blocking, axis=1)
+    ptr = jnp.where(has & stable, pj, rows)
+    off = jnp.where(ptr != rows, jnp.int32(1), jnp.int32(0))
+    return ptr, off
+
+
+def _ell_levels(state: EllDrainState):
+    blocking, j = _ell_blocking(state)
+    stable = state.status == SLOT_STABLE
+    applied0 = state.status == SLOT_APPLIED
+    n = state.status.shape[0]
+    lv0 = _level_base(state.status, stable, applied0)
+    ptr0, off0 = _critical_ptr(lv0, blocking, j, stable, n)
+
+    def relax(lv):
+        cand = 1 + jnp.max(jnp.where(blocking, lv[j], 0), axis=1)
+        return jnp.where(stable, cand, lv0)
+
+    return _level_loop(lv0, ptr0, off0, relax, n)
+
+
+@jax.jit
+def level_assign_ell(state: EllDrainState):
+    """(levels int32[N], rounds): each slot's execution round under the
+    static drain — 0 applied, 1..N the fixpoint sweep that would apply it,
+    LEVEL_INF blocked forever.  O(log depth) gather rounds on chains."""
+    return _ell_levels(state)
+
+
+def _dense_levels(state: DrainState):
+    blocking = blocking_matrix(state)
+    stable = state.status == SLOT_STABLE
+    applied0 = state.status == SLOT_APPLIED
+    n = state.status.shape[0]
+    lv0 = _level_base(state.status, stable, applied0)
+    j = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], (n, n))
+    ptr0, off0 = _critical_ptr(lv0, blocking, j, stable, n)
+
+    def relax(lv):
+        cand = 1 + jnp.max(jnp.where(blocking, lv[None, :], 0), axis=1)
+        return jnp.where(stable, cand, lv0)
+
+    return _level_loop(lv0, ptr0, off0, relax, n)
+
+
+@jax.jit
+def level_assign_dense(state: DrainState):
+    """Dense-state analogue of :func:`level_assign_ell` (one [N, N] masked
+    row-max per relax round instead of the gather)."""
+    return _dense_levels(state)
+
+
+def _levels_to_drain(state, lv):
+    stable = state.status == SLOT_STABLE
+    applied0 = state.status == SLOT_APPLIED
+    applied = applied0 | (stable & (lv < LEVEL_INF))
+    return applied, applied & ~applied0
+
+
+@jax.jit
+def _drain_ell_logdepth_full(state: EllDrainState):
+    lv, rounds = _ell_levels(state)
+    applied, newly = _levels_to_drain(state, lv)
+    depth = jnp.max(jnp.where(lv < LEVEL_INF, lv, 0))
+    return applied, newly, rounds, depth
+
+
+def drain_ell_logdepth(state: EllDrainState):
+    """Log-depth drain over the ELL adjacency: (applied, newly, rounds) —
+    byte-identical applied/newly to :func:`drain_ell_levels` (the fixpoint
+    is the standing oracle), with ``rounds`` ~ O(log depth) doubling rounds
+    in place of O(depth) sweeps."""
+    applied, newly, rounds, _depth = _drain_ell_logdepth_full(state)
+    return applied, newly, rounds
+
+
+@jax.jit
+def _drain_dense_logdepth_full(state: DrainState):
+    lv, rounds = _dense_levels(state)
+    applied, newly = _levels_to_drain(state, lv)
+    depth = jnp.max(jnp.where(lv < LEVEL_INF, lv, 0))
+    return applied, newly, rounds, depth
+
+
+def drain_logdepth(state: DrainState):
+    """Dense-state analogue of :func:`drain_ell_logdepth`."""
+    applied, newly, rounds, _depth = _drain_dense_logdepth_full(state)
+    return applied, newly, rounds
+
+
+@jax.jit
+def drain_ell_watermark(state: EllDrainState, watermark):
+    """The level-drain prefix form: apply every stable slot whose execution
+    round is <= ``watermark`` in ONE shot — byte-identical to running
+    exactly ``watermark`` fixpoint sweeps of :func:`_drain_ell_fix`.  The
+    tick's adaptive wavefront harvests candidates this way (watermark is
+    traced, so one compilation serves every W)."""
+    lv, _rounds = _ell_levels(state)
+    stable = state.status == SLOT_STABLE
+    applied0 = state.status == SLOT_APPLIED
+    applied = applied0 | (stable & (lv <= watermark))
+    return applied, applied & ~applied0
+
+
+@jax.jit
+def drain_dense_watermark(state: DrainState, watermark):
+    """Dense-state analogue of :func:`drain_ell_watermark`."""
+    lv, _rounds = _dense_levels(state)
+    stable = state.status == SLOT_STABLE
+    applied0 = state.status == SLOT_APPLIED
+    applied = applied0 | (stable & (lv <= watermark))
+    return applied, applied & ~applied0
+
+
+@jax.jit
+def drain_dense_logsq(state: DrainState):
+    """The dense log-depth form the ISSUE names: log-squaring of the
+    blocked-reachability semiring.  A stable row is blocked forever iff it
+    reaches — through stable intermediates along blocking edges — either a
+    dep that never applies (undecided / decided-not-stable) or a blocking
+    cycle (awaits_all).  Squaring the step matrix closes all path lengths in
+    O(log depth) bf16 [N, N] matmuls (MXU-shaped: this is the TPU-regime
+    variant; on CPU the cost model prices its N^3 squarings out in favor of
+    the ELL doubling pass).  Returns (applied, newly, squarings) with
+    applied/newly byte-identical to :func:`drain_levels`."""
+    blocking = blocking_matrix(state)
+    stable = state.status == SLOT_STABLE
+    applied0 = state.status == SLOT_APPLIED
+    bad = ~stable & ~applied0
+    # step edges continue only through stable deps; edges into applied deps
+    # are satisfied and edges into ``bad`` deps are terminal hits
+    step = blocking & stable[None, :]
+    hit = jnp.any(blocking & bad[None, :], axis=1)
+
+    def body(carry):
+        s, _ch, r = carry
+        s2 = ((s.astype(jnp.bfloat16) @ s.astype(jnp.bfloat16)) > 0.5) | s
+        return s2, jnp.any(s2 != s), r + 1
+
+    closure, _ch, squarings = lax.while_loop(
+        lambda c: c[1], body, (step, jnp.bool_(True), jnp.int32(0)))
+    on_cycle = jnp.diagonal(closure)        # i reaches i in >= 1 step
+    targets = (hit | on_cycle).astype(jnp.bfloat16)
+    blocked = hit | on_cycle | \
+        ((closure.astype(jnp.bfloat16) @ targets) > 0.5)
+    applied = applied0 | (stable & ~blocked)
+    return applied, applied & ~applied0, squarings
+
+
+@jax.jit
+def _dense_degree(adj):
+    return jnp.max(jnp.sum(adj, axis=1))
+
+
+def _pow2_deg(d: int) -> int:
+    out = 4
+    while out < d:
+        out *= 2
+    return out
+
+
+_DENSE_TO_ELL_CACHE = {}
+
+
+def dense_to_ell(state: DrainState,
+                 max_degree: Optional[int] = None) -> EllDrainState:
+    """Re-form a dense DrainState as the equivalent EllDrainState (same slot
+    indexing, same gating edges) so the doubling pass can run its [N, D]
+    gathers.  The scatter happens in-jit; only the max degree (one device
+    reduction) crosses the boundary.  Used by the dense ``drain_auto``
+    route — the serving tick never pays this, it builds ELL straight from
+    the host edge lists."""
+    if max_degree is None:
+        max_degree = int(_dense_degree(state.adj))
+    d = _pow2_deg(max(int(max_degree), 1))
+    n = state.status.shape[0]
+    key = (n, d)
+    fn = _DENSE_TO_ELL_CACHE.get(key)
+    if fn is None:
+        def convert(adj):
+            rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+            cols = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :],
+                                    (n, n))
+            slot = jnp.where(adj, jnp.cumsum(adj, axis=1) - 1, d)
+            flat = rows * (d + 1) + jnp.minimum(slot, d)
+            out = jnp.full(n * (d + 1), -1, jnp.int32)
+            out = out.at[flat.ravel()].max(cols.ravel())
+            return out.reshape(n, d + 1)[:, :d]
+
+        fn = _DENSE_TO_ELL_CACHE[key] = jax.jit(convert)
+    return EllDrainState(fn(state.adj), state.status, state.exec_msb,
+                         state.exec_lsb, state.exec_node, state.awaits_all)
+
+
+# -- routing: priced, never thresholds ---------------------------------------
+
+DRAIN_ENV = "ACCORD_TPU_DRAIN"
+
+
+def drain_logdepth_enabled() -> bool:
+    """The ``ACCORD_TPU_DRAIN=fixpoint`` escape hatch: when set, every
+    routed drain runs the fixpoint oracle (same contract as
+    ``ACCORD_TPU_FUSION=off``) — the log-depth kernels are a perf layer,
+    never load-bearing for correctness."""
+    return os.environ.get(DRAIN_ENV, "").strip().lower() not in (
+        "fixpoint", "fix", "off", "0", "false", "no")
+
+
+# process-wide probe coefficients (seconds per element); injectable via
+# set_drain_calibration for tests
+_DRAIN_CALIB = None
+
+# per-shape observed graph stats from prior routed calls: the depth a
+# fixpoint would pay and the rounds the doubling pass paid — the two
+# measured quantities the price comparison needs.  Keyed on the state
+# shape (the same key the jit cache uses), so steady-state workloads are
+# priced from their own history, not guesses.
+_ROUTE_STATS = {}
+
+# route counters for the ``# index:`` line / forensics
+_COUNTERS = {"drain_logdepth": 0, "drain_fixpoint": 0,
+             "drain_logdepth_failovers": 0, "fused_front_evictions": 0}
+
+
+def drain_counters() -> dict:
+    return dict(_COUNTERS)
+
+
+def reset_drain_routing() -> None:
+    """Test hook: forget learned per-shape stats and counters (calibration
+    is kept — reset it via set_drain_calibration)."""
+    _ROUTE_STATS.clear()
+    for k in _COUNTERS:
+        _COUNTERS[k] = 0
+
+
+def set_drain_calibration(c_sweep_ell: float, c_round_ell: float,
+                          c_sweep_dense: float, c_sq_dense: float,
+                          c_conv: float) -> None:
+    global _DRAIN_CALIB
+    _DRAIN_CALIB = {"c_sweep_ell": c_sweep_ell, "c_round_ell": c_round_ell,
+                    "c_sweep_dense": c_sweep_dense, "c_sq_dense": c_sq_dense,
+                    "c_conv": c_conv}
+
+
+def _probe_chain_ell(n: int, d: int = 4) -> EllDrainState:
+    import numpy as np
+    adj_idx = np.full((n, d), -1, np.int32)
+    adj_idx[1:, 0] = np.arange(n - 1, dtype=np.int32)
+    hlc = np.arange(2, n + 2, dtype=np.int64)
+    return EllDrainState(jnp.asarray(adj_idx),
+                         jnp.full(n, SLOT_STABLE, jnp.int32),
+                         jnp.asarray(hlc), jnp.zeros(n, jnp.int64),
+                         jnp.ones(n, jnp.int32), jnp.zeros(n, bool))
+
+
+def _probe_chain_dense(n: int) -> DrainState:
+    import numpy as np
+    adj = np.zeros((n, n), bool)
+    adj[np.arange(1, n), np.arange(n - 1)] = True
+    hlc = np.arange(2, n + 2, dtype=np.int64)
+    return DrainState(jnp.asarray(adj), jnp.full(n, SLOT_STABLE, jnp.int32),
+                      jnp.asarray(hlc), jnp.zeros(n, jnp.int64),
+                      jnp.ones(n, jnp.int32), jnp.zeros(n, bool))
+
+
+def _measure_drain_calibration() -> dict:
+    """The once-per-process micro-probe behind the drain route: times one
+    fixpoint sweep, one doubling round, one dense sweep, one dense squaring
+    and the dense->ELL re-form on small known-depth chains, and divides by
+    their element counts.  The crossover between fixpoint and doubling IS
+    these slopes — no depth threshold is written down anywhere."""
+    import statistics as _st
+    import time as _time
+
+    def timed(fn, reps=3):
+        fn()                                     # warm + compile
+        runs = []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            fn()
+            runs.append(_time.perf_counter() - t0)
+        return _st.median(runs)
+
+    import numpy as np
+    n, d = 256, 4
+    ell = _probe_chain_ell(n, d)
+    sweeps = int(np.asarray(drain_ell_levels(ell)[2]))
+    t_fix = timed(lambda: jax.block_until_ready(drain_ell_levels(ell)[0]))
+    c_sweep_ell = max(t_fix, 1e-9) / (sweeps * n * d)
+    rounds = int(np.asarray(_drain_ell_logdepth_full(ell)[2]))
+    t_dbl = timed(
+        lambda: jax.block_until_ready(_drain_ell_logdepth_full(ell)[0]))
+    c_round_ell = max(t_dbl, 1e-9) / (max(rounds, 1) * n * d)
+    dense = _probe_chain_dense(n)
+    sweeps_d = int(np.asarray(drain_levels(dense)[2]))
+    t_fixd = timed(lambda: jax.block_until_ready(drain_levels(dense)[0]))
+    c_sweep_dense = max(t_fixd, 1e-9) / (sweeps_d * n * n)
+    sq = int(np.asarray(drain_dense_logsq(dense)[2]))
+    t_sq = timed(
+        lambda: jax.block_until_ready(drain_dense_logsq(dense)[0]))
+    c_sq_dense = max(t_sq, 1e-9) / (max(sq, 1) * n * n * n)
+    t_conv = timed(
+        lambda: jax.block_until_ready(dense_to_ell(dense, 1).adj_idx))
+    c_conv = max(t_conv, 1e-9) / (n * n)
+    return {"c_sweep_ell": c_sweep_ell, "c_round_ell": c_round_ell,
+            "c_sweep_dense": c_sweep_dense, "c_sq_dense": c_sq_dense,
+            "c_conv": c_conv}
+
+
+def drain_calibration() -> dict:
+    global _DRAIN_CALIB
+    if _DRAIN_CALIB is None:
+        _DRAIN_CALIB = _measure_drain_calibration()
+    return _DRAIN_CALIB
+
+
+def _record_stats(key, depth: int, rounds: Optional[int]) -> None:
+    st = _ROUTE_STATS.setdefault(key, {})
+    st["depth"] = depth
+    if rounds is not None:
+        st["rounds"] = rounds
+
+
+def drain_ell_auto(state: EllDrainState):
+    """The routed ELL drain: (applied, newly, sweeps, route).  Prices the
+    doubling pass against the per-sweep fixpoint from the probe slopes and
+    this shape's observed depth/rounds; an unseen shape runs the doubling
+    pass first (worst case a small constant over the fixpoint, best case
+    exponentially cheaper) and the measurement itself becomes the price.
+    A device fault inside the log-depth launch fails the WHOLE flush over
+    to the fixpoint route — byte-identical results, one counter tick."""
+    import numpy as np
+    n, d = state.adj_idx.shape
+    key = ("ell", n, d)
+    route = "ell-logdepth"
+    if not drain_logdepth_enabled():
+        route = "ell-fixpoint"
+    else:
+        st = _ROUTE_STATS.get(key)
+        if st is not None and "rounds" in st:
+            cal = drain_calibration()
+            cost_fix = (st["depth"] + 1) * n * d * cal["c_sweep_ell"]
+            cost_dbl = (st["rounds"] + 1) * n * d * cal["c_round_ell"]
+            if cost_fix < cost_dbl:
+                route = "ell-fixpoint"
+    if route == "ell-logdepth":
+        try:
+            launch_check("drain logdepth")
+            applied, newly, rounds, depth = _drain_ell_logdepth_full(state)
+            faults.check("transfer", "drain logdepth download")
+            rounds = int(np.asarray(rounds))
+            _record_stats(key, int(np.asarray(depth)), rounds)
+            _COUNTERS["drain_logdepth"] += 1
+            return applied, newly, rounds, route
+        except faults.DEVICE_EXCEPTIONS:
+            _COUNTERS["drain_logdepth_failovers"] += 1
+            route = "ell-fixpoint-failover"
+    applied, newly, sweeps = drain_ell_levels(state)
+    sweeps = int(np.asarray(sweeps))
+    _record_stats(key, sweeps - 1, None)
+    _COUNTERS["drain_fixpoint"] += 1
+    return applied, newly, sweeps, route
+
+
+def drain_auto(state):
+    """The routed drain for either representation: (applied, newly, sweeps,
+    route).  Dense states price three ways — the dense fixpoint, the dense
+    reachability log-squaring (MXU-shaped), and re-forming to ELL for the
+    doubling pass — against this shape's observed depth; ELL states route
+    via :func:`drain_ell_auto`."""
+    import numpy as np
+    if isinstance(state, EllDrainState):
+        return drain_ell_auto(state)
+    n = state.status.shape[0]
+    key = ("dense", n)
+    route = "dense-to-ell-logdepth"
+    if not drain_logdepth_enabled():
+        route = "dense-fixpoint"
+    else:
+        st = _ROUTE_STATS.get(key)
+        if st is not None and "rounds" in st:
+            cal = drain_calibration()
+            d = st.get("ell_d", 4)
+            cost_fix = (st["depth"] + 1) * n * n * cal["c_sweep_dense"]
+            sq = max(int(st["depth"]).bit_length() + 1, 2)
+            cost_sq = sq * n * n * n * cal["c_sq_dense"]
+            cost_dbl = n * n * cal["c_conv"] + \
+                (st["rounds"] + 1) * n * d * cal["c_round_ell"]
+            costs = {"dense-fixpoint": cost_fix, "dense-logsq": cost_sq,
+                     "dense-to-ell-logdepth": cost_dbl}
+            route = min(costs, key=costs.get)
+    if route == "dense-to-ell-logdepth":
+        try:
+            launch_check("drain logdepth")
+            ell = dense_to_ell(state)
+            applied, newly, rounds, depth = _drain_ell_logdepth_full(ell)
+            faults.check("transfer", "drain logdepth download")
+            rounds = int(np.asarray(rounds))
+            _record_stats(key, int(np.asarray(depth)), rounds)
+            _ROUTE_STATS[key]["ell_d"] = ell.adj_idx.shape[1]
+            _COUNTERS["drain_logdepth"] += 1
+            return applied, newly, rounds, route
+        except faults.DEVICE_EXCEPTIONS:
+            _COUNTERS["drain_logdepth_failovers"] += 1
+            route = "dense-fixpoint-failover"
+    if route == "dense-logsq":
+        try:
+            launch_check("drain logsq")
+            applied, newly, sq = drain_dense_logsq(state)
+            faults.check("transfer", "drain logsq download")
+            _COUNTERS["drain_logdepth"] += 1
+            return applied, newly, int(np.asarray(sq)), route
+        except faults.DEVICE_EXCEPTIONS:
+            _COUNTERS["drain_logdepth_failovers"] += 1
+            route = "dense-fixpoint-failover"
+    applied, newly, sweeps = drain_levels(state)
+    sweeps = int(np.asarray(sweeps))
+    _record_stats(key, sweeps - 1, None)
+    _COUNTERS["drain_fixpoint"] += 1
+    return applied, newly, sweeps, route

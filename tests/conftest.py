@@ -45,6 +45,17 @@ def pytest_configure(config):
         from accord_tpu.local.fastpath import proto_fastpath_enabled
         assert not proto_fastpath_enabled(), \
             "ACCORD_TPU_PROTO_FASTPATH=off set but proto_fastpath_enabled()"
+    # ACCORD_TPU_DRAIN=fixpoint canary (r19, same contract as the fusion
+    # knob): with the escape hatch set every routed drain must run the
+    # fixpoint oracle (no log-depth kernel, no widened tick wavefront) and
+    # tier-1 must stay green — the log-depth drain is a perf layer, never
+    # load-bearing for correctness.
+    if os.environ.get("ACCORD_TPU_DRAIN", "").lower() in ("fixpoint", "fix",
+                                                          "off", "0",
+                                                          "false", "no"):
+        from accord_tpu.ops.drain_kernel import drain_logdepth_enabled
+        assert not drain_logdepth_enabled(), \
+            "ACCORD_TPU_DRAIN=fixpoint set but drain_logdepth_enabled()"
     # ACCORD_TPU_OBS=off canary (r09, same contract as the fusion knob):
     # with the escape hatch set the obs subsystem must actually stand down
     # (no span recording, no device profiler) and tier-1 must stay green —

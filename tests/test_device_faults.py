@@ -430,3 +430,86 @@ def test_attr_quarantine_recovers_and_serves_device_again():
     before = dev.n_fallback_queries
     assert _attr_blocks(dev, safe, qs) == expect     # healthy again
     assert dev.n_fallback_queries == before
+
+
+# ---------------------------------------------------------------------------
+# r19 log-depth drain x the fault ladder: a fault inside the routed
+# log-depth launch fails the WHOLE flush over to the fixpoint route,
+# byte-identically — the fixpoint is both the oracle and the failover
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("kind", RAISING)
+def test_logdepth_drain_fault_fails_over_to_fixpoint(kind, monkeypatch):
+    from accord_tpu.ops import drain_kernel as drk
+
+    # the machinery under test IS the log-depth route: force the escape
+    # hatch open even under the ACCORD_TPU_DRAIN=fixpoint canary run
+    monkeypatch.delenv("ACCORD_TPU_DRAIN", raising=False)
+    drk.reset_drain_routing()
+    try:
+        ell = drk._probe_chain_ell(96)
+        dense = drk._probe_chain_dense(96)
+        exp_a, exp_n, _ = drk.drain_ell_levels(ell)
+        with faults.device_fault(kind, 1.0, _rng()):
+            a, nw, sweeps, route = drk.drain_ell_auto(ell)
+            assert route == "ell-fixpoint-failover"
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(exp_a))
+            np.testing.assert_array_equal(np.asarray(nw), np.asarray(exp_n))
+            a2, _nw2, _s2, route2 = drk.drain_auto(dense)
+            assert route2 == "dense-fixpoint-failover"
+            np.testing.assert_array_equal(np.asarray(a2), np.asarray(exp_a))
+        got = drk.drain_counters()
+        assert got["drain_logdepth_failovers"] == 2
+        assert got["drain_fixpoint"] == 2 and got["drain_logdepth"] == 0
+        # fault cleared: the next routed call runs the log-depth pass again
+        a3, _nw3, rounds, route3 = drk.drain_ell_auto(ell)
+        assert route3 == "ell-logdepth" and rounds < 30
+        np.testing.assert_array_equal(np.asarray(a3), np.asarray(exp_a))
+    finally:
+        drk.reset_drain_routing()
+
+
+def test_wavefront_tick_fault_falls_back_to_frontier_sweep(monkeypatch):
+    """A widened-wavefront tick (W > 1) that faults at the device boundary
+    resets W to 1 and serves the tick through the ordinary frontier ladder
+    (the host fallback) — same candidates, no lost wakeup."""
+    from accord_tpu.ops import deps_kernel as dk
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    # wavefront widening requires the log-depth hatch open; pin it so
+    # the test still tests under the ACCORD_TPU_DRAIN=fixpoint canary
+    monkeypatch.delenv("ACCORD_TPU_DRAIN", raising=False)
+
+    store, dev, safe = make_device_state(mesh=None)
+
+    class _NoCommandsSafe:
+        """Every kernel-proposed candidate re-validates against the host
+        command records; absent records degrade to a no-op."""
+        store = safe.store
+
+        @staticmethod
+        def if_present(_txn_id):
+            return None
+
+    ids = [TxnId.create(1, 100 + i, TxnKind.Write, Domain.Key, 1)
+           for i in range(6)]
+    slots = [dev.drain.alloc(t) for t in ids]
+    for a, b in zip(slots[1:], slots):
+        dev.drain.add_edge(a, b)
+    for t, s in zip(ids, slots):
+        dev.drain.set_status(s, dk.SLOT_STABLE, t)
+        dev.drain.active[s] = True
+    dev._drain_wavefront = 4
+    with faults.device_fault("kernel_launch", 1.0, _rng()):
+        dev._tick(_NoCommandsSafe())
+    assert dev._drain_wavefront == 1        # reset on the faulted tick
+    assert dev.n_host_ticks >= 1            # ladder served the candidates
+    assert dev.n_device_faults >= 1
+    # healthy W>1 tick on a quarantine-free mirror runs the level kernel
+    dev2_store, dev2, safe2 = make_device_state(mesh=None)
+    for t, s in zip(ids, [dev2.drain.alloc(t) for t in ids]):
+        dev2.drain.set_status(s, dk.SLOT_STABLE, t)
+        dev2.drain.active[s] = True
+    dev2._drain_wavefront = 4
+    dev2._tick(_NoCommandsSafe())
+    assert dev2.n_wavefront_ticks == 1
+    assert dev2.n_host_ticks == 0

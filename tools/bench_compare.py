@@ -260,6 +260,16 @@ def main(argv=None):
             failures.append(check(f"{m}.vs_baseline",
                                   o["vs_baseline"], n["vs_baseline"],
                                   args.threshold))
+        # r19: device sweep/round counts gate lower-is-better — the
+        # log-depth drain's whole point is this number collapsing from
+        # O(depth) to O(log depth); it must never creep back up
+        if o.get("fixpoint_sweeps") is not None \
+                and n.get("fixpoint_sweeps") is not None:
+            failures.append(check(f"{m}.fixpoint_sweeps",
+                                  o["fixpoint_sweeps"],
+                                  n["fixpoint_sweeps"],
+                                  args.latency_threshold,
+                                  lower_is_better=True))
         # r09 observability fields (phase p99s lower-better, fast-path
         # rate higher-better), gated at 2x threshold: the histograms are
         # log-bucketed, so single-bucket jitter is expected

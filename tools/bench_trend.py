@@ -78,6 +78,13 @@ INDEX_GATED = {
     "bootstrap_bytes_rx": None,
     "bootstrap_wall_ms": None,
     "handoff_ranges": None,
+    # r19 drain-route counters: INFO-ONLY — the logdepth/fixpoint split is
+    # workload-shape dependent by design (routing, never thresholds); the
+    # gated signal is each drain row's fixpoint_sweeps series below
+    "drain_logdepth": None,
+    "drain_fixpoint": None,
+    "drain_logdepth_failovers": None,
+    "fused_front_evictions": None,
 }
 
 
@@ -121,6 +128,12 @@ def load_series(rounds):
                          else "down" if latency else "up")
             add(m, rnd, row.get("value"), direction)
             add(f"{m}.vs_baseline", rnd, row.get("vs_baseline"), "up")
+            # r19: device sweep/round counts gate lower-is-better across
+            # the WHOLE history (safe: the series is constant 634/4097
+            # from r11 through r18 — the r19 log-depth kernels are the
+            # first change, and it must only ever move DOWN from here)
+            add(f"{m}.fixpoint_sweeps", rnd, row.get("fixpoint_sweeps"),
+                "down")
             add(f"{m}.fast_path_rate", rnd, row.get("fast_path_rate"), "up")
             for ph, pd in (row.get("phases_ms") or {}).items():
                 add(f"{m}.phase[{ph}].p50_ms", rnd, pd.get("p50_ms"), "down")

@@ -22,6 +22,13 @@ profile_hot / profile_hot2) this repo accreted across r04-r06.
         the r09 acceptance artifact that makes the r08 win visible as a
         timeline, not just a counter.
 
+    python tools/profile.py drain [--n 100000]
+        The r19 drain-route view: dense/ELL fixpoint vs the log-depth
+        doubling kernels side by side across chain depths, with the
+        MEASURED fixpoint/doubling crossover printed next to the one the
+        route model PRICES from its micro-probe slopes (plus a byte-
+        equality spot check at every depth — the fixpoint is the oracle).
+
     python tools/profile.py serve [--nodes 3] [--duration 6] [--top 30]
         The r18 serving-path hunt: spawn the real TCP cluster under
         ``ACCORD_TPU_NODE_PROFILE``, drive it to closed-loop saturation,
@@ -350,10 +357,77 @@ def mode_serve(args):
                        "protocol_ms_per_txn", "prof_dir")}))
 
 
+def mode_drain(args):
+    """r19 drain-route forensics: dense/ELL fixpoint vs the log-depth
+    doubling kernels side by side at several chain depths, printing the
+    MEASURED crossover next to the one the route model PRICES from its
+    micro-probe — the two must broadly agree or the cost model is lying."""
+    import jax
+    import jax.numpy as jnp
+
+    from accord_tpu.ops import drain_kernel as drk
+    from accord_tpu.ops.deps_kernel import SLOT_STABLE
+
+    depths = [64, 256, 1024, 4096] if args.n >= 100_000 else [64, args.n]
+    cal = phase("route micro-probe", drk.drain_calibration, reps=1)
+    print("probe slopes (s/elem): "
+          + " ".join(f"{k}={v:.3e}" for k, v in cal.items()),
+          file=sys.stderr)
+    print(f"{'depth':>6s} {'ell_fix_ms':>11s} {'ell_dbl_ms':>11s} "
+          f"{'dense_fix_ms':>13s} {'dense_sq_ms':>12s} "
+          f"{'sweeps':>7s} {'rounds':>7s} {'measured':>9s} {'priced':>9s}",
+          file=sys.stderr)
+    measured_x, priced_x = None, None
+    for n in depths:
+        ell = drk._probe_chain_ell(n)
+        dense = drk._probe_chain_dense(n)
+
+        def t(fn, reps=3):
+            fn()
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                ts.append(time.perf_counter() - t0)
+            return min(ts) * 1e3
+
+        t_ef = t(lambda: drk.drain_ell_levels(ell)[0])
+        t_ed = t(lambda: drk.drain_ell_logdepth(ell)[0])
+        t_df = t(lambda: drk.drain_levels(dense)[0])
+        t_ds = t(lambda: drk.drain_dense_logsq(dense)[0])
+        sweeps = int(np.asarray(drk.drain_ell_levels(ell)[2]))
+        rounds = int(np.asarray(drk.drain_ell_logdepth(ell)[2]))
+        d = ell.adj_idx.shape[1]
+        cost_fix = sweeps * n * d * cal["c_sweep_ell"] * 1e3
+        cost_dbl = rounds * n * d * cal["c_round_ell"] * 1e3
+        measured = "doubling" if t_ed < t_ef else "fixpoint"
+        priced = "doubling" if cost_dbl < cost_fix else "fixpoint"
+        if measured == "doubling" and measured_x is None:
+            measured_x = n
+        if priced == "doubling" and priced_x is None:
+            priced_x = n
+        print(f"{n:6d} {t_ef:11.2f} {t_ed:11.2f} {t_df:13.2f} "
+              f"{t_ds:12.2f} {sweeps:7d} {rounds:7d} {measured:>9s} "
+              f"{priced:>9s}", file=sys.stderr)
+        # byte-equality spot check at every depth — the fixpoint is the
+        # standing oracle, a profiler run is a free extra witness
+        af, nf, _ = drk.drain_ell_levels(ell)
+        ad, nd, _ = drk.drain_ell_logdepth(ell)
+        assert bool((af == ad).all() and (nf == nd).all()), \
+            f"logdepth/fixpoint divergence at depth {n}"
+    print(f"measured crossover: doubling wins from depth "
+          f"{measured_x or '>max'}; priced crossover: depth "
+          f"{priced_x or '>max'}", file=sys.stderr)
+    print(json.dumps({"measured_crossover": measured_x,
+                      "priced_crossover": priced_x,
+                      "calibration": cal}))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("mode",
-                   choices=["headline", "attr", "hot", "launches", "serve"])
+                   choices=["headline", "attr", "hot", "launches", "serve",
+                            "drain"])
     p.add_argument("--n", type=int, default=100_000,
                    help="in-flight txns for headline/attr store")
     p.add_argument("--batch", type=int, default=2048)
@@ -374,7 +448,7 @@ def main(argv=None):
     args = p.parse_args(argv)
     {"headline": mode_headline, "attr": mode_attr,
      "hot": mode_hot, "launches": mode_launches,
-     "serve": mode_serve}[args.mode](args)
+     "serve": mode_serve, "drain": mode_drain}[args.mode](args)
 
 
 if __name__ == "__main__":

@@ -309,13 +309,42 @@ for seed in SEEDS:
             line += f"  [post-mortem: {path}]"
         print(line, flush=True)
 
+# r19: the log-depth drain-route leg — a fault inside the routed
+# log-depth launch must fail the WHOLE flush over to the fixpoint route
+# byte-identically (the fixpoint is both the oracle and the failover)
+import numpy as np
+
+from accord_tpu.ops import drain_kernel as drk
+from accord_tpu.utils import faults as _faults
+from accord_tpu.utils.random_source import RandomSource
+
+drk.reset_drain_routing()
+for seed in SEEDS:
+    chain = drk._probe_chain_ell(64 + seed)
+    exp_a, exp_n, _ = drk.drain_ell_levels(chain)
+    for kind in ("kernel_launch", "transfer"):
+        drk.reset_drain_routing()
+        with _faults.device_fault(kind, 1.0, RandomSource(seed)):
+            a, nw, _s, route = drk.drain_ell_auto(chain)
+        ok = (route == "ell-fixpoint-failover"
+              and np.array_equal(np.asarray(a), np.asarray(exp_a))
+              and np.array_equal(np.asarray(nw), np.asarray(exp_n)))
+        print(f"seed {seed} drain-route {kind:>13}: route={route} "
+              f"byte_equal={ok}", flush=True)
+        if not ok:
+            failures.append(
+                f"seed {seed} drain-route {kind}: route={route}, "
+                "failover not byte-identical to fixpoint")
+drk.reset_drain_routing()
+
 if failures:
     print("\nFAULT MATRIX FAILED:")
     for f in failures:
         print("  " + f)
     sys.exit(1)
 print("\nfault matrix clean: every class x seed deterministic and "
-      "byte-equivalent to the fault-free baseline")
+      "byte-equivalent to the fault-free baseline (incl. the r19 "
+      "log-depth drain failover leg)")
 PY
 
 net_rc=0
