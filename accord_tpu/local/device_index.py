@@ -124,37 +124,11 @@ def _pow2_at_least(n: int, floor: int = _MIN_INTERVALS) -> int:
     return out
 
 
-@jax.jit
-def _scatter_rows(table: dk.DepsTable, idx, msb, lsb, node, kind, status,
-                  lo, hi) -> dk.DepsTable:
-    """One fused dirty-row update for all seven table arrays (a single jit
-    dispatch instead of seven eager scatters — the update-in-place path that
-    keeps the table device-resident between queries)."""
-    return dk.DepsTable(
-        table.msb.at[idx].set(msb),
-        table.lsb.at[idx].set(lsb),
-        table.node.at[idx].set(node),
-        table.kind.at[idx].set(kind),
-        table.status.at[idx].set(status),
-        table.lo.at[idx].set(lo),
-        table.hi.at[idx].set(hi))
-
-
-@jax.jit
-def _scatter_attr_rows(attr, idx, dom, status, dmsb, dlsb, dnode, emsb,
-                       elsb, enode, eknown):
-    """One fused dirty-row update for the attribution columns (the
-    AttrCols sibling of _scatter_rows)."""
-    return dk.AttrCols(
-        attr.dom.at[idx].set(dom),
-        attr.status.at[idx].set(status),
-        attr.dmsb.at[idx].set(dmsb),
-        attr.dlsb.at[idx].set(dlsb),
-        attr.dnode.at[idx].set(dnode),
-        attr.emsb.at[idx].set(emsb),
-        attr.elsb.at[idx].set(elsb),
-        attr.enode.at[idx].set(enode),
-        attr.eknown.at[idx].set(eknown))
+# the fused dirty-row scatter jits moved to ops.deps_kernel (r21): the
+# per-slice store-shard sync dispatches the same programs once per slice
+# device, so one implementation serves both residencies
+_scatter_rows = dk.scatter_table_rows
+_scatter_attr_rows = dk.scatter_attr_cols
 
 
 _PZ = None
@@ -328,6 +302,14 @@ class _DepsMirror:
         self.free_slots: List[int] = list(range(capacity - 1, -1, -1))
         self._dirty: Set[int] = set()
         self._device: Optional[dk.DepsTable] = None
+        # r21 store-shard residency (parallel.store_shard.StoreShards, set
+        # by the owner's spill rung): while active, the sharded table /
+        # attr uploads route through per-slice resident buffers with their
+        # OWN dirty sets — device_table() consumes and clears ``_dirty``,
+        # so the sliced consumer must not share it
+        self.shards = None
+        self._dirty_sh: Set[int] = set()
+        self._attr_dirty_sh: Set[int] = set()
         # mesh-sharded slot-table copy, cached SEPARATELY from the
         # single-device one (r08 satellite: the router alternating
         # single-device and mesh routes between flushes used to clobber
@@ -633,6 +615,8 @@ class _DepsMirror:
         self.lo[slot] = dk.PAD_LO
         self.hi[slot] = dk.PAD_HI
         self._dirty.add(slot)
+        if self.shards is not None:
+            self._dirty_sh.add(slot)
         self._mark_attr(slot)
         self.version += 1
         self.mut_version += 1
@@ -654,6 +638,8 @@ class _DepsMirror:
         self.hi[slot] = dk.PAD_HI
         self.free_slots.append(slot)
         self._dirty.add(slot)
+        if self.shards is not None:
+            self._dirty_sh.add(slot)
         self._mark_attr(slot)
         self.version += 1
         self.mut_version += 1
@@ -726,6 +712,8 @@ class _DepsMirror:
             row_lo[used] = lo_v
             row_hi[used] = hi_v
             self._dirty.add(slot)
+            if self.shards is not None:
+                self._dirty_sh.add(slot)
             self.version += 1
             self.mut_version += 1
             self._bucket_add(slot, lo_v, hi_v, used)
@@ -745,12 +733,16 @@ class _DepsMirror:
                 self.version += 1
             self.status[slot] = status
             self._dirty.add(slot)
+            if self.shards is not None:
+                self._dirty_sh.add(slot)
             self._mark_attr(slot)
             self.mut_version += 1
 
     # -- device attribution columns (r15) -----------------------------------
     def _mark_attr(self, slot: int) -> None:
         self._attr_dirty.add(slot)
+        if self.shards is not None:
+            self._attr_dirty_sh.add(slot)
         self.attr_version += 1
 
     def mark_exec(self, slot: int) -> None:
@@ -758,6 +750,8 @@ class _DepsMirror:
         by DeviceState._advance_status): the device attribution columns
         must see it before the next attributed launch."""
         self._attr_dirty.add(slot)
+        if self.shards is not None:
+            self._attr_dirty_sh.add(slot)
         self.attr_version += 1
         self.mut_version += 1   # snapshot columns changed too
 
@@ -814,6 +808,8 @@ class _DepsMirror:
         kernel (each shard grades only its own slice), keyed on
         attr_version (NOT ``version``: elision observes live->live status
         moves and executeAt writes the dep mask never reads)."""
+        if self.shards is not None and self.shards.active:
+            return self.shards.attr_cols()
         key = (self.attr_version, self.capacity,
                tuple(dev.id for dev in mesh.devices.flat))
         if self._attr_sh is not None and self._attr_sh_key == key:
@@ -1031,6 +1027,11 @@ class _DepsMirror:
         shard the scatter too).  Live->live status moves don't bump the
         version: the dep mask reads only liveness from the status column,
         so a stale live status byte cannot change any answer."""
+        if self.shards is not None and self.shards.active:
+            # r21 sliced residency: per-slice scatter sync + zero-copy
+            # assembly (with quarantined slices' status masked) replaces
+            # the monolithic full re-upload
+            return self.shards.table()
         key = (self.version, self.capacity, self.max_intervals,
                tuple(dev.id for dev in mesh.devices.flat))
         if self._device_sh is not None and self._device_sh_key == key:
@@ -1791,6 +1792,20 @@ class DeviceState:
         self.n_compacted_slots = 0
         self.n_oom_degraded = 0
         self.n_host_ticks = 0          # drain ticks swept on host fallback
+        # r21 store-sharded residency (parallel.store_shard): the spill
+        # rung's StoreShards instance (None until the ladder activates it),
+        # flush/byte counters, the per-slice quarantine tallies, and the
+        # host-pin recovery state — ``_pin_recheck`` pinned flushes between
+        # compaction-and-re-probe attempts (doubling to a cap, the same
+        # backoff shape the quarantine ladder uses)
+        self.store_shards = None
+        self.n_store_sharded_flushes = 0
+        self.n_slice_quarantines = 0
+        self.n_slice_restores = 0
+        self.n_shard_merge_bytes = 0
+        self.n_oom_recovered = 0
+        self._pin_flushes = 0
+        self._pin_recheck = 64
         # r19 adaptive drain wavefront: W=1 ticks run the plain frontier
         # sweep (byte-identical to pre-r19 behavior); W grows x2 only when
         # a tick's ENTIRE candidate set synchronously reached Applied (the
@@ -1894,14 +1909,26 @@ class DeviceState:
         if obs is not None:
             obs(self.store, event, detail)
 
-    def _device_fault(self, exc_or_kind, detail: str = "") -> None:
+    def _device_fault(self, exc_or_kind, detail: str = "",
+                      sliced: bool = False) -> None:
         """Record one device-boundary failure and quarantine the device
         routes: exponential backoff in FLUSHES (deterministic per-store
-        jitter so co-faulted stores don't re-probe in lockstep)."""
+        jitter so co-faulted stores don't re-probe in lockstep).
+
+        ``sliced=True`` (the flush dispatch/collect call sites) composes
+        the ladder per slice when the store-sharded residency is active:
+        the failure quarantines the SLICE it touched — its slots answer
+        from the host twin while healthy slices stay on device — instead
+        of the whole node.  Drain-tick faults keep the whole-device
+        quarantine (the drain state is not sliced)."""
         kind = exc_or_kind if isinstance(exc_or_kind, str) \
             else faults.kind_of(exc_or_kind)
         self.n_device_faults += 1
         self._fault_event("fault." + kind, detail)
+        sh = self.store_shards
+        if sliced and sh is not None and sh.active:
+            sh.slice_fault(kind, detail)
+            return
         self.n_quarantines += 1
         self._dev_backoff = min(self._dev_backoff + 1, 8)
         base = min(self._BACKOFF_BASE << (self._dev_backoff - 1),
@@ -1927,6 +1954,16 @@ class DeviceState:
         quarantine probe (the caller records the probe only if it actually
         takes a device route)."""
         if self.host_pinned:
+            # r21: the OOM degrade is no longer terminal — every
+            # _pin_recheck pinned flushes, compact and re-check whether
+            # the table fits the device (or the sharded mesh) again; on
+            # success the NEXT flush is the recovery probe
+            self._pin_flushes += 1
+            if self._pin_flushes >= self._pin_recheck:
+                self._pin_flushes = 0
+                self._pin_recheck = min(self._pin_recheck * 2, 1024)
+                if self._try_oom_recover():
+                    return None, True
             self.n_fallback_queries += nq
             return "host-pinned", False
         if self._dev_quar_flushes > 0:
@@ -1938,13 +1975,22 @@ class DeviceState:
     def _approve_grow(self, mirror: _DepsMirror) -> bool:
         """HBM capacity backpressure: called by _DepsMirror._grow_capacity
         before doubling.  True = grow as usual; False = compaction made
-        room under the budget (free_slots is non-empty), don't grow.  When
-        compaction cannot make room the store degrades PINNED-TO-HOST
-        (loud one-shot event) and the HOST arrays still grow — the
-        protocol stays live, the device stops receiving uploads."""
+        room under the budget (free_slots is non-empty), don't grow.
+
+        The r21 ladder: breach -> compact -> SPILL TO SHARDED (when a mesh
+        is available and the grown table fits d x the per-chip budget —
+        one store's slots split across d devices) -> host-pinned.  When
+        every rung fails the store degrades PINNED-TO-HOST (loud one-shot
+        event) and the HOST arrays still grow — the protocol stays live,
+        the device stops receiving uploads."""
         new = mirror.capacity * 2
-        breach = (self.device_budget_slots is not None
-                  and new > self.device_budget_slots)
+        budget = self.device_budget_slots
+        sh = self.store_shards
+        sharded = sh is not None and sh.active
+        # while sharded, the effective budget is the MESH's: d slices
+        eff = None if budget is None else (budget * sh.d if sharded
+                                           else budget)
+        breach = eff is not None and new > eff
         if not breach and faults.should_fire("hbm_oom"):
             self.n_device_faults += 1
             self._fault_event("fault.hbm_oom", f"grow to {new}")
@@ -1958,12 +2004,58 @@ class DeviceState:
                           f"freed={freed} capacity={mirror.capacity}")
         if mirror.free_slots:
             return False
+        if not self.host_pinned and not sharded and self.mesh is not None:
+            from ..parallel.store_shard import store_shard_enabled
+            d = max(len(self.mesh.devices.flat), 1)
+            if store_shard_enabled() and (budget is None
+                                          or new <= budget * d):
+                self._activate_store_shards(f"capacity={mirror.capacity}"
+                                            f" -> {new}")
+                return True
         if not self.host_pinned:
             # the one-shot loud degrade: host route only from here on
             self.host_pinned = True
             self.n_oom_degraded += 1
             self._fault_event("oom.degrade",
                               f"capacity={mirror.capacity} -> {new}")
+        return True
+
+    def _activate_store_shards(self, detail: str = "") -> None:
+        """Turn on the r21 sliced residency for this store (the spill rung
+        and the sharded leg of OOM recovery): from here the sharded table
+        and attr uploads route through per-slice resident buffers."""
+        if self.store_shards is None:
+            from ..parallel.store_shard import StoreShards
+            self.store_shards = StoreShards(self, self.deps, self.mesh)
+        self.store_shards.activate()
+        self._fault_event("oom.spill", detail)
+
+    def _try_oom_recover(self) -> bool:
+        """Un-terminal the OOM degrade (r21): compact, then re-check the
+        budget — a raised budget (or a mesh whose d slices now cover the
+        table) lets a host-pinned store re-probe the device route.  Loud
+        one-shot recovery, counted in ``oom_recovered``; mirrors the
+        quarantine -> probe -> restore cycle of the device ladder."""
+        mirror = self.deps
+        freed = self._compact_below_floor()
+        if freed:
+            self.n_compactions += 1
+            self.n_compacted_slots += freed
+            self._fault_event("oom.compact",
+                              f"freed={freed} capacity={mirror.capacity}")
+        budget = self.device_budget_slots
+        cap = mirror.capacity
+        if budget is not None and cap > budget:
+            from ..parallel.store_shard import store_shard_enabled
+            sh_ok = (self.mesh is not None and store_shard_enabled())
+            d = max(len(self.mesh.devices.flat), 1) if sh_ok else 1
+            if not sh_ok or cap > budget * d:
+                return False
+            self._activate_store_shards(f"recover capacity={cap}")
+        self.host_pinned = False
+        self.n_oom_recovered += 1
+        self._fault_event("oom.recover",
+                          f"capacity={cap} budget={budget}")
         return True
 
     def _compact_below_floor(self) -> int:
@@ -2354,10 +2446,12 @@ class DeviceState:
                               c_dev: float,
                               rtt_mesh: Optional[float] = None,
                               c_xfer: float = 0.0,
-                              c_attr: float = 0.0) -> None:
+                              c_attr: float = 0.0,
+                              c_shard: float = 0.0) -> None:
         cls._CALIB = {"rtt": rtt, "c_host": c_host, "c_dev": c_dev,
                       "rtt_mesh": rtt_mesh if rtt_mesh is not None else rtt,
-                      "c_xfer": c_xfer, "c_attr": c_attr}
+                      "c_xfer": c_xfer, "c_attr": c_attr,
+                      "c_shard": c_shard}
 
     @staticmethod
     def _measure_route_calibration():
@@ -2499,12 +2593,51 @@ class DeviceState:
             rtts.append(_time.perf_counter() - t0)
         return _st.median(rtts)
 
+    @staticmethod
+    def _measure_shard_coeff(mesh) -> float:
+        """Per-element cost of the cross-slice merge collective the
+        sharded-store route adds (all-gather + replicated-block shuffle):
+        an A/B slope over two buffer sizes, so the fixed launch overhead
+        cancels and what remains is the collective's marginal cost.  A
+        COEFFICIENT, never a device-count threshold — the router prices
+        the sharded route with it like every other term."""
+        import statistics as _st
+        import time as _time
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.sharded import STORE_AXIS, _shard_map
+        d = int(np.prod(list(mesh.shape.values())))
+
+        def timed(n):
+            arr = jax.device_put(np.zeros(n * d, np.int64),
+                                 NamedSharding(mesh, P(STORE_AXIS)))
+
+            def body(a):
+                g = jax.lax.all_gather(a, STORE_AXIS, tiled=True)
+                return jnp.sort(g)
+
+            fn = jax.jit(_shard_map(body, mesh, (P(STORE_AXIS),),
+                                    P(STORE_AXIS)))
+            np.asarray(fn(arr))                  # warm + compile
+            runs = []
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                np.asarray(fn(arr))
+                runs.append(_time.perf_counter() - t0)
+            return _st.median(runs)
+
+        n1, n2 = 1024, 8192
+        t1, t2 = timed(n1), timed(n2)
+        return max(t2 - t1, 0.0) / ((n2 - n1) * d) + 1e-12
+
     def _calibration(self):
         if DeviceState._CALIB is None:
             DeviceState._CALIB = self._measure_route_calibration()
         calib = DeviceState._CALIB
         if self.mesh is not None and "rtt_mesh" not in calib:
             calib["rtt_mesh"] = self._measure_mesh_rtt(self.mesh)
+        if self.mesh is not None and "c_shard" not in calib:
+            calib["c_shard"] = self._measure_shard_coeff(self.mesh)
         return calib
 
     def _choose_route(self, qnp: np.ndarray, q_m: int, floor_id) -> str:
@@ -2569,6 +2702,11 @@ class DeviceState:
         s_attr = min(self._batch_flat, dev_elems)
         dev_cost = 2.0 * rtt + calib["c_dev"] * dev_elems \
             + calib.get("c_attr", 0.0) * s_attr
+        if d > 1:
+            # the mesh routes pay the cross-slice merge collective over
+            # the (up to) d x s merged entry block — priced from its own
+            # A/B micro-probe slope (r21), never a device-count threshold
+            dev_cost += calib.get("c_shard", 0.0) * d * s_attr
         return "host" if host_cost < dev_cost else "device"
 
     def _batch_floor(self, qnp: np.ndarray, q_m: int):
@@ -2793,6 +2931,22 @@ class DeviceState:
                 self.n_host_queries += len(rows)
                 self.n_dispatches += 1
                 self._ktime("dispatch_host", _t0)
+                return
+            if kind == "host_slice":
+                # r21 hybrid twin part: while slices are quarantined the
+                # assembled sharded table masks their slots to SLOT_FREE,
+                # and this part answers for EXACTLY those slots from the
+                # host mirror — disjoint from the device part's slot set
+                # by construction, so the concatenated entries finalize
+                # byte-identically to an all-device answer
+                cb, cj, cm, cq = self.deps.host_pairs(qnp, q_m, floor_id,
+                                                      entries=True)
+                keep = self.store_shards.quarantined_slot_mask(cj)
+                parts.append({"kind": "host_slice",
+                              "ent": (cb[keep], cj[keep], cm[keep],
+                                      cq[keep])})
+                self.n_dispatches += 1
+                self._ktime("dispatch_host_slice", _t0)
                 return
             dk.launch_check(kind)
             b_pad = _pow2_at_least(len(rows), 1)
@@ -3030,6 +3184,26 @@ class DeviceState:
                 probing = True
                 self.n_reprobes += 1
                 self._fault_event("reprobe", f"route={route}")
+        # -- r21 store-sharded residency gating --
+        sh = self.store_shards
+        hybrid = False
+        if (sh is not None and sh.active and self.mesh is not None
+                and forced is None and route != "host"):
+            sh.tick_flush()
+            if sh.any_quarantined():
+                if attributed:
+                    # hybrid: healthy slices answer on device, the sick
+                    # slices' slots from the host twin (a host_slice part)
+                    hybrid = True
+                else:
+                    # the raw-CSR path consumes whole per-part CSRs (no
+                    # per-entry merge point for a twin to join at): serve
+                    # the whole flush from host while any slice is sick
+                    route = "host"
+                    self.n_fallback_queries += nq
+                    probing = False
+            if route != "host":
+                self.n_store_sharded_flushes += 1
         observed = forced or route
         if self.on_route is not None:
             self.on_route(observed, nq)
@@ -3045,7 +3219,14 @@ class DeviceState:
             if route == "host":
                 dispatch("host", all_rows)
             elif self.mesh is not None:
-                if route == "dense" or degenerate:
+                if hybrid:
+                    # quarantined slices pin the flush to the DENSE
+                    # sharded kind: the bucketed kernels read entries
+                    # structurally (no status column), so only the dense
+                    # mask can exclude a masked slice
+                    dispatch("sharded", all_rows)
+                    dispatch("host_slice", all_rows)
+                elif route == "dense" or degenerate:
                     dispatch("sharded", all_rows)
                 else:
                     qcols, wide_q = self._bucket_query_cols(qnp, q_m)
@@ -3066,11 +3247,11 @@ class DeviceState:
                 if len(wide):
                     dispatch("dense", wide)
         except faults.DEVICE_EXCEPTIONS as e:
-            # device-boundary failure at dispatch: quarantine and fail the
-            # WHOLE flush over to the always-correct host route (mixed
-            # host+device part lists are not a thing the collector sees)
+            # device-boundary failure at dispatch: quarantine (the slice
+            # it touched, under store-shards; else the device) and fail
+            # the WHOLE flush over to the always-correct host route
             parts.clear()
-            self._device_fault(e, f"dispatch: {e}")
+            self._device_fault(e, f"dispatch: {e}", sliced=True)
             self.n_fallback_queries += nq
             probing = False
             dispatch("host", all_rows)
@@ -3283,6 +3464,11 @@ class DeviceState:
         self._ktime_span("wait_entries_" + part["kind"],
                          *(t_e or (_t1, _time.perf_counter())))
         self.download_bytes += ent.nbytes
+        if self.store_shards is not None and self.store_shards.active \
+                and "sharded" in part["kind"]:
+            # bytes the sharded-store merge shipped home (header + merged
+            # entry block) — the ``shard_merge_bytes`` index counter
+            self.n_shard_merge_bytes += hdr.nbytes + ent.nbytes
         if attr:
             # the attributed header carries the in-kernel elision tallies
             # (eknown-graded transitive rows vs decided-below-pivot rows)
@@ -3338,7 +3524,7 @@ class DeviceState:
         try:
             outs = [self._collect_part(p) for p in parts]
         except faults.DEVICE_EXCEPTIONS as e:
-            self._device_fault(e, f"collect: {e}")
+            self._device_fault(e, f"collect: {e}", sliced=True)
             return self._host_fallback_collect(handle)
         _tg = _time.perf_counter()
         if len(outs) == 1:
@@ -3372,11 +3558,15 @@ class DeviceState:
             if not np.array_equal(np.unique(b_idx * cap + j_idx),
                                   np.unique(b_h * cap + j_h)):
                 self.n_shadow_mismatches += 1
-                self._device_fault("stale_result", "shadow mismatch")
+                self._device_fault("stale_result", "shadow mismatch",
+                                   sliced=True)
                 self.n_fallback_queries += nq
                 self.n_queries += nq
                 self.n_kernel_deps += len(j_h)
                 return b_h, j_h, pmq_h, ids, ivs, qnp, queries
+        sh = self.store_shards
+        if sh is not None and sh.active:
+            sh.note_success()   # probing suspect slices are healthy again
         if fmeta["probing"]:
             self._restore_device()   # the probe flush succeeded end-to-end
         self.n_queries += nq
@@ -3479,9 +3669,14 @@ class DeviceState:
             self._ktime("host_attr_filter", _th)
             return tb, tj, tm, tq, ids, ivs, qnp, q_m, queries
         try:
-            outs = [self._collect_part(p) for p in parts]
+            # host_slice twin parts (the r21 hybrid) answer from the host
+            # mirror through the same attr filter the host route uses;
+            # device parts download as usual
+            outs = [self._host_attr_triples(handle, part=p)
+                    if p["kind"] == "host_slice" else self._collect_part(p)
+                    for p in parts]
         except faults.DEVICE_EXCEPTIONS as e:
-            self._device_fault(e, f"collect: {e}")
+            self._device_fault(e, f"collect: {e}", sliced=True)
             self.n_host_queries += nq
             self.n_fallback_queries += nq
             self.n_dispatches += 1
@@ -3507,11 +3702,15 @@ class DeviceState:
             if not np.array_equal(np.unique(tb * cap + tj),
                                   np.unique(hb * cap + hj)):
                 self.n_shadow_mismatches += 1
-                self._device_fault("stale_result", "attr shadow mismatch")
+                self._device_fault("stale_result", "attr shadow mismatch",
+                                   sliced=True)
                 self.n_fallback_queries += nq
                 self.n_queries += nq
                 self.n_kernel_deps += len(hj)
                 return hb, hj, hm, hq, ids, ivs, qnp, q_m, queries
+        sh = self.store_shards
+        if sh is not None and sh.active:
+            sh.note_success()   # probing suspect slices are healthy again
         if fmeta["probing"]:
             self._restore_device()   # the probe flush succeeded end-to-end
         self.n_queries += nq
@@ -3623,6 +3822,11 @@ class DeviceState:
         if self.host_pinned or self._dev_quar_flushes > 0 \
                 or self.route_override == "host":
             return None
+        sh = self.store_shards
+        if sh is not None and sh.active and sh.any_quarantined():
+            # hybrid (device + host-twin) flushes run solo: a fused
+            # member's block is all-device, with no twin part to graft
+            return None
         q_m = _pow2_at_least(max(len(t[3]) + len(t[4]) for t in queries))
         packed = [(sb, wit, toks, rngs, tid)
                   for (tid, sb, wit, toks, rngs) in queries]
@@ -3698,7 +3902,7 @@ class DeviceState:
         over to the host route: quarantine this member and compute its
         host pairs right now (still inside the dispatcher event, so the
         live mirror IS the prep-time state)."""
-        self._device_fault(exc, f"fused dispatch: {exc}")
+        self._device_fault(exc, f"fused dispatch: {exc}", sliced=True)
         self.n_fallback_queries += hint["nq"]
         hint["probing"] = False
         hint["host"] = self.deps.host_pairs(hint["qnp"], hint["q_m"],
@@ -3845,6 +4049,9 @@ class DeviceState:
                 self.n_fallback_queries += nq
                 self.n_dispatches += 1
                 return hb, hj, hm, hq
+        sh = self.store_shards
+        if sh is not None and sh.active:
+            sh.note_success()
         if hint.get("probing"):
             self._restore_device()
         self.n_dispatches += 1

@@ -136,7 +136,8 @@ class FusedFlushLaunch:
         if self.failed is None:
             self.failed = exc
             for h in self.hints:
-                h["dev"]._device_fault(exc, f"fused collect: {exc}")
+                h["dev"]._device_fault(exc, f"fused collect: {exc}",
+                                       sliced=True)
                 h["probing"] = False
 
 

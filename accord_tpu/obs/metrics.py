@@ -271,6 +271,14 @@ INDEX_COUNTERS: List[Tuple[str, str]] = [
     ("elided_transitive", "n_elided_transitive"),
     ("elided_decided", "n_elided_decided"),
     ("attr_download_bytes", "attr_download_bytes"),
+    # r21 store-sharded tables: flushes answered by the sliced-residency
+    # route, per-slice quarantine/restore churn, bytes merged across the
+    # shard boundary, and host-pin recoveries (the un-terminal ladder)
+    ("store_sharded_flushes", "n_store_sharded_flushes"),
+    ("slice_quarantines", "n_slice_quarantines"),
+    ("slice_restores", "n_slice_restores"),
+    ("shard_merge_bytes", "n_shard_merge_bytes"),
+    ("oom_recovered", "n_oom_recovered"),
 ]
 
 

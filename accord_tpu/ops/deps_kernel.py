@@ -153,6 +153,24 @@ def empty_table(capacity: int, max_intervals: int) -> DepsTable:
     )
 
 
+@jax.jit
+def scatter_table_rows(table: DepsTable, idx, msb, lsb, node, kind, status,
+                       lo, hi) -> DepsTable:
+    """One fused dirty-row update for all seven table arrays (a single jit
+    dispatch instead of seven eager scatters — the update-in-place path
+    that keeps the table device-resident between queries).  Placement
+    follows the committed ``table`` arrays, so the r21 store-shard path
+    runs the same program once per slice device."""
+    return DepsTable(
+        table.msb.at[idx].set(msb),
+        table.lsb.at[idx].set(lsb),
+        table.node.at[idx].set(node),
+        table.kind.at[idx].set(kind),
+        table.status.at[idx].set(status),
+        table.lo.at[idx].set(lo),
+        table.hi.at[idx].set(hi))
+
+
 def _dep_mask_and_conflict(table: DepsTable, query: DepsQuery,
                            prune_msb=None, prune_lsb=None, prune_node=None):
     """Traceable core shared by calculate_deps (mask + max_conflict) and
@@ -816,6 +834,24 @@ class AttrCols(NamedTuple):
     elsb: jnp.ndarray     # int64[N]
     enode: jnp.ndarray    # int32[N]
     eknown: jnp.ndarray   # bool[N]
+
+
+@jax.jit
+def scatter_attr_cols(attr: "AttrCols", idx, dom, status, dmsb, dlsb,
+                      dnode, emsb, elsb, enode, eknown) -> "AttrCols":
+    """One fused dirty-row update for the attribution columns (the
+    AttrCols sibling of scatter_table_rows); shared by the single-device
+    mirror sync and the r21 per-slice store-shard sync."""
+    return AttrCols(
+        attr.dom.at[idx].set(dom),
+        attr.status.at[idx].set(status),
+        attr.dmsb.at[idx].set(dmsb),
+        attr.dlsb.at[idx].set(dlsb),
+        attr.dnode.at[idx].set(dnode),
+        attr.emsb.at[idx].set(emsb),
+        attr.elsb.at[idx].set(elsb),
+        attr.enode.at[idx].set(enode),
+        attr.eknown.at[idx].set(eknown))
 
 
 class AttrIndex(NamedTuple):

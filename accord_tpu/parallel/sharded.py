@@ -68,6 +68,18 @@ def shard_table(mesh: Mesh, table: DepsTable) -> DepsTable:
     )
 
 
+def assemble_slices(mesh: Mesh, shards, shape, two_d: bool = False):
+    """Zero-copy assembly of per-device slice buffers into ONE globally
+    sharded array (the r21 store-shard residency path): each element of
+    ``shards`` is a single-device array already resident on its mesh
+    device, and make_array_from_single_device_arrays only records the
+    placement — no bytes move.  ``shape`` is the global shape; ``two_d``
+    selects the (slot, interval) layout whose second axis is unsharded."""
+    spec = P(STORE_AXIS, None) if two_d else P(STORE_AXIS)
+    return jax.make_array_from_single_device_arrays(
+        tuple(shape), NamedSharding(mesh, spec), list(shards))
+
+
 def sharded_calculate_deps(mesh: Mesh):
     """Build the pjit-ted cross-shard deps computation for ``mesh``.
 

@@ -56,6 +56,17 @@ def pytest_configure(config):
         from accord_tpu.ops.drain_kernel import drain_logdepth_enabled
         assert not drain_logdepth_enabled(), \
             "ACCORD_TPU_DRAIN=fixpoint set but drain_logdepth_enabled()"
+    # ACCORD_TPU_STORE_SHARD=off canary (r21, same contract as the fusion
+    # knob): with the escape hatch set the budget ladder must skip the
+    # spill-to-sharded rung (breach goes compact -> host-pinned exactly as
+    # pre-r21) and tier-1 must stay green — sliced residency is a scaling
+    # layer, never load-bearing for correctness.
+    if os.environ.get("ACCORD_TPU_STORE_SHARD", "").lower() in ("off", "0",
+                                                                "false",
+                                                                "no"):
+        from accord_tpu.parallel.store_shard import store_shard_enabled
+        assert not store_shard_enabled(), \
+            "ACCORD_TPU_STORE_SHARD=off set but store_shard_enabled() is True"
     # ACCORD_TPU_OBS=off canary (r09, same contract as the fusion knob):
     # with the escape hatch set the obs subsystem must actually stand down
     # (no span recording, no device profiler) and tier-1 must stay green —

@@ -10,6 +10,8 @@ restore transitions, shadow-verify catching silent result corruption, and
 the HBM budget path compacting below the RedundantBefore floor then
 degrading pinned-to-host instead of dying."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -513,3 +515,106 @@ def test_wavefront_tick_fault_falls_back_to_frontier_sweep(monkeypatch):
     dev2._tick(_NoCommandsSafe())
     assert dev2.n_wavefront_ticks == 1
     assert dev2.n_host_ticks == 0
+
+
+# ---------------------------------------------------------------------------
+# r21 store-sharded tables x the fault ladder: a fault during a SLICED
+# collect quarantines one slice (the hybrid route answers its slots from
+# the host twin) while healthy slices stay on device — one sick chip
+# degrades a slice, not the node
+# ---------------------------------------------------------------------------
+_shard_canary = pytest.mark.skipif(
+    os.environ.get("ACCORD_TPU_STORE_SHARD", "").lower()
+    in ("off", "0", "false", "no"),
+    reason="ACCORD_TPU_STORE_SHARD=off canary run: spill rung dormant")
+
+
+def _sharded_build(seed=31):
+    """A _build store pushed past its budget so the spill rung activates
+    sliced residency (the r21 rung between compact and host-pinned)."""
+    store, dev, safe, entries, floor, qs = _build(seed)
+    dev.route_override = "dense"
+    dev.device_budget_slots = 64
+    _register_n(dev, 300, hlc_base=900_000)   # above the floor: live
+    assert dev.store_shards is not None and dev.store_shards.active
+    assert not dev.host_pinned
+    return store, dev, safe, qs
+
+
+@pytest.mark.parametrize("kind", RAISING)
+@_shard_canary
+def test_slice_fault_quarantines_one_slice_only(kind):
+    """Launch/transfer faults at p=1.0 during a sliced flush: the flush
+    fails over to host byte-identically, and exactly ONE slice quarantines
+    — the whole-device ladder stays untouched."""
+    store, dev, safe, qs = _sharded_build(seed=31)
+    expect = _attributed(dev, safe, qs, prune=True)
+    quar_before = dev.n_quarantines
+    with faults.device_fault(kind, 1.0, _rng()):
+        got = _attributed(dev, safe, qs, prune=True)
+    assert got == expect
+    assert dev.n_slice_quarantines == 1
+    assert dev.n_quarantines == quar_before      # no whole-device quarantine
+    sh = dev.store_shards
+    assert sum(1 for q in sh.quar if q > 0) == 1
+
+
+@_shard_canary
+def test_slice_quarantine_hybrid_then_probe_restore():
+    """The full per-slice cycle: fault -> slice quarantine -> hybrid
+    flushes (masked device dispatch + host twin for the sick slice) ->
+    backoff expiry -> reprobe -> restore.  Byte-identical at every step."""
+    store, dev, safe, qs = _sharded_build(seed=47)
+    expect = _attributed(dev, safe, qs, prune=True)
+    with faults.device_fault("transfer", 1.0, _rng()):
+        assert _attributed(dev, safe, qs, prune=True) == expect
+    sh = dev.store_shards
+    assert sh.any_quarantined()
+    sharded_before = dev.n_store_sharded_flushes
+    # hybrid flushes while quarantined: device route still counted, the
+    # sick slice answered from the host twin
+    while sh.any_quarantined():
+        assert _attributed(dev, safe, qs, prune=True) == expect
+    assert dev.n_store_sharded_flushes > sharded_before
+    # the tick that hit zero marked the slice suspect; the next healthy
+    # flush is the probe and restores it
+    assert _attributed(dev, safe, qs, prune=True) == expect
+    assert dev.n_slice_restores >= 1
+    assert not any(sh.suspect)
+    assert _attributed(dev, safe, qs, prune=True) == expect
+
+
+@_shard_canary
+def test_slice_stale_result_detected_by_shadow():
+    """Silent corruption during a sliced collect: paranoia shadow-verify
+    catches it and quarantines the SLICE, not the device."""
+    store, dev, safe, qs = _sharded_build(seed=53)
+    expect = _attributed(dev, safe, qs, prune=True)
+    dev.paranoia = True
+    quar_before = dev.n_quarantines
+    with faults.device_fault("stale_result", 1.0, _rng()):
+        got = _attributed(dev, safe, qs, prune=True)
+    assert got == expect
+    assert dev.n_shadow_mismatches >= 1
+    assert dev.n_slice_quarantines >= 1
+    assert dev.n_quarantines == quar_before
+
+
+@_shard_canary
+def test_raw_route_forced_host_under_slice_quarantine():
+    """The raw (non-attributed) CSR path has no per-entry merge point, so
+    under ANY slice quarantine the whole flush runs host — byte-identical,
+    counted as fallback, never as a sharded flush."""
+    store, dev, safe, qs = _sharded_build(seed=31)
+    expect_csr = _csr(dev, qs, prune=True)
+    with faults.device_fault("transfer", 1.0, _rng()):
+        _attributed(dev, safe, qs, prune=True)
+    sh = dev.store_shards
+    assert sh.any_quarantined()
+    sharded_before = dev.n_store_sharded_flushes
+    fallback_before = dev.n_fallback_queries
+    got_csr = _csr(dev, qs, prune=True)
+    for a, b in zip(expect_csr, got_csr):
+        np.testing.assert_array_equal(a, b)
+    assert dev.n_store_sharded_flushes == sharded_before
+    assert dev.n_fallback_queries > fallback_before

@@ -228,6 +228,17 @@ def main(argv=None):
     if ela:
         print("  elastic (info-only): "
               + "  ".join(f"{k}: {o} -> {n}" for k, o, n in ela))
+    # r21 store-sharded counters: printed, not gated — the headline store
+    # never breaches its budget (all zeros there); the config-5b row's
+    # dryrun_multichip assertion is the verdict-bearing gate and fails the
+    # bench run itself on any byte drift
+    shd = [(k, old_idx.get(k), new_idx.get(k))
+           for k in ("store_sharded_flushes", "slice_quarantines",
+                     "slice_restores", "shard_merge_bytes", "oom_recovered")
+           if old_idx.get(k) is not None or new_idx.get(k) is not None]
+    if shd:
+        print("  store-shard (info-only): "
+              + "  ".join(f"{k}: {o} -> {n}" for k, o, n in shd))
 
     common = [m for m in old_cfg if m in new_cfg]
     print(f"config rows ({len(common)} common, "

@@ -238,6 +238,115 @@ if [ "$HALF" = "net" ]; then
     exit $?
 fi
 
+run_meshstore_leg() {
+    echo ""
+    echo "== store-sharded per-slice fault legs (r21: sliced residency, double-run) =="
+    # every per-slice fault class x seed against ONE store spilled past its
+    # budget onto the mesh: the fault must quarantine a SLICE (never the
+    # node), attributed results must stay byte-identical to the fault-free
+    # sharded run AND to the solo single-device route over the same
+    # registrations, and the whole leg (counters included) must replay
+    # exactly across a double run
+    env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+        XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+        python - <<'PY'
+import sys
+
+from accord_tpu.utils import faults
+from accord_tpu.utils.random_source import RandomSource
+
+from tests.test_routing import _attributed, _build
+from tests.test_device_faults import _register_n
+
+SEEDS = (0, 5, 11)
+KINDS = ("kernel_launch", "transfer", "stale_result")
+
+
+def build_sharded(seed):
+    store, dev, safe, entries, floor, qs = _build(seed)
+    dev.route_override = "dense"
+    dev.device_budget_slots = 64
+    _register_n(dev, 300, hlc_base=900_000)
+    assert dev.store_shards is not None and dev.store_shards.active, \
+        "spill rung never activated"
+    assert not dev.host_pinned
+    return dev, safe, qs
+
+
+def build_solo(seed):
+    store, dev, safe, entries, floor, qs = _build(seed)
+    dev.mesh = None
+    dev.route_override = "dense"
+    _register_n(dev, 300, hlc_base=900_000)
+    return dev, safe, qs
+
+
+def run_leg(seed, kind):
+    dev, safe, qs = build_sharded(seed)
+    expect = _attributed(dev, safe, qs, prune=True)
+    if kind == "stale_result":
+        dev.paranoia = True
+    with faults.device_fault(kind, 1.0, RandomSource(seed ^ 0xDEC0)):
+        got = _attributed(dev, safe, qs, prune=True)
+    assert got == expect, f"faulted flush diverged ({kind})"
+    sh = dev.store_shards
+    hybrid = 0
+    while sh.any_quarantined():          # hybrid flushes drain the backoff
+        assert _attributed(dev, safe, qs, prune=True) == expect
+        hybrid += 1
+    assert _attributed(dev, safe, qs, prune=True) == expect   # the probe
+    counters = {
+        "slice_quarantines": dev.n_slice_quarantines,
+        "slice_restores": dev.n_slice_restores,
+        "whole_device_quarantines": dev.n_quarantines,
+        "store_sharded_flushes": dev.n_store_sharded_flushes,
+        "hybrid_flushes": hybrid,
+    }
+    return expect, counters
+
+
+failures = []
+for seed in SEEDS:
+    solo_dev, solo_safe, solo_qs = build_solo(seed)
+    solo = _attributed(solo_dev, solo_safe, solo_qs, prune=True)
+    for kind in KINDS:
+        a_res, a_cnt = run_leg(seed, kind)
+        b_res, b_cnt = run_leg(seed, kind)
+        problems = []
+        if a_res != b_res:
+            problems.append("results NONDETERMINISTIC across double run")
+        if a_cnt != b_cnt:
+            diff = {k for k in a_cnt if a_cnt[k] != b_cnt[k]}
+            problems.append(f"counters NONDETERMINISTIC: {sorted(diff)}")
+        if a_res != solo:
+            problems.append("sharded route != solo single-device route")
+        if a_cnt["slice_quarantines"] < 1:
+            problems.append("fault never quarantined a slice")
+        if a_cnt["whole_device_quarantines"] != 0:
+            problems.append("whole-device quarantine fired for a slice fault")
+        if a_cnt["slice_restores"] < 1:
+            problems.append("quarantined slice never restored")
+        line = (f"seed {seed} {kind:>13}: {a_cnt}")
+        if problems:
+            failures.append(f"seed {seed} kind {kind}: " + "; ".join(problems))
+            line += "  <-- " + "; ".join(problems)
+        print(line, flush=True)
+
+if failures:
+    print("\nMESHSTORE LEG FAILED:")
+    for f in failures:
+        print("  " + f)
+    sys.exit(1)
+print("meshstore legs clean: every per-slice fault class x seed "
+      "deterministic, slice-isolated, byte-equal to the solo route")
+PY
+}
+
+if [ "$HALF" = "meshstore" ]; then
+    run_meshstore_leg
+    exit $?
+fi
+
 device_rc=0
 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
@@ -351,16 +460,18 @@ net_rc=0
 disk_rc=0
 recovery_rc=0
 reconfig_rc=0
+meshstore_rc=0
 if [ "$HALF" != "device" ]; then
     run_net_leg || net_rc=$?
     run_disk_leg || disk_rc=$?
     run_recovery_leg || recovery_rc=$?
     run_reconfig_leg || reconfig_rc=$?
+    run_meshstore_leg || meshstore_rc=$?
 fi
 
-if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ] || [ "$disk_rc" -ne 0 ] || [ "$recovery_rc" -ne 0 ] || [ "$reconfig_rc" -ne 0 ]; then
+if [ "$device_rc" -ne 0 ] || [ "$net_rc" -ne 0 ] || [ "$disk_rc" -ne 0 ] || [ "$recovery_rc" -ne 0 ] || [ "$reconfig_rc" -ne 0 ] || [ "$meshstore_rc" -ne 0 ]; then
     echo ""
-    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc, disk rc=$disk_rc, recovery rc=$recovery_rc, reconfig rc=$reconfig_rc)"
+    echo "FAULT MATRIX FAILED (device rc=$device_rc, net rc=$net_rc, disk rc=$disk_rc, recovery rc=$recovery_rc, reconfig rc=$reconfig_rc, meshstore rc=$meshstore_rc)"
     exit 1
 fi
 echo ""
