@@ -28,11 +28,16 @@ from ..utils import async_chain, invariants
 from ..utils.interval_map import ReducingRangeMap
 from .command import Command
 from .commands_for_key import CommandsForKey, InternalStatus
-from .fastpath import proto_fastpath_enabled
+from .fastpath import proto_fastpath_enabled, store_group_enabled
 from .redundant import DurableBefore, MaxConflicts, RedundantBefore
 from .status import SaveStatus
 
 _FASTPATH = proto_fastpath_enabled()
+# r20 store-grouped execution: every task that shares a drain tick shares
+# ONE SafeCommandStore acquisition (merged PreLoadContext, one page-in
+# pass, op-boundary notification flushes).  Captured at import like
+# _FASTPATH; ACCORD_TPU_STORE_GROUP=off restores the per-task path.
+_STORE_GROUP = store_group_enabled()
 
 
 class PreLoadContext:
@@ -59,6 +64,30 @@ class PreLoadContext:
 
 
 _EMPTY_CONTEXT = PreLoadContext()
+
+
+def _merge_contexts(batch) -> PreLoadContext:
+    """Union of a grouped batch's declared contexts (r20): one merged
+    PreLoadContext covering every sub-op's txn ids — the single page-in
+    pass / context load the grouped drain performs up front.  Keys are
+    not merged (no consumer loads by key; in-memory stores satisfy any
+    context immediately)."""
+    if len(batch) == 1:
+        return batch[0][0]
+    primary = None
+    additional: List[TxnId] = []
+    seen: Set[TxnId] = set()
+    for context, _fn, _out in batch:
+        for tid in (context.primary_txn_id, *context.additional_txn_ids):
+            if tid is not None and tid not in seen:
+                seen.add(tid)
+                if primary is None:
+                    primary = tid
+                else:
+                    additional.append(tid)
+    if primary is None:
+        return _EMPTY_CONTEXT
+    return PreLoadContext(primary, additional)
 
 
 class RangesForEpoch:
@@ -170,8 +199,13 @@ class CommandStore:
         self._bootstrap_waiters: List[Callable[[], None]] = []
         self.n_stale_marks = 0      # diagnostics: staleness escape hatches
         self.reject_before: Optional[ReducingRangeMap] = None
-        self._queue: List[Callable[[], None]] = []
+        # under _STORE_GROUP the queue holds (context, fn, out) entries;
+        # otherwise opaque task closures (the original per-task path)
+        self._queue: List = []
         self._draining = False
+        # r20 grouped-execution census: ops per merged SafeCommandStore
+        # acquisition (1 = no sharing; mirrors the outbound batch census)
+        self.group_sizes: Dict[int, int] = {}
         # transient (non-durable) listeners: txn_id -> [fn(safe, command)]
         # (ref: Command.TransientListener / ReadData registration)
         self.transient_listeners: Dict[TxnId, List[Callable]] = {}
@@ -198,6 +232,20 @@ class CommandStore:
         """Queue fn to run with exclusive access; returns chain of result."""
         out: async_chain.AsyncResult = async_chain.AsyncResult()
 
+        if not getattr(self.node, "alive", True):
+            # dead incarnation (restart_node): its queued work must not run —
+            # ghost tasks would keep writing registers into the shared
+            # journal and data store, contaminating the new incarnation's
+            # durable state.  The chain never settles, like a crashed process.
+            return out
+
+        if _STORE_GROUP:
+            # grouped route: queue the structured entry; the drain merges
+            # every same-tick entry under ONE SafeCommandStore
+            self._queue.append((context, fn, out))
+            self._schedule_drain()
+            return out
+
         def task():
             # honor the PreLoadContext contract (ref: PreLoadContext.java:42):
             # everything the task declared is in memory before it runs.  With
@@ -214,12 +262,6 @@ class CommandStore:
             safe.complete()
             out.set_success(result)
 
-        if not getattr(self.node, "alive", True):
-            # dead incarnation (restart_node): its queued work must not run —
-            # ghost tasks would keep writing registers into the shared
-            # journal and data store, contaminating the new incarnation's
-            # durable state.  The chain never settles, like a crashed process.
-            return out
         self._queue.append(task)
         self._schedule_drain()
         return out
@@ -235,15 +277,55 @@ class CommandStore:
             self._queue.clear()   # the process died with this work pending
             self._draining = False
             return
-        while self._queue:
-            task = self._queue.pop(0)
-            try:
-                task()
-            except BaseException as e:  # noqa: BLE001
-                self.node.agent.on_uncaught_exception(e)
+        if _STORE_GROUP:
+            self._drain_grouped()
+        else:
+            while self._queue:
+                task = self._queue.pop(0)
+                try:
+                    task()
+                except BaseException as e:  # noqa: BLE001
+                    self.node.agent.on_uncaught_exception(e)
         self._draining = False
         if self.paged_limit is not None:
             self._maybe_page_out()
+
+    def _drain_grouped(self) -> None:
+        """Run every same-tick queued op under ONE SafeCommandStore.
+
+        Each batch = the queue as it stands: one merged PreLoadContext
+        (one page-in pass), one SafeCommandStore, then the per-op fn
+        bodies in queue order.  After each fn its deferred notifications
+        flush at the OP BOUNDARY (queued exactly where the per-op
+        ``complete()`` would have queued them) and its chain settles —
+        so the store-queue task order, listener_update call order and
+        reply emission order are byte-identical to the per-task drain.
+        Ops queued DURING the batch (notification tasks, nested
+        executes) form the next batch, preserving the per-op FIFO."""
+        while self._queue:
+            batch, self._queue = self._queue, []
+            self.group_sizes[len(batch)] = \
+                self.group_sizes.get(len(batch), 0) + 1
+            if self.paged_limit is not None:
+                for context, _fn, _out in batch:
+                    self._load_context(context)
+            safe = SafeCommandStore(self, _merge_contexts(batch))
+            for _context, fn, out in batch:
+                try:
+                    result = fn(safe)
+                except BaseException as e:  # noqa: BLE001
+                    safe.flush_pending()
+                    try:
+                        out.set_failure(e)
+                    except BaseException as e2:  # noqa: BLE001
+                        self.node.agent.on_uncaught_exception(e2)
+                    continue
+                safe.flush_pending()
+                try:
+                    out.set_success(result)
+                except BaseException as e:  # noqa: BLE001
+                    self.node.agent.on_uncaught_exception(e)
+            safe.complete()   # no-op: every op's pendings already flushed
 
     # -- journal-backed paging ----------------------------------------------
     def _load_context(self, context: PreLoadContext) -> None:
@@ -584,6 +666,14 @@ class SafeCommandStore:
         if self._completed:
             return
         self._completed = True
+        self.flush_pending()
+
+    def flush_pending(self) -> None:
+        """Emit the deferred notifications accumulated so far, leaving the
+        safe view open.  This is the r20 grouped drain's OP-BOUNDARY flush:
+        called after each sub-op's fn, it queues that op's notification
+        task exactly where the per-op ``complete()`` would have — same
+        store-queue order, same listener_update sequence."""
         notifications, self._pending_notifications = self._pending_notifications, []
         transients, self._pending_transients = self._pending_transients, []
         if not notifications and not transients:

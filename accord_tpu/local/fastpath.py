@@ -29,3 +29,20 @@ def proto_fastpath_enabled() -> bool:
     """True unless ``ACCORD_TPU_PROTO_FASTPATH`` is off/0/false/no."""
     return os.environ.get("ACCORD_TPU_PROTO_FASTPATH", "").lower() \
         not in ("off", "0", "false", "no")
+
+
+def store_group_enabled() -> bool:
+    """True unless ``ACCORD_TPU_STORE_GROUP`` is off/0/false/no.
+
+    The r20 store-grouped execution escape hatch: with the knob on, an
+    ``accord_batch`` envelope's protocol sub-bodies decode in one pass
+    and all ops targeting the same CommandStore execute under ONE
+    scheduled task with ONE SafeCommandStore acquisition (merged
+    PreLoadContext, one page-in pass).  With the knob off, every
+    envelope unbatches into the per-op path exactly as r16 shipped it.
+    Same contract as ``proto_fastpath_enabled``: consumers capture the
+    value at module import; ``tests/conftest.py`` carries the canary;
+    ``tools/run_fault_matrix.sh`` dual-runs both settings.
+    """
+    return os.environ.get("ACCORD_TPU_STORE_GROUP", "").lower() \
+        not in ("off", "0", "false", "no")

@@ -22,7 +22,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import api, wire
 from ..coordinate.errors import Timeout
-from ..local.fastpath import proto_fastpath_enabled
+from ..local.fastpath import proto_fastpath_enabled, store_group_enabled
 from ..impl.config_service import AbstractConfigurationService
 from ..local.node import Node
 from ..primitives.datum import datum_from_json, datum_to_json
@@ -35,6 +35,12 @@ from ..topology.topology import Topology
 from ..utils.random_source import RandomSource
 
 _FASTPATH = proto_fastpath_enabled()
+# r20 store-grouped execution: accord_batch envelopes decode in one pass
+# and deliver their protocol requests through Node.receive_group (one
+# scheduler hop, one SafeCommandStore per (run x store)) instead of N
+# recursive per-op handle calls.  ACCORD_TPU_STORE_GROUP=off restores
+# the r16 unbatch-at-the-door path.
+_STORE_GROUP = store_group_enabled()
 
 TOKEN_SPACE = 1 << 32
 # ref: Main.java uses a 1s sweeper; a cold JAX node stalls for seconds per
@@ -445,13 +451,19 @@ class MaelstromProcess:
             return
         if typ == "accord_batch":
             # cross-request fused fan-out (r16): one envelope carries N
-            # ops' bodies from one peer tick — unbatch HERE, at the
-            # protocol receiver, into the unchanged per-op path below (the
-            # envelope is transport amortization, never protocol state:
-            # per-op decisions, deps and replies are byte-identical to N
-            # separate frames).  The sub-bodies run in one scheduler tick,
-            # so their store flushes coalesce into one deps flush (and one
-            # fused device launch under --device-mode) by construction.
+            # ops' bodies from one peer tick.  Under _STORE_GROUP (r20)
+            # the envelope's protocol requests decode in ONE pass and
+            # deliver as a group (store-grouped execution); otherwise
+            # unbatch HERE, at the protocol receiver, into the unchanged
+            # per-op path below (the envelope is transport amortization,
+            # never protocol state: per-op decisions, deps and replies
+            # are byte-identical to N separate frames).  Either way the
+            # sub-bodies run in one scheduler tick, so their store
+            # flushes coalesce into one deps flush (and one fused device
+            # launch under --device-mode) by construction.
+            if _STORE_GROUP:
+                self._handle_batch_grouped(src, packet)
+                return
             import sys
             for sub in body.get("msgs") or ():
                 try:
@@ -501,6 +513,54 @@ class MaelstromProcess:
             # sharing a tick with protocol traffic would be silently
             # dropped at the unbatcher
             self.control_fallback(packet)
+
+    def _handle_batch_grouped(self, src: str, packet: dict) -> None:
+        """r20 store-grouped envelope intake: decode the envelope's
+        ``accord_req`` sub-bodies in ONE codec dispatch loop (shared
+        ``_wire_doc`` stamping) and hand each consecutive run to
+        :meth:`Node.receive_group`.  Sub-bodies the grouper cannot prove
+        safe to merge — replies (synchronous by contract), control verbs
+        and reconfig gossip (``control_fallback`` riders), client txns —
+        FLUSH the current run and take the unchanged per-op path, so
+        inter-type ordering is exactly the per-op unbatcher's: per-op
+        requests defer via one scheduler hop while everything else
+        handles synchronously, before the deferred run."""
+        import sys
+        from_id = node_name_to_id(src)
+        group: List = []
+
+        def flush():
+            if group:
+                self.node.receive_group(group[:], from_id)
+                del group[:]
+
+        for sub in packet.get("body", {}).get("msgs") or ():
+            styp = (sub or {}).get("type")
+            if styp == "accord_req":
+                try:
+                    request = wire.decode(sub["payload"])
+                    try:
+                        request._wire_doc = sub["payload"]
+                    except AttributeError:
+                        pass   # slotted/exotic request: journal re-encodes
+                    group.append((request, sub["msg_id"]))
+                except Exception as exc:
+                    print(f"batch sub-handler error on accord_req: {exc!r}",
+                          file=sys.stderr)
+                continue
+            flush()
+            if styp not in ("accord_rsp", "accord_fail", "txn"):
+                # control verbs / reconfig gossip riding the envelope:
+                # per-op fallback through control_fallback
+                self.node.n_group_fallbacks += 1
+            try:
+                self.handle({"src": src, "dest": packet.get("dest"),
+                             "body": sub}, _from_envelope=True)
+            except Exception as exc:   # one poisoned sub-body must not
+                # drop the rest of the batch on the floor
+                print(f"batch sub-handler error on {styp}: {exc!r}",
+                      file=sys.stderr)
+        flush()
 
     def _handle_init(self, src: str, body: dict) -> None:
         self.name = body["node_id"]

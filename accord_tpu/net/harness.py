@@ -62,12 +62,24 @@ class ServeCluster:
                  log_dir: Optional[str] = None,
                  extra_args: Optional[List[str]] = None,
                  journal_root: Optional[str] = None,
-                 wire_codec: str = "binary"):
+                 wire_codec: str = "binary",
+                 hosts: Optional[List[str]] = None,
+                 pin_cpus: Optional[List[int]] = None):
         self.names = [f"n{i}" for i in range(1, n_nodes + 1)]
         ports = free_ports(n_nodes)
+        # multi-box spread (r20, ROADMAP item 4): ``hosts`` assigns listen
+        # addresses round-robin across the given host IPs (they must be
+        # locally-bindable interfaces — the harness spawns local
+        # processes; loopback is the default single-box topology) and
+        # ``pin_cpus`` pins node i to cpu pin_cpus[i % len] via taskset —
+        # the honest separate-core equivalent of separate boxes on one
+        # machine.  Both are recorded in ``topology()`` so bench rows
+        # carry the spread in-row.
+        self.hosts = list(hosts) if hosts else ["127.0.0.1"]
+        self.pin_cpus = list(pin_cpus) if pin_cpus else None
         self.addrs: List[Tuple[str, str, int]] = [
-            (name, "127.0.0.1", port)
-            for name, port in zip(self.names, ports)]
+            (name, self.hosts[i % len(self.hosts)], port)
+            for i, (name, port) in enumerate(zip(self.names, ports))]
         # epoch-1 membership is frozen at construction: nodes added later
         # (add_node) spawn with --members = this list so every node's
         # epoch-1 topology byte-matches; membership then changes only
@@ -91,6 +103,33 @@ class ServeCluster:
     def _peers_arg(self) -> str:
         return ",".join(f"{n}={h}:{p}" for n, h, p in self.addrs)
 
+    def _pin_for(self, name: str) -> Optional[int]:
+        """The cpu this node pins to (taskset), or None (unpinned)."""
+        if not self.pin_cpus:
+            return None
+        import shutil
+        if shutil.which("taskset") is None:
+            return None
+        try:
+            idx = self.names.index(name)
+        except ValueError:
+            return None
+        return self.pin_cpus[idx % len(self.pin_cpus)]
+
+    def topology(self) -> dict:
+        """The in-row spread record (ROADMAP item 4): which hosts the
+        cluster spans, the box's core count, and any per-node cpu
+        pinning — so a bench row is honest about whether its numbers
+        came from N processes time-sharing one core or truly separate
+        cores/boxes."""
+        pinning = {n: self._pin_for(n) for n in self.names}
+        return {
+            "hosts": sorted({h for _n, h, _p in self.addrs}),
+            "host_cpus": os.cpu_count(),
+            "pinning": (pinning if any(v is not None
+                                       for v in pinning.values()) else None),
+        }
+
     def spawn(self, name: str,
               env_extra: Optional[Dict[str, str]] = None
               ) -> subprocess.Popen:
@@ -107,7 +146,11 @@ class ServeCluster:
         env.setdefault("ACCORD_TPU_DEVICE", "0")   # host route: fast start
         if self.net_faults:
             env["ACCORD_TPU_NET_FAULTS"] = self.net_faults
-        cmd = [sys.executable, "-m", "accord_tpu.net.server",
+        cmd = []
+        cpu = self._pin_for(name)
+        if cpu is not None:
+            cmd += ["taskset", "-c", str(cpu)]
+        cmd += [sys.executable, "-m", "accord_tpu.net.server",
                "--name", name, "--listen", f"{host}:{port}",
                "--peers", self._peers_arg(),
                "--members", ",".join(self.initial_members),
@@ -399,8 +442,12 @@ async def cluster_net_stats(client: ClusterClient,
            # the # index: line quote these)
            "wire_bytes_tx": 0, "wire_bytes_rx": 0, "frames_coalesced": 0,
            "batched_fanouts": 0, "batched_ops": 0, "fast_sheds": 0,
-           "batch_occupancy_p50": 0, "per_node": {}}
+           "batch_occupancy_p50": 0,
+           # the r20 store-grouped execution counters
+           "grouped_ops": 0, "group_fallbacks": 0,
+           "store_group_occupancy_p50": 0, "per_node": {}}
     occupancy = []
+    group_occupancy = []
     for name in names:
         try:
             s = await client.stats(name)
@@ -422,10 +469,17 @@ async def cluster_net_stats(client: ClusterClient,
         agg["batched_fanouts"] += b.get("batched_fanouts", 0)
         agg["batched_ops"] += b.get("batched_ops", 0)
         agg["fast_sheds"] += b.get("fast_sheds", 0)
+        agg["grouped_ops"] += b.get("grouped_ops", 0)
+        agg["group_fallbacks"] += b.get("group_fallbacks", 0)
         if b.get("batch_occupancy_p50"):
             occupancy.append(b["batch_occupancy_p50"])
+        if b.get("store_group_occupancy_p50"):
+            group_occupancy.append(b["store_group_occupancy_p50"])
     if occupancy:
         agg["batch_occupancy_p50"] = sorted(occupancy)[len(occupancy) // 2]
+    if group_occupancy:
+        agg["store_group_occupancy_p50"] = \
+            sorted(group_occupancy)[len(group_occupancy) // 2]
     return agg
 
 

@@ -575,15 +575,20 @@ class NodeServer:
     def batch_occupancy_p50(self) -> int:
         """Weighted median outbound per-peer batch size (1 = no sharing;
         the envelope census counts every flushed fan-out)."""
-        total = sum(self.batch_sizes.values())
-        if not total:
+        return _weighted_median(self.batch_sizes)
+
+    def store_group_occupancy_p50(self) -> int:
+        """Weighted median ops per merged SafeCommandStore acquisition
+        (r20 store-grouped execution), across this node's CommandStores
+        (1 = no sharing; 0 with the knob off or before any drain)."""
+        node = getattr(self.proc, "node", None) if self.proc else None
+        if node is None:
             return 0
-        seen = 0
-        for size in sorted(self.batch_sizes):
-            seen += self.batch_sizes[size]
-            if seen * 2 >= total:
-                return size
-        return 0
+        merged: Dict[int, int] = {}
+        for store in node.command_stores.stores:
+            for size, n in store.group_sizes.items():
+                merged[size] = merged.get(size, 0) + n
+        return _weighted_median(merged)
 
     def stats(self) -> dict:
         proc = self.proc
@@ -601,6 +606,13 @@ class NodeServer:
                 "batch_occupancy_p50": self.batch_occupancy_p50(),
                 "unbatched_envelopes": self.n_unbatched_envelopes,
                 "fast_sheds": self.n_fast_sheds,
+                # r20 store-grouped execution (ACCORD_TPU_STORE_GROUP)
+                "grouped_ops": getattr(getattr(proc, "node", None),
+                                       "n_grouped_ops", 0),
+                "group_fallbacks": getattr(getattr(proc, "node", None),
+                                           "n_group_fallbacks", 0),
+                "store_group_occupancy_p50":
+                    self.store_group_occupancy_p50(),
             },
             "dispatch": (lambda d: None if d is None else {
                 "flush_events": d.n_flush_events,
@@ -774,6 +786,18 @@ class NodeServer:
                 self.journal.close()   # final flush (graceful exit only —
             except OSError:            # kill -9 relies on recovery)
                 pass
+
+
+def _weighted_median(census: Dict[int, int]) -> int:
+    total = sum(census.values())
+    if not total:
+        return 0
+    seen = 0
+    for size in sorted(census):
+        seen += census[size]
+        if seen * 2 >= total:
+            return size
+    return 0
 
 
 def parse_addr(s: str) -> Tuple[str, int]:

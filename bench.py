@@ -1280,6 +1280,23 @@ def main(em: Emitter):
                     "# protocol_us_per_txn: merged-pstats accord_tpu "
                     "tottime per committed txn from the short "
                     "cProfile'd config-6 leg")
+        # r20: the store-grouped execution counters join the index line
+        # from the config-6 saturation row — occupancy gates
+        # higher-is-better (the tentpole's amortization census),
+        # grouped_ops/group_fallbacks are info-only (workload-shape
+        # dependent splits)
+        if sat_row is not None and "store_group_occupancy_p50" in sat_row:
+            em.note("# index: "
+                    "store_group_occupancy_p50="
+                    f"{sat_row['store_group_occupancy_p50']} "
+                    f"grouped_ops={sat_row.get('grouped_ops', 0)} "
+                    "group_fallbacks="
+                    f"{sat_row.get('group_fallbacks', 0)}\n"
+                    "# store-group counters: median ops sharing one "
+                    "SafeCommandStore acquisition + ops that rode a "
+                    "grouped scheduler callback vs fell back per-op "
+                    "(cross-epoch / non-protocol sub-bodies), whole "
+                    "config-6 sweep")
         # r17: the elastic-serving counters join the # index: line from
         # the config-9 rebalance row (int-parseable; wall-clock counters
         # are info-only in the trend map — the oscillating box makes

@@ -45,6 +45,16 @@ def pytest_configure(config):
         from accord_tpu.local.fastpath import proto_fastpath_enabled
         assert not proto_fastpath_enabled(), \
             "ACCORD_TPU_PROTO_FASTPATH=off set but proto_fastpath_enabled()"
+    # ACCORD_TPU_STORE_GROUP=off canary (r20, same contract): with the
+    # escape hatch set every CommandStore must drain per-op (opaque
+    # closures, one SafeCommandStore per op) and every batch envelope
+    # must route sub-bodies one at a time — store-grouped execution is a
+    # perf layer, never load-bearing for correctness.
+    if os.environ.get("ACCORD_TPU_STORE_GROUP", "").lower() in (
+            "off", "0", "false", "no"):
+        from accord_tpu.local.fastpath import store_group_enabled
+        assert not store_group_enabled(), \
+            "ACCORD_TPU_STORE_GROUP=off set but store_group_enabled()"
     # ACCORD_TPU_DRAIN=fixpoint canary (r19, same contract as the fusion
     # knob): with the escape hatch set every routed drain must run the
     # fixpoint oracle (no log-depth kernel, no widened tick wavefront) and

@@ -1,0 +1,452 @@
+"""r20 store-grouped execution: grouped must equal per-op, byte for byte.
+
+The tentpole keeps an ``accord_batch`` envelope batched from the wire to
+the SafeCommandStore — one decode loop, one scheduler hop, one store
+acquisition per same-store run — while claiming PROTOCOL INVISIBILITY:
+every reply byte, journal record and command outcome identical to the
+per-op path.  This file is that claim's pinned evidence:
+
+- a seeded ``run_property`` sweep drives MIXED envelopes (real protocol
+  payloads x client txns x duplicate msg_ids x control verbs x reconfig
+  gossip x cross-epoch requests) through one MaelstromProcess under BOTH
+  modes (module flags flipped in-process, the ``command.py _FASTPATH``
+  precedent) and asserts the full emitted-packet stream, the
+  control-fallback routing, the journal record streams and the per-store
+  command outcomes are identical;
+- the grouped drain's census must actually ENGAGE (occupancy > 1) on an
+  envelope of protocol requests — protocol invisibility must not be
+  vacuous;
+- a real-TCP kill -9 lands mid-grouped-batch under concurrent load and
+  the at-most-once contract holds: ``duplicate_replies == 0``.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from accord_tpu import api, wire
+from accord_tpu.maelstrom import node as maelstrom_node
+from accord_tpu.local import command_store as command_store_mod
+from accord_tpu.local.fastpath import store_group_enabled
+from tests.proptest import case_budget, run_property
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+# ---------------------------------------------------------------------------
+# harness: a drainable scheduler, a recording process, the mode flip
+# ---------------------------------------------------------------------------
+
+class _Scheduler(api.Scheduler):
+    """FIFO drainable scheduler (the test_net envelope-test mold): timers
+    never fire, so a run's outcome is a pure function of the input."""
+
+    def __init__(self):
+        self.q = []
+
+    def now(self, run):
+        self.q.append(run)
+
+    def once(self, delay, run):
+        class S(api.Scheduled):
+            cancelled = False
+
+            def cancel(self):
+                self.cancelled = True
+
+            def is_cancelled(self):
+                return self.cancelled
+        return S()
+
+    def recurring(self, interval, run):
+        return self.once(interval, run)
+
+    def drain(self):
+        while self.q:
+            self.q.pop(0)()
+
+
+class _RecordingJournal:
+    """The journal surface MaelstromProcess consults, recording every
+    fact in arrival order: record streams are part of the byte-identity
+    contract.  ``commit`` stays None so nothing gates on durability, and
+    ``replied_body`` serves the at-most-once table — duplicate client
+    msg_ids exercise the REPLAY path in both modes."""
+
+    commit = None
+    max_hlc = 0
+    hlc_reserved = 0
+
+    def __init__(self):
+        self.messages = []
+        self.replies = []
+        self.applies = []
+        self._replied = {}
+
+    def has_restored_state(self):
+        return False
+
+    def reserve_hlc(self, hlc):
+        self.hlc_reserved = hlc
+
+    def record_message(self, request, from_id):
+        doc = getattr(request, "_wire_doc", None)
+        if doc is None:
+            doc = wire.encode(request)
+        self.messages.append((from_id, json.dumps(doc, sort_keys=True)))
+
+    def record_reply(self, dest, in_reply_to, stored):
+        self.replies.append((dest, in_reply_to,
+                             json.dumps(stored, sort_keys=True)))
+        self._replied[(dest, in_reply_to)] = stored
+
+    def replied_body(self, src, msg_id):
+        return self._replied.get((src, msg_id))
+
+    def record_apply(self, token, values, execute_at, txn_id):
+        self.applies.append((token, str(values), str(execute_at),
+                             str(txn_id)))
+
+
+def _set_store_group(enabled: bool):
+    """Flip the r20 mode in-process (both capture points) and return the
+    saved values for restore."""
+    saved = (command_store_mod._STORE_GROUP, maelstrom_node._STORE_GROUP)
+    command_store_mod._STORE_GROUP = enabled
+    maelstrom_node._STORE_GROUP = enabled
+    return saved
+
+
+def _restore_store_group(saved):
+    command_store_mod._STORE_GROUP, maelstrom_node._STORE_GROUP = saved
+
+
+# ---------------------------------------------------------------------------
+# sub-body pools: real protocol payloads + a cross-epoch request
+# ---------------------------------------------------------------------------
+
+_PAYLOADS = None
+
+
+def _protocol_payloads():
+    """Real inter-node protocol payloads (PreAccept/Accept/Commit/Apply
+    fan-out) captured from a tapped in-process cluster run — the
+    _golden_packets technique, cached per test session."""
+    global _PAYLOADS
+    if _PAYLOADS is not None:
+        return _PAYLOADS
+    from accord_tpu.sim import cluster as cluster_mod
+    from accord_tpu.sim.cluster import Cluster
+    from accord_tpu.sim.kvstore import KVDataStore, kv_txn
+    from accord_tpu.sim.topology_factory import build_topology
+
+    topology = build_topology(1, (1, 2, 3), 3, 4)
+    cluster = Cluster(topology=topology, seed=11,
+                      data_store_factory=KVDataStore)
+    captured = []
+    orig = cluster_mod.NodeSink.send_with_callback
+
+    def tap(self, to, request, cb):
+        captured.append(request)
+        return orig(self, to, request, cb)
+
+    cluster_mod.NodeSink.send_with_callback = tap
+    try:
+        for i in range(4):
+            cluster.nodes[1 + (i % 3)].coordinate(
+                kv_txn([i * 7, (i + 1) * 7], {i * 7: (i,)})).begin(
+                lambda r, f: None)
+        cluster.run_until_quiescent()
+    finally:
+        cluster_mod.NodeSink.send_with_callback = orig
+    assert len(captured) >= 8, "tap captured no protocol traffic"
+    _PAYLOADS = [wire.encode(req) for req in captured[:24]]
+    return _PAYLOADS
+
+
+def _cross_epoch_payload():
+    """A request whose wait_for_epoch exceeds the static cluster's epoch
+    1: both routes must park it on await_epoch (the grouped route via its
+    per-op fallback) and emit nothing."""
+    from accord_tpu.messages.check_status import CheckStatus, IncludeInfo
+    from accord_tpu.primitives.keys import RoutingKeys
+    from accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+    tid = TxnId.create(1, 777, TxnKind.Write, Domain.Key, 2)
+    return wire.encode(CheckStatus(tid, RoutingKeys.of(5), 99,
+                                   IncludeInfo.All))
+
+
+# ---------------------------------------------------------------------------
+# the property: one mixed-envelope scenario, two modes, identical bytes
+# ---------------------------------------------------------------------------
+
+class _Case:
+    def __init__(self, envelopes):
+        self.envelopes = envelopes   # [[sub-body, ...], ...]
+
+    def describe(self):
+        lines = []
+        for i, env in enumerate(self.envelopes):
+            kinds = [(s.get("type"), s.get("msg_id")) for s in env]
+            lines.append(f"envelope {i}: {kinds}")
+        return "\n".join(lines) or "(empty)"
+
+    def __repr__(self):
+        return self.describe()
+
+
+def _make_case(rng) -> _Case:
+    payloads = _protocol_payloads()
+    cross = _cross_epoch_payload()
+    envelopes = []
+    msg_id = [50_000]
+    txn_bodies = []
+
+    def next_id():
+        msg_id[0] += 1
+        return msg_id[0]
+
+    def sub(kind_roll, k):
+        if kind_roll < 5:       # protocol op (the common case)
+            return {"type": "accord_req", "msg_id": next_id(),
+                    "payload": payloads[rng.next_int(len(payloads))]}
+        if kind_roll < 7:       # client txn riding the envelope
+            body = {"type": "txn", "msg_id": next_id(),
+                    "txn": [["append", 3 + k, k], ["r", 3 + k, None]]}
+            txn_bodies.append(body)
+            return body
+        if kind_roll == 7 and txn_bodies:   # duplicate client msg_id
+            return dict(txn_bodies[rng.next_int(len(txn_bodies))])
+        if kind_roll == 8:      # control verb -> control_fallback rider
+            return {"type": "codec_hello", "msg_id": next_id(),
+                    "node": "n2", "codec": "binary"}
+        if kind_roll == 9:      # reconfig gossip -> control_fallback
+            return {"type": "epoch_sync", "msg_id": next_id(),
+                    "epoch": 2, "node": "n2"}
+        # cross-epoch protocol op: parks on await_epoch in both modes
+        return {"type": "accord_req", "msg_id": next_id(),
+                "payload": cross}
+
+    for e in range(1 + rng.next_int(3)):
+        n_sub = 1 + rng.next_int(6)
+        envelopes.append([sub(rng.next_int(11), e * 8 + j)
+                          for j in range(n_sub)])
+    return _Case(envelopes)
+
+
+def _shrink_candidates(case: _Case):
+    for i in range(len(case.envelopes)):      # drop a whole envelope
+        yield _Case(case.envelopes[:i] + case.envelopes[i + 1:])
+    for i, env in enumerate(case.envelopes):  # drop one sub-body
+        if len(env) > 1:
+            for j in range(len(env)):
+                yield _Case(case.envelopes[:i]
+                            + [env[:j] + env[j + 1:]]
+                            + case.envelopes[i + 1:])
+
+
+def _run_case(case: _Case, grouped: bool) -> dict:
+    """One fresh 3-node-topology process, every envelope delivered from
+    peer n2, scheduler drained between envelopes; returns everything the
+    byte-identity contract covers."""
+    saved = _set_store_group(grouped)
+    try:
+        sent = []
+        fallback = []
+        sched = _Scheduler()
+        journal = _RecordingJournal()
+        proc = maelstrom_node.MaelstromProcess(
+            emit=lambda dest, body: sent.append(
+                (dest, json.dumps(body, sort_keys=True))),
+            scheduler=sched, now_micros=lambda: 0,
+            num_stores=2, device_mode=False, durability=False,
+            journal=journal)
+        proc.control_fallback = lambda pkt: fallback.append(
+            json.dumps(pkt, sort_keys=True))
+        proc.handle({"src": "boot", "dest": "n1",
+                     "body": {"type": "init", "msg_id": 0, "node_id": "n1",
+                              "node_ids": ["n1", "n2", "n3"]}})
+        sched.drain()
+        del sent[:]   # drop init_ok
+        for env in case.envelopes:
+            proc.handle({"src": "n2", "dest": "n1",
+                         "body": {"type": "accord_batch",
+                                  "msgs": [dict(s) for s in env]}})
+            sched.drain()
+        sched.drain()
+        assert not proc.failures, proc.failures
+        commands = {}
+        for i, store in enumerate(proc.node.command_stores.stores):
+            commands[i] = sorted(
+                (str(tid), str(cmd.save_status))
+                for tid, cmd in store.commands.items())
+        return {
+            "sent": sent,
+            "fallback": fallback,
+            "journal_messages": journal.messages,
+            "journal_replies": journal.replies,
+            "journal_applies": journal.applies,
+            "commands": commands,
+        }
+    finally:
+        _restore_store_group(saved)
+
+
+def test_mixed_envelopes_grouped_equals_per_op_property():
+    """The seeded sweep: every mixed-envelope scenario produces an
+    IDENTICAL emitted-packet stream, control-fallback routing, journal
+    record stream and per-store command outcome under store-grouped and
+    per-op execution."""
+    def check(case):
+        a = _run_case(case, grouped=True)
+        b = _run_case(case, grouped=False)
+        for key in a:
+            assert a[key] == b[key], \
+                f"grouped != per-op on {key}:\n{a[key]}\n--vs--\n{b[key]}"
+
+    ran = run_property(
+        case_budget(8), base_seed=2020,
+        make_case=_make_case, check=check,
+        shrink_candidates=_shrink_candidates,
+        replay_hint="pytest tests/test_store_group.py -k property")
+    assert ran >= 1
+
+
+def test_grouped_drain_census_engages():
+    """Protocol invisibility must not be vacuous: an envelope full of
+    protocol requests must actually ride the grouped path — ops counted,
+    a store batch deeper than one op, zero fallbacks for pure-protocol
+    traffic — and flipping the knob must stand the whole layer down."""
+    payloads = _protocol_payloads()
+    env = [{"type": "accord_req", "msg_id": 60_000 + i,
+            "payload": payloads[i % len(payloads)]}
+           for i in range(6)]
+    out = {}
+    for grouped in (True, False):
+        saved = _set_store_group(grouped)
+        try:
+            sched = _Scheduler()
+            proc = maelstrom_node.MaelstromProcess(
+                emit=lambda dest, body: None, scheduler=sched,
+                now_micros=lambda: 0, num_stores=2, device_mode=False,
+                durability=False)
+            proc.handle({"src": "boot", "dest": "n1",
+                         "body": {"type": "init", "msg_id": 0,
+                                  "node_id": "n1",
+                                  "node_ids": ["n1", "n2", "n3"]}})
+            sched.drain()
+            proc.handle({"src": "n2", "dest": "n1",
+                         "body": {"type": "accord_batch", "msgs": env}})
+            sched.drain()
+            census = {}
+            for store in proc.node.command_stores.stores:
+                for size, n in store.group_sizes.items():
+                    census[size] = census.get(size, 0) + n
+            out[grouped] = (proc.node.n_grouped_ops,
+                            proc.node.n_group_fallbacks, census)
+        finally:
+            _restore_store_group(saved)
+    n_grouped, n_fallback, census = out[True]
+    assert n_grouped == len(env), (n_grouped, census)
+    assert n_fallback == 0
+    assert any(size > 1 for size in census), \
+        f"no store batch ever held more than one op: {census}"
+    assert out[False] == (0, 0, {}), \
+        f"per-op mode still ran the grouped layer: {out[False]}"
+
+
+def test_cross_epoch_sub_bodies_fall_back_per_op():
+    """A cross-epoch request inside an envelope takes the per-op
+    await_epoch path (counted as a fallback) while its neighbours still
+    group — and emits nothing until the epoch exists."""
+    payloads = _protocol_payloads()
+    env = [
+        {"type": "accord_req", "msg_id": 61_001, "payload": payloads[0]},
+        {"type": "accord_req", "msg_id": 61_002,
+         "payload": _cross_epoch_payload()},
+        {"type": "accord_req", "msg_id": 61_003, "payload": payloads[1]},
+    ]
+    saved = _set_store_group(True)
+    try:
+        sched = _Scheduler()
+        proc = maelstrom_node.MaelstromProcess(
+            emit=lambda dest, body: None, scheduler=sched,
+            now_micros=lambda: 0, num_stores=2, device_mode=False,
+            durability=False)
+        proc.handle({"src": "boot", "dest": "n1",
+                     "body": {"type": "init", "msg_id": 0, "node_id": "n1",
+                              "node_ids": ["n1", "n2", "n3"]}})
+        sched.drain()
+        proc.handle({"src": "n2", "dest": "n1",
+                     "body": {"type": "accord_batch", "msgs": env}})
+        sched.drain()
+        assert proc.node.n_group_fallbacks == 1
+        assert proc.node.n_grouped_ops == 2
+        assert not proc.failures, proc.failures
+    finally:
+        _restore_store_group(saved)
+
+
+# ---------------------------------------------------------------------------
+# kill -9 mid-grouped-batch on the real TCP cluster
+# ---------------------------------------------------------------------------
+
+def test_kill9_mid_grouped_batch_no_duplicate_replies():
+    """Concurrent load keeps the per-tick fan-out batcher full (grouped
+    batches on the wire and in the stores — asserted from the serving
+    counters), then kill -9 lands mid-burst: survivors keep serving, the
+    victim rejoins, and no client ever sees a duplicate reply."""
+    import random
+
+    from accord_tpu.net.client import ClusterClient
+    from accord_tpu.net.harness import (ServeCluster, _mk_ops,
+                                        cluster_net_stats, wait_ready)
+
+    cluster = ServeCluster(n_nodes=3, request_timeout_ms=800)
+    cluster.spawn_all()
+    try:
+        async def scenario():
+            client = ClusterClient(cluster.addrs, timeout=8.0)
+            try:
+                await wait_ready(cluster, client)
+                rng = random.Random(7)
+                counter = [0]
+
+                async def burst(n, nodes):
+                    async def one(i):
+                        await client.submit_retry(
+                            _mk_ops(rng, counter, 16), retries=12,
+                            timeout=6.0, node=nodes[i % len(nodes)])
+                    await asyncio.gather(*(one(i) for i in range(n)))
+                    return n
+
+                # phase 1: concurrent load, all three nodes — fan-out
+                # envelopes form, the grouped drain engages (census only
+                # meaningful with the knob on; the kill -9 at-most-once
+                # contract below runs under BOTH settings)
+                assert await burst(24, cluster.names) == 24
+                net = await cluster_net_stats(client, cluster.names)
+                if store_group_enabled():
+                    assert net["grouped_ops"] > 0, \
+                        "no op ever rode a grouped scheduler callback"
+                    assert net["store_group_occupancy_p50"] >= 1, net
+                # phase 2: kill -9 mid-concurrent-burst
+                load = asyncio.get_event_loop().create_task(
+                    burst(16, ["n1", "n3"]))
+                await asyncio.sleep(0.05)
+                cluster.kill9("n2")
+                assert await load == 16
+                # phase 3: rejoin, serve again, at-most-once held
+                cluster.spawn("n2")
+                await wait_ready(cluster, client)
+                assert await burst(8, cluster.names) == 8
+                assert client.duplicate_replies() == 0
+                return True
+            finally:
+                await client.close()
+
+        assert asyncio.run(scenario())
+        assert all(cluster.alive().values())
+    finally:
+        cluster.shutdown()

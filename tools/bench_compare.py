@@ -209,6 +209,10 @@ def main(argv=None):
                        ("frames_coalesced", False),
                        ("batched_fanouts", False),
                        ("batch_occupancy_p50", False),
+                       # r20: median ops sharing one SafeCommandStore
+                       # acquisition (store-grouped execution) — deeper
+                       # groups amortize better
+                       ("store_group_occupancy_p50", False),
                        # r18: profiled protocol CPU per txn (us) — same
                        # cProfile tooling every round, lower is better
                        ("protocol_us_per_txn", True)):
@@ -228,6 +232,15 @@ def main(argv=None):
     if ela:
         print("  elastic (info-only): "
               + "  ".join(f"{k}: {o} -> {n}" for k, o, n in ela))
+    # r20 store-group split: printed, not gated — the grouped/fallback
+    # ratio tracks workload shape (control verbs and cross-epoch ops
+    # fall back per-op by design); occupancy_p50 above is the gate
+    sg = [(k, old_idx.get(k), new_idx.get(k))
+          for k in ("grouped_ops", "group_fallbacks")
+          if old_idx.get(k) is not None or new_idx.get(k) is not None]
+    if sg:
+        print("  store-group (info-only): "
+              + "  ".join(f"{k}: {o} -> {n}" for k, o, n in sg))
     # r21 store-sharded counters: printed, not gated — the headline store
     # never breaches its budget (all zeros there); the config-5b row's
     # dryrun_multichip assertion is the verdict-bearing gate and fails the

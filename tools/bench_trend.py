@@ -73,6 +73,14 @@ INDEX_GATED = {
     # cProfile'd config-6 leg) gates lower-is-better — same tool every
     # round, so the profiler overhead cancels in the ratio
     "protocol_us_per_txn": "down",
+    # r20 store-grouped execution: occupancy gates higher-is-better (the
+    # amortization census the tentpole claims); grouped_ops and
+    # group_fallbacks are INFO-ONLY — the grouped/fallback split is
+    # workload-shape dependent (control verbs, reconfig gossip and
+    # cross-epoch ops fall back per-op by design)
+    "store_group_occupancy_p50": "up",
+    "grouped_ops": None,
+    "group_fallbacks": None,
     "epoch_current": None,
     "epochs_retired": None,
     "bootstrap_bytes_rx": None,

@@ -347,6 +347,13 @@ def mode_serve(args):
     for r in res["frames"]:
         print(f"{r['ms_per_txn']:8.3f} {r['calls_per_txn']:10.2f} "
               f"{r['tottime_s']:10.3f}  {r['frame']}", file=sys.stderr)
+    stages = res.get("stage_ms_per_txn") or {}
+    if stages:
+        # r20: the pipeline-stage partition of protocol_ms_per_txn —
+        # decode / scheduler hop / store setup / handler body / reply
+        # encode — the attribution the grouped-vs-per-op A/B reads
+        print("stage ms/txn: " + " ".join(
+            f"{k}={v}" for k, v in stages.items()), file=sys.stderr)
     print(f"saturation={res['saturation_txns_per_sec']} txn/s "
           f"txns={res['txns']} "
           f"protocol_ms_per_txn={res['protocol_ms_per_txn']}",
@@ -354,7 +361,8 @@ def mode_serve(args):
     # machine-readable summary on stdout (stderr carries the table)
     print(json.dumps({k: res[k] for k in
                       ("saturation_txns_per_sec", "txns",
-                       "protocol_ms_per_txn", "prof_dir")}))
+                       "protocol_ms_per_txn", "stage_ms_per_txn",
+                       "prof_dir")}))
 
 
 def mode_drain(args):

@@ -50,6 +50,14 @@ HALF="${ACCORD_TPU_FAULT_MATRIX:-all}"
 # the knob is default-enabled, any of off/0/false/no disables)
 FASTPATH_SETTINGS=("" "off")
 
+# r20: the store-grouped execution knob sweeps the same way (grouped is
+# default-on; off forces per-op decode + per-op drains).  The net leg
+# sweeps baseline / fastpath-off / store-group-off (the hatches are
+# independent layers — no full cross product needed; tier-1 runs the
+# both-off combo via the conftest canaries) and the reconfig leg
+# dual-runs whole: grouping may change speed, never one byte.
+STORE_GROUP_SETTINGS=("" "off")
+
 run_disk_leg() {
     echo ""
     echo "== storage-boundary disk-fault legs (durable journal self-test) =="
@@ -149,8 +157,14 @@ fi
 run_reconfig_leg() {
     echo ""
     echo "== reconfiguration legs (epoch churn burn + elastic TCP kills) =="
-    local rc=0
+    # r20: the whole leg dual-runs under store grouping on AND off — epoch
+    # churn composed with the recovery nemesis must stay byte-deterministic
+    # on both routes, and the elastic TCP kills must converge on both
+    local rc=0 sg
+    for sg in "${STORE_GROUP_SETTINGS[@]}"; do
+    echo "-- store group: ${sg:-on}"
     env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+        ACCORD_TPU_STORE_GROUP="$sg" \
         XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
         python - <<'PY' || rc=1
 import sys
@@ -191,13 +205,15 @@ print("reconfig churn legs clean: deterministic, composed with the "
       "recovery nemesis, every seed converged")
 PY
     for kill in "--kill-joiner" "--kill-proposer"; do
-        echo "-- leg: elastic TCP $kill"
+        echo "-- leg: elastic TCP $kill store_group=${sg:-on}"
         if ! env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
+            ACCORD_TPU_STORE_GROUP="$sg" \
             python -m accord_tpu.net.harness --reconfig-smoke $kill \
             --out "${FAULT_MATRIX_OUT:-/tmp}"; then
-            echo "   LEG FAILED: reconfig $kill (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
+            echo "   LEG FAILED: reconfig $kill store_group=${sg:-on} (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
             rc=1
         fi
+    done
     done
     return $rc
 }
@@ -214,17 +230,23 @@ run_net_leg() {
     # tearing a half-written coalesced binary batch must behave exactly
     # like the json debug codec's (protocol outcomes identical, zero
     # duplicate replies; the harness asserts both)
-    local rc=0 fp
-    for fp in "${FASTPATH_SETTINGS[@]}"; do
+    local rc=0 combo fp sg
+    # knob combos: baseline (both on) / r18 fastpath off / r20 store
+    # grouping off — each escape hatch dual-runs against every socket
+    # fault class without crossing the full knob product
+    for combo in ":" "off:" ":off"; do
+        fp="${combo%%:*}"
+        sg="${combo##*:}"
     for codec in binary json; do
         for spec in "conn_reset:0.04:5" "stalled_peer:0.03:5" "slow_link:0.25:5"; do
-            echo "-- leg: $spec codec=$codec fastpath=${fp:-on}"
+            echo "-- leg: $spec codec=$codec fastpath=${fp:-on} store_group=${sg:-on}"
             if ! env JAX_PLATFORMS=cpu JAX_ENABLE_X64=true \
                 ACCORD_TPU_PROTO_FASTPATH="$fp" \
+                ACCORD_TPU_STORE_GROUP="$sg" \
                 python -m accord_tpu.net.harness --smoke --txns 60 --nodes 2 \
                 --net-faults "$spec" --wire-codec "$codec" \
                 --out "${FAULT_MATRIX_OUT:-/tmp}"; then
-                echo "   LEG FAILED: $spec codec=$codec fastpath=${fp:-on} (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
+                echo "   LEG FAILED: $spec codec=$codec fastpath=${fp:-on} store_group=${sg:-on} (post-mortems in ${FAULT_MATRIX_OUT:-/tmp})"
                 rc=1
             fi
         done

@@ -375,6 +375,110 @@ async def sweep(cluster, duration: float, probe_s: float,
     return out
 
 
+async def pinned_probe(cluster: ServeCluster, duration: float,
+                       workers: int) -> dict:
+    """One closed-loop saturation window against an already-spawned
+    cluster (the r20 multi-box / pinned-core leg, BENCH config 10):
+    warm, probe, snapshot the cluster's serving counters."""
+    client = ClusterClient(cluster.addrs, timeout=10.0,
+                           codec=cluster.wire_codec)
+    try:
+        await wait_ready(cluster, client, timeout=90.0)
+        await saturation_probe(client, workers=4, duration=1.5, seed=3)
+        probe = await saturation_probe(client, workers=workers,
+                                       duration=duration, seed=42)
+        net = await cluster_net_stats(client, cluster.names)
+        return {"rate": probe["rate"], "p99_ms": probe["p99_ms"],
+                "net": net, "n_ok": client.n_ok,
+                "duplicate_replies": client.duplicate_replies()}
+    finally:
+        await client.close()
+
+
+def multibox_leg(args, note, probe_s: float,
+                 probe_workers: int) -> list:
+    """The r20 topology leg: the same N-node cluster with each node
+    process PINNED to its own core (taskset) — the honest separate-box
+    stand-in on a shared-memory host — or on genuinely separate hosts
+    via ``--hosts``.  Grouped and per-op execution run back-to-back in
+    the same oscillation window; the topology (hosts, host_cpus, the
+    name->cpu pinning map) rides the row.  Done-bar: >= ~1k txn/s
+    loopback with grouping on (recorded either way — a shortfall rides
+    the row with the A/B evidence, not a silent drop)."""
+    hosts = ([h.strip() for h in args.hosts.split(",") if h.strip()]
+             if args.hosts else None)
+    try:
+        avail = sorted(os.sched_getaffinity(0))
+    except AttributeError:
+        avail = list(range(os.cpu_count() or 1))
+    # one core per node when the box has them; otherwise honest
+    # round-robin over what exists (the row records which it was)
+    pin = avail if not hosts else None
+    results = {}
+    topo = None
+    for tag, env_extra in (("on", None),
+                           ("off", {"ACCORD_TPU_STORE_GROUP": "off"})):
+        mcluster = ServeCluster(
+            n_nodes=args.nodes, stores=args.stores,
+            admit_max=args.admit_max, target_p99_ms=args.target_p99_ms,
+            request_timeout_ms=3000, wire_codec=args.wire_codec,
+            hosts=hosts, pin_cpus=pin)
+        for name in mcluster.names:
+            mcluster.spawn(name, env_extra=env_extra)
+        topo = mcluster.topology()
+        note(f"multibox leg ({tag}): spawned {args.nodes} nodes "
+             f"topology={topo}")
+        try:
+            results[tag] = asyncio.run(
+                pinned_probe(mcluster, probe_s, probe_workers))
+            results[tag]["alive"] = mcluster.alive()
+        finally:
+            mcluster.shutdown()
+    on, off = results["on"], results["off"]
+    rate_on, rate_off = on["rate"], off["rate"]
+    ratio = round(rate_on / rate_off, 4) if rate_off else None
+    done_bar = rate_on >= 1000.0
+    prefix = f"serve_tcp_{args.nodes}n"
+    net = on["net"] or {}
+    ok_total = max(1, on["n_ok"])
+    row = {
+        "config": 10,
+        "metric": f"{prefix}_pinned_cores_saturation_txns_per_sec",
+        "value": round(rate_on, 1), "unit": "txn/s",
+        "gated": False,
+        "platform": "cpu",
+        "transport": ("tcp-multihost" if hosts else
+                      "tcp-loopback-pinned-cores"),
+        "wire_codec": args.wire_codec,
+        "topology": topo,
+        "saturation_p99_ms": on["p99_ms"],
+        "store_group_off_saturation_txns_per_sec": round(rate_off, 1),
+        "vs_store_group_off": ratio,
+        "done_bar_1k_txns_per_sec": done_bar,
+        "grouped_ops": net.get("grouped_ops", 0),
+        "group_fallbacks": net.get("group_fallbacks", 0),
+        "store_group_occupancy_p50": net.get(
+            "store_group_occupancy_p50", 0),
+        "grouped_ops_per_1k_txn":
+            (1000 * net.get("grouped_ops", 0)) // ok_total,
+        "duplicate_replies": on["duplicate_replies"]
+        + off["duplicate_replies"],
+        "all_nodes_alive": all(on["alive"].values())
+        and all(off["alive"].values()),
+        "note": "ROADMAP item 4's multi-box done-bar: every node "
+                "process pinned to its own core (taskset) unless "
+                "--hosts named real separate boxes; grouped "
+                "(default) vs ACCORD_TPU_STORE_GROUP=off probed "
+                "back-to-back in the same oscillation window; "
+                "wall-clock row, info-only in the gates (topology "
+                "experiments don't pair across rounds)",
+    }
+    note(f"multibox: grouped={rate_on:.1f} txn/s per-op={rate_off:.1f} "
+         f"txn/s ratio={ratio} done_bar_1k={done_bar} "
+         f"pinning={topo and topo.get('pinning')}")
+    return [row]
+
+
 def graceful_overload_verdict(result: dict, alive: dict) -> dict:
     """The r12 acceptance gate: shed-not-collapse at 3x saturation.
 
@@ -440,6 +544,14 @@ def main(argv=None) -> int:
                    help="skip the r18 profiled leg (short cProfile'd "
                         "saturation run; protocol_ms_per_txn on the "
                         "config-6 rows)")
+    p.add_argument("--no-multibox-leg", action="store_true",
+                   help="skip the r20 topology leg (per-node core "
+                        "pinning or --hosts, grouped vs per-op A/B, "
+                        "BENCH config 10)")
+    p.add_argument("--hosts", default=None,
+                   help="comma-separated host list for the config-10 "
+                        "leg (real multi-box); default: loopback with "
+                        "per-node taskset core pinning")
     p.add_argument("--wire-codec", choices=("json", "binary"),
                    default="binary",
                    help="wire codec for every node AND the load "
@@ -494,6 +606,14 @@ def main(argv=None) -> int:
         "batched_ops": net.get("batched_ops", 0),
         "batch_occupancy_p50": net.get("batch_occupancy_p50", 0),
         "fast_sheds": net.get("fast_sheds", 0),
+        # r20: the store-grouped execution census — how many protocol ops
+        # rode a grouped scheduler callback, how many fell back per-op
+        # (cross-epoch / non-protocol sub-bodies), and the median ops
+        # sharing one SafeCommandStore acquisition
+        "grouped_ops": net.get("grouped_ops", 0),
+        "group_fallbacks": net.get("group_fallbacks", 0),
+        "store_group_occupancy_p50": net.get(
+            "store_group_occupancy_p50", 0),
         "client_ok_total": ok_total,
         "wire_bytes_tx_per_txn": net.get("wire_bytes_tx", 0) // ok_total,
         "wire_bytes_rx_per_txn": net.get("wire_bytes_rx", 0) // ok_total,
@@ -716,6 +836,14 @@ def main(argv=None) -> int:
              + (f" strict_error={eres.get('strict_error')}"
                 if eres.get("strict_error") else ""))
 
+    # -- the r20 topology leg (BENCH config 10): pinned-core (or real
+    #    multi-host) cluster, grouped vs per-op back-to-back ------------
+    if not args.no_multibox_leg:
+        try:
+            rows.extend(multibox_leg(args, note, probe_s, probe_workers))
+        except Exception as e:       # topology leg must never sink the
+            note(f"multibox leg failed: {e!r}")  # graceful-overload rows
+
     # -- the r18 profiled leg: a SHORT saturation run with every node
     #    under cProfile (ACCORD_TPU_NODE_PROFILE), merged into one
     #    protocol-CPU-per-txn number.  Profiler overhead (~1us/call) and
@@ -743,13 +871,43 @@ def main(argv=None) -> int:
                 target_p99_ms=args.target_p99_ms,
                 wire_codec=args.wire_codec, note=note,
                 env_extra={"ACCORD_TPU_PROTO_FASTPATH": "off"})
+            # r20: the grouped-vs-per-op cut.  TWO interleaved on/off
+            # pairs (on already ran above as `prof`), quoted peak/peak
+            # like the config-7 durability ratio — a single-draw ratio
+            # tracks the box's 2-4x oscillation, not grouping cost
+            def _goff_run():
+                return profiled_saturation_run(
+                    n_nodes=args.nodes, stores=args.stores,
+                    duration=min(duration, 6.0),
+                    admit_max=args.admit_max,
+                    target_p99_ms=args.target_p99_ms,
+                    wire_codec=args.wire_codec, note=note,
+                    env_extra={"ACCORD_TPU_STORE_GROUP": "off"})
+            goff = _goff_run()
+            prof2 = profiled_saturation_run(
+                n_nodes=args.nodes, stores=args.stores,
+                duration=min(duration, 6.0),
+                admit_max=args.admit_max,
+                target_p99_ms=args.target_p99_ms,
+                wire_codec=args.wire_codec, note=note)
+            goff2 = _goff_run()
+            on_reps = [prof["protocol_ms_per_txn"],
+                       prof2["protocol_ms_per_txn"]]
+            goff_reps = [goff["protocol_ms_per_txn"],
+                         goff2["protocol_ms_per_txn"]]
+            if prof2["protocol_ms_per_txn"] < prof["protocol_ms_per_txn"]:
+                prof = prof2
+            if goff2["protocol_ms_per_txn"] < goff["protocol_ms_per_txn"]:
+                goff = goff2
             pms = prof["protocol_ms_per_txn"]
             pms_off = off["protocol_ms_per_txn"]
+            pms_goff = goff["protocol_ms_per_txn"]
             top = [{"frame": f["frame"],
                     "ms_per_txn": f["ms_per_txn"],
                     "calls_per_txn": f["calls_per_txn"]}
                    for f in prof["frames"][:5]]
             rows[0]["protocol_ms_per_txn"] = pms
+            rows[0]["stage_ms_per_txn"] = prof.get("stage_ms_per_txn")
             rows.append({
                 "config": 6,
                 "metric": f"{prefix}_protocol_ms_per_txn",
@@ -763,6 +921,16 @@ def main(argv=None) -> int:
                 "vs_fastpath_off": round(pms_off / pms, 4) if pms else None,
                 "fastpath_off_saturation_txns_per_sec":
                     off["saturation_txns_per_sec"],
+                "protocol_ms_per_txn_store_group_off": pms_goff,
+                "protocol_ms_per_txn_reps": on_reps,
+                "protocol_ms_per_txn_store_group_off_reps": goff_reps,
+                "vs_store_group_off":
+                    round(pms_goff / pms, 4) if pms else None,
+                "store_group_off_saturation_txns_per_sec":
+                    goff["saturation_txns_per_sec"],
+                "stage_ms_per_txn": prof.get("stage_ms_per_txn"),
+                "stage_ms_per_txn_store_group_off":
+                    goff.get("stage_ms_per_txn"),
                 "top_frames": top,
                 "note": "sum of tottime over accord_tpu frames across "
                         "all nodes (merged pstats), per committed txn, "
@@ -773,11 +941,23 @@ def main(argv=None) -> int:
                         "re-run (ACCORD_TPU_PROTO_FASTPATH=off, same "
                         "tool, adjacent window) anchors vs_fastpath_off "
                         "— the in-artifact cache-on/off cut; "
-                        "calls_per_txn is the box-independent signal",
+                        "the _store_group_off re-runs "
+                        "(ACCORD_TPU_STORE_GROUP=off, same tool, two "
+                        "interleaved on/off pairs quoted peak/peak like "
+                        "config-7) anchor vs_store_group_off — the r20 "
+                        "grouped-vs-per-op cut; stage_ms_per_txn "
+                        "partitions the scalar into decode / "
+                        "scheduler_hop / store_setup / handler_body / "
+                        "reply_encode; calls_per_txn is the "
+                        "box-independent signal",
             })
-            note(f"profiled leg: protocol={pms}ms/txn (off={pms_off}) "
+            note(f"profiled leg: protocol={pms}ms/txn "
+                 f"(fastpath_off={pms_off} store_group_off={pms_goff}) "
                  f"over {prof['txns']} txns "
                  f"({prof['saturation_txns_per_sec']} txn/s profiled)")
+            note(f"  stages ms/txn: "
+                 + " ".join(f"{k}={v}" for k, v in
+                            (prof.get("stage_ms_per_txn") or {}).items()))
         except Exception as e:          # profile leg must never sink the
             note(f"profile leg failed: {e!r}")   # graceful-overload rows
 
